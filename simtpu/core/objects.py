@@ -1,0 +1,297 @@
+"""Lightweight Kubernetes object model.
+
+The reference links the full k8s API type tree (`pkg/simulator/core.go:29-43`
+enumerates the 13 resource kinds it ingests). We are not a controller — objects
+here are inert simulation inputs — so instead of typed structs we keep each
+manifest as its raw dict and provide accessor helpers for the handful of fields
+the scheduler semantics read. This keeps ingestion = `yaml.safe_load`, workload
+expansion = dict surgery, and leaves the numeric heavy lifting to tensorize.py.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+from .quantity import parse_quantity
+
+# Kind names shared with simtpu.constants (single canonical table there).
+from ..constants import (  # noqa: F401
+    KIND_CRON_JOB,
+    KIND_DEPLOYMENT,
+    KIND_DS,
+    KIND_JOB,
+    KIND_POD,
+    KIND_RC,
+    KIND_RS,
+    KIND_STS,
+)
+
+KIND_SERVICE = "Service"
+KIND_PVC = "PersistentVolumeClaim"
+KIND_PDB = "PodDisruptionBudget"
+KIND_STORAGE_CLASS = "StorageClass"
+KIND_NODE = "Node"
+
+WORKLOAD_KINDS = (
+    KIND_DEPLOYMENT,
+    KIND_RS,
+    KIND_RC,
+    KIND_STS,
+    KIND_DS,
+    KIND_JOB,
+    KIND_CRON_JOB,
+)
+
+
+def meta(obj: dict) -> dict:
+    """Read-only view of metadata; use ensure_meta() when mutating."""
+    return obj.get("metadata") or {}
+
+
+def ensure_meta(obj: dict) -> dict:
+    return obj.setdefault("metadata", {})
+
+
+def name_of(obj: dict) -> str:
+    return meta(obj).get("name", "")
+
+
+def namespace_of(obj: dict) -> str:
+    return meta(obj).get("namespace") or "default"
+
+
+def labels_of(obj: dict) -> Dict[str, str]:
+    return meta(obj).get("labels") or {}
+
+
+def annotations_of(obj: dict) -> Dict[str, str]:
+    return meta(obj).get("annotations") or {}
+
+
+def set_annotation(obj: dict, key: str, value: str) -> None:
+    ensure_meta(obj).setdefault("annotations", {})[key] = value
+
+
+def set_label(obj: dict, key: str, value: str) -> None:
+    ensure_meta(obj).setdefault("labels", {})[key] = value
+
+
+def nn_key(obj: dict) -> str:
+    """namespace/name key used for identity maps."""
+    return f"{namespace_of(obj)}/{name_of(obj)}"
+
+
+def owner_references(obj: dict) -> List[dict]:
+    return meta(obj).get("ownerReferences") or []
+
+
+def deep_copy(obj: dict) -> dict:
+    return copy.deepcopy(obj)
+
+
+# ---------------------------------------------------------------------------
+# Pod helpers
+# ---------------------------------------------------------------------------
+
+
+def pod_spec(pod: dict) -> dict:
+    """Read-only view of spec."""
+    return pod.get("spec") or {}
+
+
+def pod_node_name(pod: dict) -> str:
+    return pod_spec(pod).get("nodeName") or ""
+
+
+def pod_containers(pod: dict) -> List[dict]:
+    return pod_spec(pod).get("containers") or []
+
+
+def pod_init_containers(pod: dict) -> List[dict]:
+    return pod_spec(pod).get("initContainers") or []
+
+
+def _container_requests(container: dict) -> Dict[str, float]:
+    res = (container.get("resources") or {}).get("requests") or {}
+    # limits default requests when requests are absent (k8s defaulting)
+    limits = (container.get("resources") or {}).get("limits") or {}
+    out = {k: parse_quantity(v) for k, v in limits.items()}
+    out.update({k: parse_quantity(v) for k, v in res.items()})
+    return out
+
+
+def pod_requests(pod: dict) -> Dict[str, float]:
+    """Aggregate pod resource requests.
+
+    Mirrors k8s resourcehelper.PodRequestsAndLimits (used at
+    `pkg/simulator/plugin/simon.go:45`): sum of containers, elementwise max with
+    each init container, plus pod overhead.
+    """
+    totals: Dict[str, float] = {}
+    for c in pod_containers(pod):
+        for k, v in _container_requests(c).items():
+            totals[k] = totals.get(k, 0.0) + v
+    for c in pod_init_containers(pod):
+        for k, v in _container_requests(c).items():
+            if v > totals.get(k, 0.0):
+                totals[k] = v
+    for k, v in (pod_spec(pod).get("overhead") or {}).items():
+        totals[k] = totals.get(k, 0.0) + parse_quantity(v)
+    return {k: v for k, v in totals.items() if v > 0}
+
+
+def pod_host_ports(pod: dict) -> List[tuple]:
+    """(protocol, hostIP, hostPort) triples, for the NodePorts filter."""
+    out = []
+    for c in pod_containers(pod):
+        for p in c.get("ports") or []:
+            hp = p.get("hostPort")
+            if hp:
+                out.append((p.get("protocol", "TCP"), p.get("hostIP", "0.0.0.0"), int(hp)))
+    return out
+
+
+def pod_tolerations(pod: dict) -> List[dict]:
+    return pod_spec(pod).get("tolerations") or []
+
+
+def pod_node_selector(pod: dict) -> Dict[str, str]:
+    return pod_spec(pod).get("nodeSelector") or {}
+
+
+def pod_affinity(pod: dict) -> dict:
+    return pod_spec(pod).get("affinity") or {}
+
+
+# ---------------------------------------------------------------------------
+# Node helpers
+# ---------------------------------------------------------------------------
+
+
+def node_allocatable(node: dict) -> Dict[str, float]:
+    alloc = ((node.get("status") or {}).get("allocatable")) or {}
+    return {k: parse_quantity(v) for k, v in alloc.items()}
+
+
+def node_taints(node: dict) -> List[dict]:
+    return (node.get("spec") or {}).get("taints") or []
+
+
+def node_unschedulable(node: dict) -> bool:
+    return bool((node.get("spec") or {}).get("unschedulable"))
+
+
+# ---------------------------------------------------------------------------
+# ResourceTypes — the 13-kind container (pkg/simulator/core.go:29-43)
+# ---------------------------------------------------------------------------
+
+_KIND_TO_FIELD = {
+    KIND_POD: "pods",
+    KIND_DEPLOYMENT: "deployments",
+    KIND_RS: "replica_sets",
+    KIND_RC: "replication_controllers",
+    KIND_STS: "stateful_sets",
+    KIND_DS: "daemon_sets",
+    KIND_JOB: "jobs",
+    KIND_CRON_JOB: "cron_jobs",
+    KIND_SERVICE: "services",
+    KIND_PVC: "persistent_volume_claims",
+    KIND_PDB: "pod_disruption_budgets",
+    KIND_STORAGE_CLASS: "storage_classes",
+    KIND_NODE: "nodes",
+}
+
+
+@dataclass
+class ResourceTypes:
+    """All simulation inputs, grouped by kind.
+
+    Mirrors `simulator.ResourceTypes` (`pkg/simulator/core.go:29-43`).
+    """
+
+    nodes: List[dict] = field(default_factory=list)
+    pods: List[dict] = field(default_factory=list)
+    deployments: List[dict] = field(default_factory=list)
+    replica_sets: List[dict] = field(default_factory=list)
+    replication_controllers: List[dict] = field(default_factory=list)
+    stateful_sets: List[dict] = field(default_factory=list)
+    daemon_sets: List[dict] = field(default_factory=list)
+    jobs: List[dict] = field(default_factory=list)
+    cron_jobs: List[dict] = field(default_factory=list)
+    services: List[dict] = field(default_factory=list)
+    persistent_volume_claims: List[dict] = field(default_factory=list)
+    pod_disruption_budgets: List[dict] = field(default_factory=list)
+    storage_classes: List[dict] = field(default_factory=list)
+
+    def add(self, obj: dict) -> bool:
+        """Type-switch an object into its bucket.
+
+        Mirrors `simulator.GetObjectFromYamlContent`'s decode-and-switch
+        (`pkg/simulator/utils.go:139-183`). Returns False for unrecognized kinds
+        (the reference errors; callers decide).
+        """
+        kind = obj.get("kind")
+        fld = _KIND_TO_FIELD.get(kind)
+        if fld is None:
+            return False
+        getattr(self, fld).append(obj)
+        return True
+
+    def extend(self, other: "ResourceTypes") -> None:
+        for fld in _KIND_TO_FIELD.values():
+            getattr(self, fld).extend(getattr(other, fld))
+
+    def workloads(self) -> Iterator[dict]:
+        for fld in (
+            "deployments",
+            "replica_sets",
+            "replication_controllers",
+            "stateful_sets",
+            "daemon_sets",
+            "jobs",
+            "cron_jobs",
+        ):
+            yield from getattr(self, fld)
+
+    def __iter__(self) -> Iterator[dict]:
+        for fld in _KIND_TO_FIELD.values():
+            yield from getattr(self, fld)
+
+
+@dataclass
+class AppResource:
+    """A named application bundle (`pkg/simulator/core.go:45-48`)."""
+
+    name: str
+    resource: ResourceTypes
+
+
+@dataclass
+class UnscheduledPod:
+    """A pod the engine could not place, with the failing constraint.
+
+    Mirrors `simulator.UnscheduledPod` (`pkg/simulator/core.go:56-59`), but the
+    reason is recovered from the constraint masks (which kernel zeroed the row)
+    instead of a PodCondition message.
+    """
+
+    pod: dict
+    reason: str
+
+
+@dataclass
+class NodeStatus:
+    """One node plus the pods placed on it (`pkg/simulator/core.go:105-108`)."""
+
+    node: dict
+    pods: List[dict]
+
+
+@dataclass
+class SimulateResult:
+    """Result of one simulation (`pkg/simulator/core.go:56-62`)."""
+
+    unscheduled_pods: List[UnscheduledPod]
+    node_status: List[NodeStatus]

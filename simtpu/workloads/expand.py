@@ -1,0 +1,309 @@
+"""Workload → Pod expansion (kube-controller-manager emulation).
+
+Host-side pass that turns every workload kind into the concrete pods the
+scheduler will see, mirroring `pkg/utils/utils.go:133-497`:
+
+- Deployment → synthetic ReplicaSet → pods (`utils.go:133-136,185-195`)
+- ReplicaSet / ReplicationController → pods, replicas default 1 (`:138-183`)
+- CronJob → synthetic Job → pods (`:197-241`)
+- Job → pods, completions default 1 (`:202-227`)
+- StatefulSet → pods named `{name}-{ordinal}` with local-storage annotations
+  from volumeClaimTemplates (`:243-316`)
+- DaemonSet → one pod per matching node, pinned via a `metadata.name`
+  MatchFields node-affinity term (`:356-395,861-906`)
+
+Pod metadata comes from the *owner's* metadata (labels/annotations of the
+workload object, not the pod template — `SetObjectMetaFromObject`,
+`utils.go:318-347`), with a random hash suffix on the name.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+from typing import List, Optional
+
+from .. import constants as C
+from ..core.match import node_should_run_pod
+from ..core.objects import (
+    ResourceTypes,
+    annotations_of,
+    deep_copy,
+    ensure_meta,
+    labels_of,
+    name_of,
+    namespace_of,
+    set_annotation,
+)
+from ..core.quantity import parse_quantity
+from .validate import validate_node, validate_pod
+
+_rng = random.Random()
+
+
+def seed_name_hashes(seed: Optional[int]) -> None:
+    """Make generated pod-name suffixes reproducible (tests, planner sweeps)."""
+    global _rng
+    _rng = random.Random(seed)
+
+
+def _hash_suffix(digits: int) -> str:
+    """Random sha256-prefix suffix (`utils.GetSHA256HashCode`, utils.go:531-536)."""
+    token = "".join(_rng.choice("abcdefghijklmnopqrstuvwxyz0123456789") for _ in range(10))
+    return hashlib.sha256(token.encode()).hexdigest()[:digits]
+
+
+def _object_meta_from_owner(owner: dict, owner_kind: str, gen_pod: bool) -> dict:
+    """Pod/workload metadata derived from its owner (`utils.go:318-347`)."""
+    digits = C.POD_HASH_DIGITS if gen_pod else C.WORKLOAD_HASH_DIGITS
+    m = {
+        "name": f"{name_of(owner)}{C.SEPARATE_SYMBOL}{_hash_suffix(digits)}",
+        "namespace": namespace_of(owner),
+        "generateName": name_of(owner),
+        "ownerReferences": [
+            {
+                "kind": owner_kind,
+                "name": name_of(owner),
+                "controller": True,
+            }
+        ],
+    }
+    if labels_of(owner):
+        m["labels"] = dict(labels_of(owner))
+    if annotations_of(owner):
+        m["annotations"] = dict(annotations_of(owner))
+    return m
+
+
+def _pod_from_template(owner: dict, owner_kind: str) -> dict:
+    spec = deep_copy(((owner.get("spec") or {}).get("template") or {}).get("spec") or {})
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": _object_meta_from_owner(owner, owner_kind, gen_pod=True),
+        "spec": spec,
+    }
+
+
+def make_valid_pod(pod: dict) -> dict:
+    """Normalize a pod the way the reference does (`utils.go:407-489`).
+
+    Defaults namespace/DNSPolicy/RestartPolicy/SchedulerName, strips probes,
+    env, volume mounts and image-pull secrets (irrelevant to scheduling),
+    converts PVC volumes to hostPath, then validates.
+    """
+    pod = deep_copy(pod)
+    m = ensure_meta(pod)
+    m.setdefault("labels", {})
+    m.setdefault("annotations", {})
+    if not m.get("namespace"):
+        m["namespace"] = "default"
+    spec = pod.setdefault("spec", {})
+    spec.setdefault("dnsPolicy", "ClusterFirst")
+    spec.setdefault("restartPolicy", "Always")
+    if not spec.get("schedulerName"):
+        spec["schedulerName"] = C.DEFAULT_SCHEDULER_NAME
+    spec.pop("imagePullSecrets", None)
+    for clist in ("initContainers", "containers"):
+        for c in spec.get(clist) or []:
+            c.setdefault("terminationMessagePolicy", "FallbackToLogsOnError")
+            c.setdefault("imagePullPolicy", "IfNotPresent")
+            c.pop("volumeMounts", None)
+            c.pop("env", None)
+            c.pop("livenessProbe", None)
+            c.pop("readinessProbe", None)
+            c.pop("startupProbe", None)
+    for vol in spec.get("volumes") or []:
+        if "persistentVolumeClaim" in vol:
+            vol.pop("persistentVolumeClaim")
+            vol["hostPath"] = {"path": "/tmp"}
+    validate_pod(pod)
+    return pod
+
+
+def add_workload_info(pod: dict, kind: str, name: str, namespace: str) -> dict:
+    """Annotate the pod with its source workload (`utils.go:492-497`)."""
+    set_annotation(pod, C.ANNO_WORKLOAD_KIND, kind)
+    set_annotation(pod, C.ANNO_WORKLOAD_NAME, name)
+    set_annotation(pod, C.ANNO_WORKLOAD_NAMESPACE, namespace)
+    return pod
+
+
+def _replicas(obj: dict, field: str = "replicas", default: int = 1) -> int:
+    val = (obj.get("spec") or {}).get(field)
+    return default if val is None else int(val)
+
+
+def make_valid_pods_by_replica_set(rs: dict) -> List[dict]:
+    pods = []
+    for _ in range(_replicas(rs)):
+        pod = make_valid_pod(_pod_from_template(rs, C.KIND_RS))
+        pods.append(add_workload_info(pod, C.KIND_RS, name_of(rs), namespace_of(rs)))
+    return pods
+
+
+def generate_replica_set_from_deployment(deploy: dict) -> dict:
+    """Deployment → its ReplicaSet (`utils.go:185-195`)."""
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "ReplicaSet",
+        "metadata": _object_meta_from_owner(deploy, C.KIND_DEPLOYMENT, gen_pod=False),
+        "spec": {
+            "selector": (deploy.get("spec") or {}).get("selector"),
+            "replicas": _replicas(deploy),
+            "template": (deploy.get("spec") or {}).get("template"),
+        },
+    }
+
+
+def make_valid_pods_by_deployment(deploy: dict) -> List[dict]:
+    return make_valid_pods_by_replica_set(generate_replica_set_from_deployment(deploy))
+
+
+def make_valid_pods_by_replication_controller(rc: dict) -> List[dict]:
+    pods = []
+    for _ in range(_replicas(rc)):
+        pod = make_valid_pod(_pod_from_template(rc, C.KIND_RC))
+        pods.append(add_workload_info(pod, C.KIND_RC, name_of(rc), namespace_of(rc)))
+    return pods
+
+
+def make_valid_pods_by_job(job: dict) -> List[dict]:
+    pods = []
+    for _ in range(_replicas(job, "completions")):
+        pod = make_valid_pod(_pod_from_template(job, C.KIND_JOB))
+        pods.append(add_workload_info(pod, C.KIND_JOB, name_of(job), namespace_of(job)))
+    return pods
+
+
+def generate_job_from_cron_job(cronjob: dict) -> dict:
+    """CronJob → one manual Job instance (`utils.go:229-241`)."""
+    job_template = (cronjob.get("spec") or {}).get("jobTemplate") or {}
+    job = {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": _object_meta_from_owner(cronjob, C.KIND_CRON_JOB, gen_pod=False),
+        "spec": deep_copy(job_template.get("spec") or {}),
+    }
+    annos = {"cronjob.kubernetes.io/instantiate": "manual"}
+    annos.update((job_template.get("metadata") or {}).get("annotations") or {})
+    ensure_meta(job)["annotations"] = annos
+    return job
+
+
+def make_valid_pods_by_cron_job(cronjob: dict) -> List[dict]:
+    return make_valid_pods_by_job(generate_job_from_cron_job(cronjob))
+
+
+def make_valid_pods_by_stateful_set(sts: dict) -> List[dict]:
+    """STS pods are named `{sts}-{ordinal}` and carry the volume-claim storage
+    annotation (`utils.go:243-316`)."""
+    pods = []
+    for ordinal in range(_replicas(sts)):
+        pod = _pod_from_template(sts, C.KIND_STS)
+        pod = make_valid_pod(pod)
+        ensure_meta(pod)["name"] = f"{name_of(sts)}-{ordinal}"
+        pods.append(add_workload_info(pod, C.KIND_STS, name_of(sts), namespace_of(sts)))
+    set_storage_annotation_on_pods(
+        pods, (sts.get("spec") or {}).get("volumeClaimTemplates") or [], name_of(sts)
+    )
+    return pods
+
+
+def set_storage_annotation_on_pods(pods: List[dict], vcts: List[dict], sts_name: str) -> None:
+    """Translate volumeClaimTemplates into the `simon/pod-local-storage`
+    annotation (`utils.go:273-316`). Unrecognized storage classes are skipped."""
+    volumes = []
+    for pvc in vcts:
+        sc = (pvc.get("spec") or {}).get("storageClassName")
+        if sc is None:
+            continue
+        size = parse_quantity(
+            (((pvc.get("spec") or {}).get("resources") or {}).get("requests") or {}).get("storage")
+        )
+        if sc in C.SC_LVM:
+            kind = "LVM"
+        elif sc in C.SC_DEVICE_SSD:
+            kind = "SSD"
+        elif sc in C.SC_DEVICE_HDD:
+            kind = "HDD"
+        else:
+            continue
+        volumes.append({"size": str(int(size)), "kind": kind, "scName": sc})
+    payload = json.dumps({"volumes": volumes})
+    for pod in pods:
+        set_annotation(pod, C.ANNO_POD_LOCAL_STORAGE, payload)
+
+
+def set_daemonset_node_affinity(pod: dict, node_name: str) -> None:
+    """Pin a daemon pod to its node via a `metadata.name` MatchFields term
+    (`utils.go:861-906`), replacing any existing required terms' fields."""
+    req = {"key": "metadata.name", "operator": "In", "values": [node_name]}
+    spec = pod.setdefault("spec", {})
+    affinity = spec.setdefault("affinity", {})
+    node_aff = affinity.setdefault("nodeAffinity", {})
+    required = node_aff.get("requiredDuringSchedulingIgnoredDuringExecution")
+    if not required or not required.get("nodeSelectorTerms"):
+        node_aff["requiredDuringSchedulingIgnoredDuringExecution"] = {
+            "nodeSelectorTerms": [{"matchFields": [req]}]
+        }
+        return
+    for term in required["nodeSelectorTerms"]:
+        term["matchFields"] = [req]
+
+
+def new_daemon_pod(ds: dict, node_name: str) -> dict:
+    """One DaemonSet pod pinned to node_name (`utils.go:372-385`)."""
+    pod = _pod_from_template(ds, C.KIND_DS)
+    set_daemonset_node_affinity(pod, node_name)
+    pod = make_valid_pod(pod)
+    return add_workload_info(pod, C.KIND_DS, name_of(ds), namespace_of(ds))
+
+
+def make_valid_pods_by_daemonset(ds: dict, nodes: List[dict]) -> List[dict]:
+    """One pod per node that should run it (`utils.go:356-370`)."""
+    pods = []
+    for node in nodes:
+        pod = new_daemon_pod(ds, name_of(node))
+        if node_should_run_pod(node, pod):
+            pods.append(pod)
+    return pods
+
+
+def make_valid_pod_by_pod(pod: dict) -> dict:
+    return make_valid_pod(pod)
+
+
+def make_valid_node_by_node(node: dict, node_name: str) -> dict:
+    """Clone a template node under a new hostname (`utils.go:499-513`)."""
+    node = deep_copy(node)
+    ensure_meta(node)["name"] = node_name
+    ensure_meta(node).setdefault("labels", {})[C.LABEL_HOSTNAME] = node_name
+    ensure_meta(node).setdefault("annotations", {})
+    validate_node(node)
+    return node
+
+
+def get_valid_pods_exclude_daemonset(resources: ResourceTypes) -> List[dict]:
+    """Expand every non-DaemonSet workload (`pkg/simulator/utils.go:111-135`).
+
+    Order matters and matches the reference: bare pods, deployments, replica
+    sets, replication controllers, stateful sets, jobs, cron jobs.
+    """
+    pods: List[dict] = []
+    for item in resources.pods:
+        pods.append(make_valid_pod_by_pod(item))
+    for item in resources.deployments:
+        pods.extend(make_valid_pods_by_deployment(item))
+    for item in resources.replica_sets:
+        pods.extend(make_valid_pods_by_replica_set(item))
+    for item in resources.replication_controllers:
+        pods.extend(make_valid_pods_by_replication_controller(item))
+    for item in resources.stateful_sets:
+        pods.extend(make_valid_pods_by_stateful_set(item))
+    for item in resources.jobs:
+        pods.extend(make_valid_pods_by_job(item))
+    for item in resources.cron_jobs:
+        pods.extend(make_valid_pods_by_cron_job(item))
+    return pods

@@ -1,0 +1,60 @@
+"""Object validation.
+
+The reference runs the full upstream API validation on every generated pod and
+node (`pkg/utils/utils.go:516-529,654-668` → k8s.io/kubernetes validation). We
+validate the subset of invariants the simulator actually depends on; anything
+violating them raises ValidationError before tensorization, so the engine never
+sees malformed inputs.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..core.objects import meta, name_of, namespace_of, pod_containers, pod_requests
+
+_DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9.]*[a-z0-9])?$")
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def _validate_name(name: str, what: str) -> None:
+    if not name or len(name) > 253 or not _DNS1123.match(name):
+        raise ValidationError(f"invalid {what} name: {name!r}")
+
+
+def validate_pod(pod: dict) -> None:
+    _validate_name(name_of(pod), "pod")
+    _validate_name(namespace_of(pod), "namespace")
+    containers = pod_containers(pod)
+    if not containers:
+        raise ValidationError(f"pod {name_of(pod)} has no containers")
+    seen = set()
+    for c in containers:
+        cname = c.get("name")
+        if not cname:
+            raise ValidationError(f"pod {name_of(pod)} has a container without a name")
+        if cname in seen:
+            raise ValidationError(f"pod {name_of(pod)} has duplicate container name {cname}")
+        seen.add(cname)
+    for k, v in pod_requests(pod).items():
+        if v < 0:
+            raise ValidationError(f"pod {name_of(pod)} has negative request {k}={v}")
+    restart = (pod.get("spec") or {}).get("restartPolicy", "Always")
+    if restart not in ("Always", "OnFailure", "Never"):
+        raise ValidationError(f"pod {name_of(pod)} has invalid restartPolicy {restart!r}")
+
+
+def validate_node(node: dict) -> None:
+    _validate_name(name_of(node), "node")
+    labels = meta(node).get("labels") or {}
+    from ..constants import LABEL_HOSTNAME
+
+    if LABEL_HOSTNAME in labels and labels[LABEL_HOSTNAME] != name_of(node):
+        # mirror of upstream rule: hostname label, when present, must equal name
+        # (the reference sets it explicitly in MakeValidNodeByNode, utils.go:505)
+        raise ValidationError(
+            f"node {name_of(node)}: hostname label {labels[LABEL_HOSTNAME]!r} != name"
+        )

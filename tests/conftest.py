@@ -1,0 +1,24 @@
+"""Test configuration.
+
+Tests run on CPU with a virtual 8-device topology so multi-chip sharding
+(`simtpu.parallel`) is exercised without TPU hardware, per the driver contract.
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+REFERENCE_EXAMPLES = "/root/reference/example"
+
+
+@pytest.fixture(scope="session")
+def example_dir():
+    if not os.path.isdir(REFERENCE_EXAMPLES):
+        pytest.skip("reference example fixtures not available")
+    return REFERENCE_EXAMPLES

@@ -1,0 +1,203 @@
+"""Unit tests: quantity grammar, YAML ingestion, predicate matching."""
+
+import os
+
+import pytest
+
+from simtpu.core.match import (
+    node_should_run_pod,
+    pod_matches_node_selector_and_affinity,
+    pod_tolerates_node_taints,
+    toleration_tolerates_taint,
+)
+from simtpu.core.objects import ResourceTypes, pod_requests
+from simtpu.core.quantity import format_quantity, parse_quantity
+from simtpu.io.cluster import create_cluster_resource_from_cluster_config
+from simtpu.io.yaml_loader import load_resources
+
+
+class TestQuantity:
+    @pytest.mark.parametrize(
+        "text,expected",
+        [
+            ("100m", 0.1),
+            ("1500m", 1.5),
+            ("8", 8.0),
+            ("16Gi", 16 * 2**30),
+            ("512Mi", 512 * 2**20),
+            ("32560Mi", 32560 * 2**20),
+            ("1", 1.0),
+            ("0", 0.0),
+            ("107374182400", 107374182400.0),
+            ("2k", 2000.0),
+            ("1e3", 1000.0),
+            (110, 110.0),
+            (None, 0.0),
+        ],
+    )
+    def test_parse(self, text, expected):
+        assert parse_quantity(text) == expected
+
+    def test_bad(self):
+        with pytest.raises(ValueError):
+            parse_quantity("banana")
+
+    def test_format_roundtrip(self):
+        assert format_quantity(1.5, "cpu") == "1500m"
+        assert format_quantity(16 * 2**30, "mem") == "16Gi"
+
+
+class TestIngestion:
+    def test_demo1_cluster(self, example_dir):
+        res = create_cluster_resource_from_cluster_config(
+            os.path.join(example_dir, "cluster/demo_1")
+        )
+        names = sorted(n["metadata"]["name"] for n in res.nodes)
+        assert names == ["master-1", "master-2", "master-3", "worker-1"]
+        # static pods from manifests/ + kube-proxy daemonsets + coredns + metrics-server
+        assert len(res.pods) >= 3
+        assert len(res.daemon_sets) == 3
+        assert len(res.deployments) == 1
+        assert len(res.storage_classes) == 3
+        # node-1.json storage annotations attached by name match
+        anno = {n["metadata"]["name"]: n["metadata"].get("annotations", {}) for n in res.nodes}
+        assert "simon/node-local-storage" in anno["master-1"]
+        assert "simon/node-local-storage" in anno["worker-1"]
+        assert "simon/node-local-storage" not in anno["master-2"]
+
+    def test_simple_app(self, example_dir):
+        res = load_resources(os.path.join(example_dir, "application/simple"))
+        assert len(res.deployments) == 1
+        assert len(res.daemon_sets) == 1
+        assert len(res.jobs) == 1
+        assert len(res.pods) == 1
+        assert len(res.stateful_sets) == 1
+        assert len(res.replica_sets) == 1
+
+    def test_gpushare_cluster(self, example_dir):
+        res = load_resources(os.path.join(example_dir, "cluster/gpushare"))
+        assert len(res.nodes) == 2
+        alloc = res.nodes[0]["status"]["allocatable"]
+        assert parse_quantity(alloc["alibabacloud.com/gpu-count"]) == 2
+
+
+class TestPodRequests:
+    def test_sum_and_init_max(self):
+        pod = {
+            "spec": {
+                "containers": [
+                    {"name": "a", "resources": {"requests": {"cpu": "500m", "memory": "1Gi"}}},
+                    {"name": "b", "resources": {"requests": {"cpu": "250m"}}},
+                ],
+                "initContainers": [
+                    {"name": "init", "resources": {"requests": {"cpu": "2", "memory": "64Mi"}}}
+                ],
+            }
+        }
+        req = pod_requests(pod)
+        assert req["cpu"] == 2.0  # init container dominates
+        assert req["memory"] == 2**30
+
+    def test_limits_default_requests(self):
+        pod = {"spec": {"containers": [{"name": "a", "resources": {"limits": {"cpu": "1"}}}]}}
+        assert pod_requests(pod)["cpu"] == 1.0
+
+
+MASTER_TAINT = {"key": "node-role.kubernetes.io/master", "effect": "NoSchedule"}
+
+
+def _node(name, labels=None, taints=None):
+    n = {
+        "kind": "Node",
+        "metadata": {"name": name, "labels": labels or {}},
+        "spec": {},
+        "status": {"allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"}},
+    }
+    if taints:
+        n["spec"]["taints"] = taints
+    return n
+
+
+class TestMatch:
+    def test_toleration_exists_all(self):
+        assert toleration_tolerates_taint({"operator": "Exists"}, MASTER_TAINT)
+
+    def test_toleration_effect_mismatch(self):
+        tol = {"key": "node-role.kubernetes.io/master", "effect": "NoExecute"}
+        assert not toleration_tolerates_taint(tol, MASTER_TAINT)
+
+    def test_taint_filter(self):
+        master = _node("m", {"node-role.kubernetes.io/master": ""}, [MASTER_TAINT])
+        pod = {"metadata": {"name": "p"}, "spec": {}}
+        assert not pod_tolerates_node_taints(pod, master)
+        pod["spec"]["tolerations"] = [
+            {"key": "node-role.kubernetes.io/master", "operator": "Exists", "effect": "NoSchedule"}
+        ]
+        assert pod_tolerates_node_taints(pod, master)
+
+    def test_node_selector(self):
+        worker = _node("w", {"node-role.kubernetes.io/worker": ""})
+        pod = {
+            "metadata": {"name": "p"},
+            "spec": {"nodeSelector": {"node-role.kubernetes.io/master": ""}},
+        }
+        assert not pod_matches_node_selector_and_affinity(pod, worker)
+        master = _node("m", {"node-role.kubernetes.io/master": ""})
+        assert pod_matches_node_selector_and_affinity(pod, master)
+
+    def test_affinity_exists_and_doesnotexist(self):
+        master = _node("m", {"node-role.kubernetes.io/master": ""})
+        worker = _node("w", {"node-role.kubernetes.io/worker": ""})
+        req = lambda op: {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [
+                    {
+                        "matchExpressions": [
+                            {"key": "node-role.kubernetes.io/master", "operator": op}
+                        ]
+                    }
+                ]
+            }
+        }
+        pod = {"metadata": {"name": "p"}, "spec": {"affinity": {"nodeAffinity": req("Exists")}}}
+        assert pod_matches_node_selector_and_affinity(pod, master)
+        assert not pod_matches_node_selector_and_affinity(pod, worker)
+        pod["spec"]["affinity"]["nodeAffinity"] = req("DoesNotExist")
+        assert not pod_matches_node_selector_and_affinity(pod, master)
+        assert pod_matches_node_selector_and_affinity(pod, worker)
+
+    def test_not_in_matches_absent_key(self):
+        # apimachinery selector.go:207-211 — NotIn matches when key is absent
+        from simtpu.core.match import match_requirement
+
+        req = {"key": "role", "operator": "NotIn", "values": ["master"]}
+        assert match_requirement({}, req)
+        assert not match_requirement({"role": "master"}, req)
+        assert match_requirement({"role": "worker"}, req)
+
+    def test_match_fields_pinning(self):
+        n1, n2 = _node("n1"), _node("n2")
+        pod = {
+            "metadata": {"name": "p"},
+            "spec": {
+                "affinity": {
+                    "nodeAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": {
+                            "nodeSelectorTerms": [
+                                {
+                                    "matchFields": [
+                                        {
+                                            "key": "metadata.name",
+                                            "operator": "In",
+                                            "values": ["n1"],
+                                        }
+                                    ]
+                                }
+                            ]
+                        }
+                    }
+                }
+            },
+        }
+        assert node_should_run_pod(n1, pod)
+        assert not node_should_run_pod(n2, pod)

@@ -1,0 +1,187 @@
+"""Workload → pod expansion tests (controller-manager emulation parity)."""
+
+import json
+import os
+
+import pytest
+
+import simtpu.constants as C
+from simtpu.core.objects import annotations_of, labels_of, name_of, owner_references
+from simtpu.io.yaml_loader import load_resources
+from simtpu.workloads.expand import (
+    get_valid_pods_exclude_daemonset,
+    make_valid_pods_by_daemonset,
+    make_valid_pods_by_deployment,
+    make_valid_pods_by_stateful_set,
+    new_daemon_pod,
+    seed_name_hashes,
+)
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    seed_name_hashes(0)
+
+
+def _deploy(name="web", namespace="ns", replicas=3, labels=None):
+    return {
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": namespace, "labels": labels or {"app": name}},
+        "spec": {
+            "replicas": replicas,
+            "template": {
+                "spec": {
+                    "containers": [
+                        {"name": "c", "resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}
+                    ]
+                }
+            },
+        },
+    }
+
+
+class TestDeployment:
+    def test_replica_count_and_owner_chain(self):
+        pods = make_valid_pods_by_deployment(_deploy(replicas=4))
+        assert len(pods) == 4
+        for pod in pods:
+            refs = owner_references(pod)
+            assert refs[0]["kind"] == "ReplicaSet"
+            # RS name = deploy name + "-" + 10-char hash; pod name extends it
+            rs_name = refs[0]["name"]
+            assert rs_name.startswith("web-") and len(rs_name) == len("web-") + 10
+            assert name_of(pod).startswith(rs_name + "-")
+            assert annotations_of(pod)[C.ANNO_WORKLOAD_KIND] == "ReplicaSet"
+            assert labels_of(pod)["app"] == "web"
+            assert pod["spec"]["schedulerName"] == "default-scheduler"
+
+    def test_default_replicas_is_one(self):
+        d = _deploy()
+        del d["spec"]["replicas"]
+        assert len(make_valid_pods_by_deployment(d)) == 1
+
+
+class TestStatefulSet:
+    def test_ordinal_names_and_storage_annotation(self):
+        sts = {
+            "kind": "StatefulSet",
+            "metadata": {"name": "db", "namespace": "ns"},
+            "spec": {
+                "replicas": 2,
+                "template": {"spec": {"containers": [{"name": "c"}]}},
+                "volumeClaimTemplates": [
+                    {
+                        "spec": {
+                            "storageClassName": "yoda-lvm-default",
+                            "resources": {"requests": {"storage": "10Gi"}},
+                        }
+                    },
+                    {
+                        "spec": {
+                            "storageClassName": "yoda-device-hdd",
+                            "resources": {"requests": {"storage": "100Gi"}},
+                        }
+                    },
+                ],
+            },
+        }
+        pods = make_valid_pods_by_stateful_set(sts)
+        assert [name_of(p) for p in pods] == ["db-0", "db-1"]
+        vols = json.loads(annotations_of(pods[0])[C.ANNO_POD_LOCAL_STORAGE])["volumes"]
+        assert vols[0] == {"size": str(10 * 2**30), "kind": "LVM", "scName": "yoda-lvm-default"}
+        assert vols[1]["kind"] == "HDD"
+
+
+MASTER = {
+    "kind": "Node",
+    "metadata": {
+        "name": "master-1",
+        "labels": {"node-role.kubernetes.io/master": "", "beta.kubernetes.io/os": "linux"},
+    },
+    "spec": {"taints": [{"key": "node-role.kubernetes.io/master", "effect": "NoSchedule"}]},
+    "status": {"allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"}},
+}
+WORKER = {
+    "kind": "Node",
+    "metadata": {
+        "name": "worker-1",
+        "labels": {"node-role.kubernetes.io/worker": "", "beta.kubernetes.io/os": "linux"},
+    },
+    "spec": {},
+    "status": {"allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"}},
+}
+
+
+class TestDaemonSet:
+    def _ds(self, selector=None, tolerations=None):
+        spec = {"containers": [{"name": "c"}]}
+        if selector:
+            spec["nodeSelector"] = selector
+        if tolerations:
+            spec["tolerations"] = tolerations
+        return {
+            "kind": "DaemonSet",
+            "metadata": {"name": "proxy", "namespace": "kube-system"},
+            "spec": {"template": {"spec": spec}},
+        }
+
+    def test_pinned_per_matching_node(self):
+        ds = self._ds(
+            selector={"node-role.kubernetes.io/master": ""},
+            tolerations=[{"operator": "Exists"}],
+        )
+        pods = make_valid_pods_by_daemonset(ds, [MASTER, WORKER])
+        assert len(pods) == 1
+        aff = pods[0]["spec"]["affinity"]["nodeAffinity"]
+        term = aff["requiredDuringSchedulingIgnoredDuringExecution"]["nodeSelectorTerms"][0]
+        assert term["matchFields"] == [
+            {"key": "metadata.name", "operator": "In", "values": ["master-1"]}
+        ]
+
+    def test_taint_blocks_untolerating_ds(self):
+        ds = self._ds(selector={"beta.kubernetes.io/os": "linux"})
+        pods = make_valid_pods_by_daemonset(ds, [MASTER, WORKER])
+        assert [owner_references(p)[0]["name"] for p in pods] == ["proxy"]
+        # only the untainted worker node runs it
+        term = pods[0]["spec"]["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        ]["nodeSelectorTerms"][0]
+        assert term["matchFields"][0]["values"] == ["worker-1"]
+
+    def test_existing_affinity_fields_replaced(self):
+        ds = self._ds()
+        ds["spec"]["template"]["spec"]["affinity"] = {
+            "nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [
+                        {
+                            "matchExpressions": [
+                                {"key": "node-role.kubernetes.io/worker", "operator": "Exists"}
+                            ]
+                        }
+                    ]
+                }
+            }
+        }
+        pod = new_daemon_pod(ds, "worker-1")
+        term = pod["spec"]["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        ]["nodeSelectorTerms"][0]
+        # matchFields injected while matchExpressions kept (utils.go:898-903)
+        assert term["matchFields"][0]["values"] == ["worker-1"]
+        assert term["matchExpressions"][0]["key"] == "node-role.kubernetes.io/worker"
+
+
+class TestFullExpansion:
+    def test_simple_app_pod_census(self, example_dir):
+        res = load_resources(os.path.join(example_dir, "application/simple"))
+        pods = get_valid_pods_exclude_daemonset(res)
+        # deploy(4) + rs-calico(2) + sts(4) + job(1) + bare pod(1) = 12 non-DS pods
+        by_kind = {}
+        for p in pods:
+            kind = annotations_of(p).get(C.ANNO_WORKLOAD_KIND, "Pod")
+            by_kind[kind] = by_kind.get(kind, 0) + 1
+        assert by_kind["ReplicaSet"] == 4 + 2
+        assert by_kind["StatefulSet"] == 4
+        assert by_kind["Job"] == 1
+        assert by_kind.get("Pod", 0) == 1
