@@ -1,0 +1,30 @@
+"""simtpu — a TPU-native cluster simulator and capacity planner.
+
+A ground-up JAX/XLA re-design of the capabilities of Open-Simulator
+(`/root/reference`, alibaba/open-simulator): simulated all-or-nothing
+deployment of Kubernetes app lists onto a modeled cluster, minimum-node-count
+capacity planning, and per-node placement reports — with the kube-scheduler
+replay loop replaced by batched tensor kernels scanning the pod axis.
+"""
+
+from .api import Simulator, simulate
+from .core.objects import (
+    AppResource,
+    NodeStatus,
+    ResourceTypes,
+    SimulateResult,
+    UnscheduledPod,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AppResource",
+    "NodeStatus",
+    "ResourceTypes",
+    "SimulateResult",
+    "Simulator",
+    "UnscheduledPod",
+    "simulate",
+    "__version__",
+]

@@ -1,0 +1,141 @@
+"""Public simulation API.
+
+Mirrors the reference's facade (`pkg/simulator/core.go:14-103`): `simulate()`
+is the one-shot entry (`Simulate`), `Simulator` the incremental interface
+(`Interface{RunCluster, ScheduleApp, Close}`, `core.go:50-54`). The fake
+clientset + informer + scheduler goroutine machinery is replaced by the
+Tensorizer + scan Engine: cluster state lives in dense arrays, each app batch
+is one compiled scan.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from . import constants as C
+from .core.objects import (
+    AppResource,
+    NodeStatus,
+    ResourceTypes,
+    SimulateResult,
+    UnscheduledPod,
+    deep_copy,
+    name_of,
+    namespace_of,
+    set_label,
+)
+from .core.tensorize import Tensorizer
+from .engine.scan import OK, REASON_TEXT, Engine
+from .workloads.expand import (
+    get_valid_pods_exclude_daemonset,
+    make_valid_pods_by_daemonset,
+)
+
+
+def _sort_app_pods(pods: List[dict]) -> List[dict]:
+    """Stable emulation of the reference's app-pod ordering: AffinityQueue
+    (nodeSelector pods first) then TolerationQueue (tolerations pods first),
+    applied in that order (`pkg/simulator/simulator.go:172-176`;
+    `pkg/algo/affinity.go:21-23`, `toleration.go:19-21`)."""
+    pods = sorted(pods, key=lambda p: (p.get("spec") or {}).get("nodeSelector") is None)
+    return sorted(pods, key=lambda p: (p.get("spec") or {}).get("tolerations") is None)
+
+
+class Simulator:
+    """One in-memory cluster simulation."""
+
+    def __init__(self, extra_resources: Sequence[str] = ()):
+        self._extra_resources = extra_resources
+        self._tensorizer: Optional[Tensorizer] = None
+        self._engine: Optional[Engine] = None
+        self._nodes: List[dict] = []
+        self._scheduled: List[dict] = []  # placed pods, nodeName set
+        self._unscheduled: List[UnscheduledPod] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def run_cluster(self, cluster: ResourceTypes) -> SimulateResult:
+        """Install nodes and schedule the cluster's own pods
+        (`pkg/simulator/simulator.go:159-164,251-332`)."""
+        self._nodes = [deep_copy(n) for n in cluster.nodes]
+        self._tensorizer = Tensorizer(self._nodes, self._extra_resources)
+        self._engine = Engine(self._tensorizer)
+        self._schedule_pods(cluster.pods)
+        return self._result()
+
+    def schedule_app(self, app: AppResource) -> SimulateResult:
+        """Expand one app into pods and schedule them in order
+        (`pkg/simulator/simulator.go:166-184`)."""
+        pods = get_valid_pods_exclude_daemonset(app.resource)
+        for ds in app.resource.daemon_sets:
+            pods.extend(make_valid_pods_by_daemonset(ds, self._nodes))
+        for pod in pods:
+            set_label(pod, C.LABEL_APP_NAME, app.name)
+        pods = _sort_app_pods(pods)
+        self._schedule_pods(pods)
+        return self._result()
+
+    def close(self) -> None:
+        self._tensorizer = None
+        self._engine = None
+
+    # -- internals ---------------------------------------------------------
+
+    def _schedule_pods(self, pods: Sequence[dict]) -> None:
+        if not pods:
+            return
+        batch = self._tensorizer.add_pods(pods)
+        nodes, reasons = self._engine.place(batch)
+        n_total = len(self._nodes)
+        for pod, node_idx, reason in zip(batch.pods, nodes, reasons):
+            if node_idx >= 0:
+                placed = deep_copy(pod)
+                placed["spec"]["nodeName"] = self._nodes[node_idx]["metadata"]["name"]
+                placed.setdefault("status", {})["phase"] = "Running"
+                self._scheduled.append(placed)
+            else:
+                msg = REASON_TEXT.get(int(reason), "unschedulable")
+                self._unscheduled.append(
+                    UnscheduledPod(
+                        pod=pod,
+                        reason=(
+                            f"failed to schedule pod ({namespace_of(pod)}/{name_of(pod)}): "
+                            f"Unschedulable: 0/{n_total} nodes are available: {msg}"
+                        ),
+                    )
+                )
+
+    def _result(self) -> SimulateResult:
+        by_node = {name_of(n): [] for n in self._nodes}
+        for pod in self._scheduled:
+            by_node[pod["spec"]["nodeName"]].append(deep_copy(pod))
+        statuses = [
+            NodeStatus(node=deep_copy(n), pods=by_node[name_of(n)]) for n in self._nodes
+        ]
+        return SimulateResult(
+            unscheduled_pods=list(self._unscheduled), node_status=statuses
+        )
+
+
+def simulate(
+    cluster: ResourceTypes,
+    apps: Sequence[AppResource] = (),
+    extended_resources: Sequence[str] = (),
+) -> SimulateResult:
+    """One-shot simulation (`pkg/simulator/core.go:64-103`): expand cluster
+    workloads, run the cluster, then schedule each app in configured order.
+    Unscheduled pods accumulate across the cluster and every app; node status
+    reflects the final cluster."""
+    sim = Simulator(extra_resources=extended_resources)
+    cluster = ResourceTypes(**{k: list(v) for k, v in vars(cluster).items()})
+    cluster_pods = get_valid_pods_exclude_daemonset(cluster)
+    for ds in cluster.daemon_sets:
+        cluster_pods.extend(make_valid_pods_by_daemonset(ds, cluster.nodes))
+    cluster.pods = cluster_pods
+    try:
+        result = sim.run_cluster(cluster)
+        for app in apps:
+            result = sim.schedule_app(app)
+        return result
+    finally:
+        sim.close()

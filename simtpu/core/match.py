@@ -21,7 +21,6 @@ from .objects import (
     pod_node_selector,
     pod_tolerations,
 )
-from .quantity import parse_quantity
 
 # NodeSelectorRequirement operators (k8s core/v1 types)
 OP_IN = "In"

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import copy
 from dataclasses import dataclass, field
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List
 
 from .quantity import parse_quantity
 
@@ -139,7 +139,8 @@ def pod_requests(pod: dict) -> Dict[str, float]:
                 totals[k] = v
     for k, v in (pod_spec(pod).get("overhead") or {}).items():
         totals[k] = totals.get(k, 0.0) + parse_quantity(v)
-    return {k: v for k, v in totals.items() if v > 0}
+    # keep negatives so validation can reject malformed manifests
+    return {k: v for k, v in totals.items() if v != 0}
 
 
 def pod_host_ports(pod: dict) -> List[tuple]:

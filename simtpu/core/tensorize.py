@@ -1,0 +1,632 @@
+"""Cluster + pod tensorization: k8s objects → dense arrays.
+
+This module is the boundary between the host-side object world and the TPU
+engine. It lowers the state the vendored scheduler keeps in caches and
+informers (`vendor/.../scheduler/internal/cache/cache.go:57`, snapshot per
+cycle) into:
+
+- per-node resource arrays `alloc[N, R]`,
+- per-topology-key domain ids `node_dom[K, N]`,
+- a *pod group* axis G (pods with identical scheduling-relevant specs share a
+  group), with a precomputed static feasibility mask `static_mask[G, N]`
+  covering the stateless filter plugins (NodeUnschedulable, NodeName,
+  TaintToleration, NodeAffinity/selector — `vendor/.../algorithmprovider/
+  registry.go:75-145`), plus static per-group score terms, and
+- an inter-pod affinity *term universe* T with the group↔term incidence
+  matrices the scan-time InterPodAffinity kernels consume
+  (`vendor/.../framework/plugins/interpodaffinity/filtering.go` semantics).
+
+Node labels/taints never change during a simulation (nodes are pure data,
+`SURVEY.md §4`), so everything that depends only on (pod spec, node spec) is
+evaluated here once, vectorized over nodes in numpy; only state that evolves
+with placements (free resources, topology counts, storage, GPU devices) lives
+in the scan carry (engine/state.py).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import constants as C
+from .match import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    match_label_selector,
+    toleration_tolerates_taint,
+)
+from .objects import (
+    labels_of,
+    name_of,
+    namespace_of,
+    node_allocatable,
+    node_taints,
+    node_unschedulable,
+    pod_affinity,
+    pod_host_ports,
+    pod_node_name,
+    pod_node_selector,
+    pod_requests,
+    pod_tolerations,
+)
+from .vocab import Interner
+
+# Canonical resource order; extended resources appended dynamically.
+RES_CPU = 0
+RES_MEMORY = 1
+RES_PODS = 2
+_BASE_RESOURCES = ("cpu", "memory", "pods")
+
+# Synthetic taint for unschedulable nodes (NodeUnschedulable plugin semantics:
+# `vendor/.../plugins/nodeunschedulable/node_unschedulable.go`).
+_UNSCHEDULABLE_TAINT = {"key": "node.kubernetes.io/unschedulable", "effect": "NoSchedule"}
+
+
+# ---------------------------------------------------------------------------
+# Node-side vectorized label algebra
+# ---------------------------------------------------------------------------
+
+
+class NodeLabelIndex:
+    """Boolean-column view of node labels for vectorized selector evaluation."""
+
+    def __init__(self, nodes: Sequence[dict]):
+        self.n = len(nodes)
+        self.names = np.array([name_of(n) for n in nodes])
+        self._kv: Dict[Tuple[str, str], np.ndarray] = {}
+        self._key: Dict[str, np.ndarray] = {}
+        self._val: Dict[str, np.ndarray] = {}  # raw values per key (for Gt/Lt)
+        for i, node in enumerate(nodes):
+            for k, v in labels_of(node).items():
+                v = "" if v is None else str(v)
+                self._kv.setdefault((k, v), np.zeros(self.n, bool))[i] = True
+                self._key.setdefault(k, np.zeros(self.n, bool))[i] = True
+                self._val.setdefault(k, np.full(self.n, "", object))[i] = v
+
+    def has_kv(self, key: str, value: str) -> np.ndarray:
+        arr = self._kv.get((key, value))
+        return arr if arr is not None else np.zeros(self.n, bool)
+
+    def has_key(self, key: str) -> np.ndarray:
+        arr = self._key.get(key)
+        return arr if arr is not None else np.zeros(self.n, bool)
+
+    def match_requirement(self, req: dict, field_names: Optional[np.ndarray] = None) -> np.ndarray:
+        """Vectorized NodeSelectorRequirement over all nodes.
+
+        field_names switches evaluation to matchFields (metadata.name).
+        Semantics mirror match.match_requirement / apimachinery selector.go.
+        """
+        key = req.get("key", "")
+        op = req.get("operator", "")
+        vals = req.get("values") or []
+        if field_names is not None:
+            # only metadata.name is a legal field key
+            if key != "metadata.name":
+                return np.zeros(self.n, bool)
+            present = np.ones(self.n, bool)
+            member = np.isin(field_names, vals)
+            if op == OP_IN:
+                return member
+            if op == OP_NOT_IN:
+                return ~member
+            if op == OP_EXISTS:
+                return present
+            if op == OP_DOES_NOT_EXIST:
+                return ~present
+            return np.zeros(self.n, bool)
+        present = self.has_key(key)
+        if op == OP_IN:
+            out = np.zeros(self.n, bool)
+            for v in vals:
+                out |= self.has_kv(key, v)
+            return out
+        if op == OP_NOT_IN:
+            out = np.zeros(self.n, bool)
+            for v in vals:
+                out |= self.has_kv(key, v)
+            return ~out
+        if op == OP_EXISTS:
+            return present
+        if op == OP_DOES_NOT_EXIST:
+            return ~present
+        if op in (OP_GT, OP_LT):
+            if not vals:
+                return np.zeros(self.n, bool)
+            try:
+                rhs = int(vals[0])
+            except ValueError:
+                return np.zeros(self.n, bool)
+            out = np.zeros(self.n, bool)
+            raw = self._val.get(key)
+            if raw is None:
+                return out
+            for i in range(self.n):
+                if present[i]:
+                    try:
+                        lhs = int(raw[i])
+                    except (ValueError, TypeError):
+                        continue
+                    out[i] = lhs > rhs if op == OP_GT else lhs < rhs
+            return out
+        return np.zeros(self.n, bool)
+
+    def match_term(self, term: dict) -> np.ndarray:
+        """One NodeSelectorTerm over all nodes (AND of expressions+fields)."""
+        exprs = term.get("matchExpressions") or []
+        fields = term.get("matchFields") or []
+        if not exprs and not fields:
+            return np.zeros(self.n, bool)
+        out = np.ones(self.n, bool)
+        for req in exprs:
+            out &= self.match_requirement(req)
+        for req in fields:
+            out &= self.match_requirement(req, field_names=self.names)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Group signatures & pin extraction
+# ---------------------------------------------------------------------------
+
+
+def _canon(obj) -> str:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+def _extract_pin(node_affinity_required: Optional[dict]) -> Tuple[Optional[str], Optional[dict]]:
+    """Detect a DaemonSet-style metadata.name pin common to every term.
+
+    DaemonSet pods are pinned per node (`pkg/utils/utils.go:861-906`), which
+    would otherwise explode the group axis to one group per node. If every
+    required term carries the same single `metadata.name In [x]` matchFields
+    requirement, return (x, affinity-with-fields-stripped).
+    """
+    if not node_affinity_required:
+        return None, node_affinity_required
+    terms = node_affinity_required.get("nodeSelectorTerms") or []
+    if not terms:
+        return None, node_affinity_required
+    pin = None
+    stripped_terms = []
+    for term in terms:
+        fields = term.get("matchFields") or []
+        if (
+            len(fields) != 1
+            or fields[0].get("key") != "metadata.name"
+            or fields[0].get("operator") != OP_IN
+            or len(fields[0].get("values") or []) != 1
+        ):
+            return None, node_affinity_required
+        value = fields[0]["values"][0]
+        if pin is None:
+            pin = value
+        elif pin != value:
+            return None, node_affinity_required
+        t = {k: v for k, v in term.items() if k != "matchFields"}
+        stripped_terms.append(t)
+    # if stripping fields left a term empty, the term was pure pin → drop it;
+    # if no terms remain, the whole required clause was the pin
+    stripped_terms = [t for t in stripped_terms if t.get("matchExpressions")]
+    stripped = {"nodeSelectorTerms": stripped_terms} if stripped_terms else None
+    return pin, stripped
+
+
+@dataclass
+class PodGroup:
+    """One equivalence class of pods (identical scheduling-relevant spec)."""
+
+    node_selector: dict
+    affinity_required: Optional[dict]  # pin-stripped node affinity required
+    affinity_preferred: list
+    tolerations: list
+    labels: Dict[str, str]
+    namespace: str
+    pod_affinity: dict  # podAffinity sub-dict
+    pod_anti_affinity: dict
+
+    def signature(self) -> str:
+        return _canon(
+            [
+                self.node_selector,
+                self.affinity_required,
+                self.affinity_preferred,
+                self.tolerations,
+                sorted(self.labels.items()),
+                self.namespace,
+                self.pod_affinity,
+                self.pod_anti_affinity,
+            ]
+        )
+
+
+def _group_of_pod(pod: dict) -> Tuple[PodGroup, Optional[str]]:
+    aff = pod_affinity(pod)
+    node_aff = aff.get("nodeAffinity") or {}
+    pin, stripped_required = _extract_pin(
+        node_aff.get("requiredDuringSchedulingIgnoredDuringExecution")
+    )
+    return (
+        PodGroup(
+            node_selector=pod_node_selector(pod),
+            affinity_required=stripped_required,
+            affinity_preferred=node_aff.get("preferredDuringSchedulingIgnoredDuringExecution")
+            or [],
+            tolerations=pod_tolerations(pod),
+            labels=labels_of(pod),
+            namespace=namespace_of(pod),
+            pod_affinity=aff.get("podAffinity") or {},
+            pod_anti_affinity=aff.get("podAntiAffinity") or {},
+        ),
+        pin,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Inter-pod affinity term universe
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Term:
+    topology_key: str
+    namespaces: Tuple[str, ...]
+    selector_json: str  # canonical labelSelector
+
+    @property
+    def selector(self) -> dict:
+        return json.loads(self.selector_json)
+
+
+def _terms_of(spec_terms: list, default_ns: str) -> List[Tuple[Term, float]]:
+    """PodAffinityTerm list → [(Term, weight)] with weight 1 for required."""
+    out = []
+    for item in spec_terms or []:
+        if "podAffinityTerm" in item:  # weighted form
+            weight = float(item.get("weight", 0))
+            term = item["podAffinityTerm"]
+        else:
+            weight = 1.0
+            term = item
+        ns = tuple(sorted(term.get("namespaces") or [default_ns]))
+        sel = term.get("labelSelector")
+        out.append(
+            (
+                Term(
+                    topology_key=term.get("topologyKey", ""),
+                    namespaces=ns,
+                    selector_json=_canon(sel),
+                ),
+                weight,
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The tensorized cluster
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ClusterTensors:
+    """Everything static the engine needs, as numpy arrays (host-side)."""
+
+    node_names: List[str]
+    resource_names: List[str]
+    alloc: np.ndarray  # [N, R] f32
+    node_dom: np.ndarray  # [K, N] i32 global domain id, -1 when key absent
+    n_domains: int
+    topo_keys: List[str]
+
+    # group axis
+    groups: List[PodGroup]
+    static_mask: np.ndarray  # [G, N] bool — unschedulable+taints+affinity+selector
+    node_pref_score: np.ndarray  # [G, N] f32 — NodeAffinity preferred raw score
+    taint_intolerable: np.ndarray  # [G, N] f32 — count of intolerable PreferNoSchedule
+
+    # inter-pod term axis
+    terms: List[Term]
+    term_topo_key: np.ndarray  # [T] i32 index into topo_keys
+    s_match: np.ndarray  # [G, T] bool — group's pods match term selector+ns
+    a_aff_req: np.ndarray  # [G, T] bool
+    a_anti_req: np.ndarray  # [G, T] bool
+    w_aff_pref: np.ndarray  # [G, T] f32 (summed weights)
+    w_anti_pref: np.ndarray  # [G, T] f32
+
+    label_index: NodeLabelIndex = field(repr=False, default=None)
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.node_names)
+
+    @property
+    def n_groups(self) -> int:
+        return len(self.groups)
+
+    @property
+    def n_terms(self) -> int:
+        return len(self.terms)
+
+
+@dataclass
+class PodBatch:
+    """Per-pod arrays for one schedulable batch, aligned with `pods`."""
+
+    pods: List[dict]
+    group: np.ndarray  # [P] i32
+    req: np.ndarray  # [P, R] f32 (includes the synthetic `pods`=1 resource)
+    pin: np.ndarray  # [P] i32 node index or -1
+    forced: np.ndarray  # [P] bool — pre-assigned via spec.nodeName
+
+
+class Tensorizer:
+    """Incremental tensorization: one instance per simulation.
+
+    The group/term vocabularies grow as apps are scheduled in sequence
+    (mirroring `sim.ScheduleApp` being called per app, `pkg/simulator/
+    simulator.go:167-184`); node-side arrays are fixed at construction.
+    """
+
+    def __init__(self, nodes: Sequence[dict], extra_resources: Sequence[str] = ()):
+        self.nodes = list(nodes)
+        self.label_index = NodeLabelIndex(self.nodes)
+        self.node_idx = {name: i for i, name in enumerate(self.label_index.names)}
+
+        # resource vocabulary: base + everything any node allocates
+        self.resources = Interner()
+        for r in _BASE_RESOURCES:
+            self.resources.intern(r)
+        for node in self.nodes:
+            for r in node_allocatable(node):
+                self.resources.intern(r)
+        for r in extra_resources:
+            self.resources.intern(r)
+
+        n, r = len(self.nodes), len(self.resources)
+        self.alloc = np.zeros((n, r), np.float32)
+        for i, node in enumerate(self.nodes):
+            for rname, val in node_allocatable(node).items():
+                self.alloc[i, self.resources.intern(rname)] = val
+
+        self.taints: List[List[dict]] = [list(node_taints(nd)) for nd in self.nodes]
+        for i, node in enumerate(self.nodes):
+            if node_unschedulable(node):
+                self.taints[i] = self.taints[i] + [_UNSCHEDULABLE_TAINT]
+
+        # topology keys/domains and the term universe grow lazily
+        self.topo_keys = Interner()
+        self.domains = Interner()  # (key, value) pairs
+        self._node_dom_rows: List[np.ndarray] = []  # [K][N]
+        self.term_interner = Interner()
+        self.terms: List[Term] = []
+        self._term_topo: List[int] = []
+
+        self.groups: List[PodGroup] = []
+        self._group_ids: Dict[str, int] = {}
+        self._static_mask: List[np.ndarray] = []
+        self._node_pref: List[np.ndarray] = []
+        self._taint_intol: List[np.ndarray] = []
+        # group×term incidence, grown row-wise (lists of dict[t]=val)
+        self._s_match: List[Dict[int, bool]] = []
+        self._a_aff: List[Dict[int, bool]] = []
+        self._a_anti: List[Dict[int, bool]] = []
+        self._w_aff: List[Dict[int, float]] = []
+        self._w_anti: List[Dict[int, float]] = []
+
+    # -- topology ----------------------------------------------------------
+
+    def _intern_topo_key(self, key: str) -> int:
+        k = self.topo_keys.get(key)
+        if k >= 0:
+            return k
+        k = self.topo_keys.intern(key)
+        row = np.full(len(self.nodes), -1, np.int32)
+        for i, node in enumerate(self.nodes):
+            val = labels_of(node).get(key)
+            if val is not None:
+                row[i] = self.domains.intern((key, str(val)))
+        self._node_dom_rows.append(row)
+        return k
+
+    def _intern_term(self, term: Term) -> int:
+        t = self.term_interner.get(term)
+        if t >= 0:
+            return t
+        t = self.term_interner.intern(term)
+        self.terms.append(term)
+        self._term_topo.append(self._intern_topo_key(term.topology_key))
+        return t
+
+    # -- groups ------------------------------------------------------------
+
+    def _static_mask_for(self, g: PodGroup) -> np.ndarray:
+        """Stateless filters vectorized over nodes: taints (NoSchedule/
+        NoExecute + unschedulable), nodeSelector, required node affinity."""
+        li = self.label_index
+        mask = np.ones(li.n, bool)
+        # TaintToleration + NodeUnschedulable
+        for i in range(li.n):
+            for taint in self.taints[i]:
+                if taint.get("effect") not in ("NoSchedule", "NoExecute"):
+                    continue
+                if not any(toleration_tolerates_taint(t, taint) for t in g.tolerations):
+                    mask[i] = False
+                    break
+        # nodeSelector: every kv must be a node label
+        for k, v in (g.node_selector or {}).items():
+            mask &= li.has_kv(k, "" if v is None else str(v))
+        # required node affinity: OR over terms
+        if g.affinity_required is not None:
+            terms = g.affinity_required.get("nodeSelectorTerms") or []
+            any_term = np.zeros(li.n, bool)
+            for term in terms:
+                any_term |= li.match_term(term)
+            mask &= any_term
+        return mask
+
+    def _node_pref_for(self, g: PodGroup) -> np.ndarray:
+        """NodeAffinity preferred raw score (sum of matching term weights),
+        mirroring `plugins/nodeaffinity` Score."""
+        score = np.zeros(self.label_index.n, np.float32)
+        for item in g.affinity_preferred:
+            w = float(item.get("weight", 0))
+            pref = item.get("preference") or {}
+            score += w * self.label_index.match_term(pref).astype(np.float32)
+        return score
+
+    def _taint_intol_for(self, g: PodGroup) -> np.ndarray:
+        """Count of PreferNoSchedule taints the group does not tolerate
+        (`plugins/tainttoleration` Score)."""
+        out = np.zeros(self.label_index.n, np.float32)
+        for i in range(self.label_index.n):
+            cnt = 0
+            for taint in self.taints[i]:
+                if taint.get("effect") != "PreferNoSchedule":
+                    continue
+                if not any(toleration_tolerates_taint(t, taint) for t in g.tolerations):
+                    cnt += 1
+            out[i] = cnt
+        return out
+
+    def _intern_group(self, g: PodGroup) -> int:
+        sig = g.signature()
+        gid = self._group_ids.get(sig)
+        if gid is not None:
+            return gid
+        gid = len(self.groups)
+        self._group_ids[sig] = gid
+        self.groups.append(g)
+        self._static_mask.append(self._static_mask_for(g))
+        self._node_pref.append(self._node_pref_for(g))
+        self._taint_intol.append(self._taint_intol_for(g))
+
+        s_match: Dict[int, bool] = {}
+        a_aff: Dict[int, bool] = {}
+        a_anti: Dict[int, bool] = {}
+        w_aff: Dict[int, float] = {}
+        w_anti: Dict[int, float] = {}
+        pa, paa = g.pod_affinity, g.pod_anti_affinity
+        for term, _ in _terms_of(
+            pa.get("requiredDuringSchedulingIgnoredDuringExecution"), g.namespace
+        ):
+            a_aff[self._intern_term(term)] = True
+        for term, _ in _terms_of(
+            paa.get("requiredDuringSchedulingIgnoredDuringExecution"), g.namespace
+        ):
+            a_anti[self._intern_term(term)] = True
+        for term, w in _terms_of(
+            pa.get("preferredDuringSchedulingIgnoredDuringExecution"), g.namespace
+        ):
+            t = self._intern_term(term)
+            w_aff[t] = w_aff.get(t, 0.0) + w
+        for term, w in _terms_of(
+            paa.get("preferredDuringSchedulingIgnoredDuringExecution"), g.namespace
+        ):
+            t = self._intern_term(term)
+            w_anti[t] = w_anti.get(t, 0.0) + w
+        self._s_match.append(s_match)
+        self._a_aff.append(a_aff)
+        self._a_anti.append(a_anti)
+        self._w_aff.append(w_aff)
+        self._w_anti.append(w_anti)
+        return gid
+
+    def _refresh_s_match(self) -> None:
+        """(Re)evaluate group-labels × term-selector incidence.
+
+        Cheap (G×T host-side selector matches) and done once per batch build so
+        terms interned by later apps see earlier groups too.
+        """
+        for gid, g in enumerate(self.groups):
+            row = self._s_match[gid]
+            for t, term in enumerate(self.terms):
+                if t in row:
+                    continue
+                ns_ok = g.namespace in term.namespaces
+                sel = term.selector
+                row[t] = bool(ns_ok and sel is not None and match_label_selector(sel, g.labels))
+
+    # -- batches -----------------------------------------------------------
+
+    def add_pods(self, pods: Sequence[dict]) -> PodBatch:
+        """Intern a batch of pods, growing group/term vocabularies."""
+        p = len(pods)
+        group = np.zeros(p, np.int32)
+        pin = np.full(p, -1, np.int32)
+        forced = np.zeros(p, bool)
+        reqs: List[Dict[str, float]] = []
+        for i, pod in enumerate(pods):
+            g, pin_name = _group_of_pod(pod)
+            group[i] = self._intern_group(g)
+            node_name = pod_node_name(pod)
+            if node_name:
+                pin[i] = self.node_idx.get(node_name, -1)
+                forced[i] = True
+            elif pin_name is not None:
+                pin[i] = self.node_idx.get(pin_name, -1)
+            reqs.append(pod_requests(pod))
+        self._refresh_s_match()
+        req = np.zeros((p, len(self.resources)), np.float32)
+        for i, r in enumerate(reqs):
+            req[i, RES_PODS] = 1.0
+            for rname, val in r.items():
+                ridx = self.resources.get(rname)
+                if ridx >= 0:
+                    req[i, ridx] = val
+                # a resource no node allocates can never fit; map it to the
+                # `pods` column? no — grow the vocabulary so fit fails cleanly
+                else:
+                    ridx = self.resources.intern(rname)
+                    self.alloc = np.pad(self.alloc, ((0, 0), (0, 1)))
+                    req = np.pad(req, ((0, 0), (0, 1)))
+                    req[i, ridx] = val
+        return PodBatch(pods=list(pods), group=group, req=req, pin=pin, forced=forced)
+
+    def freeze(self) -> ClusterTensors:
+        """Materialize the dense arrays for the current vocabularies."""
+        n, g_n, t_n = len(self.nodes), len(self.groups), len(self.terms)
+
+        def dense(rows: List[Dict[int, float]], dtype) -> np.ndarray:
+            out = np.zeros((g_n, t_n), dtype)
+            for gi, row in enumerate(rows):
+                for t, v in row.items():
+                    out[gi, t] = v
+            return out
+
+        node_dom = (
+            np.stack(self._node_dom_rows) if self._node_dom_rows else np.zeros((0, n), np.int32)
+        )
+        return ClusterTensors(
+            node_names=list(self.label_index.names),
+            resource_names=[str(r) for r in self.resources.items()],
+            alloc=self.alloc.copy(),
+            node_dom=node_dom,
+            n_domains=max(len(self.domains), 1),
+            topo_keys=[str(k) for k in self.topo_keys.items()],
+            groups=list(self.groups),
+            static_mask=(
+                np.stack(self._static_mask) if g_n else np.zeros((0, n), bool)
+            ),
+            node_pref_score=(
+                np.stack(self._node_pref) if g_n else np.zeros((0, n), np.float32)
+            ),
+            taint_intolerable=(
+                np.stack(self._taint_intol) if g_n else np.zeros((0, n), np.float32)
+            ),
+            terms=list(self.terms),
+            term_topo_key=np.asarray(self._term_topo, np.int32),
+            s_match=dense(self._s_match, bool),
+            a_aff_req=dense(self._a_aff, bool),
+            a_anti_req=dense(self._a_anti, bool),
+            w_aff_pref=dense(self._w_aff, np.float32),
+            w_anti_pref=dense(self._w_anti, np.float32),
+            label_index=self.label_index,
+        )

@@ -1,0 +1,228 @@
+"""The placement engine: sequential-equivalent scheduling as `lax.scan`.
+
+Replaces the reference's pod-at-a-time handshake — fake-client Create →
+channel block → scheduler goroutine filter/score over all nodes → bind event
+(`pkg/simulator/simulator.go:219-244,334-353`; hot loop
+`vendor/.../core/generic_scheduler.go:131-341,470`) — with one compiled scan:
+each scan step is a full scheduling cycle (filter → score → select → state
+update) over the whole node axis at once. Pods are strictly ordered like the
+reference's serial loop, so placement semantics are sequential-equivalent.
+
+Tie-breaking: the reference picks a random node among max scorers
+(`generic_scheduler.go:188-209` reservoir sample); we take the lowest index —
+deterministic, and placement-set-equivalent for conformance purposes
+(SURVEY.md §7 'hard parts').
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensorize import ClusterTensors, PodBatch
+from ..kernels.filters import interpod_filter, resources_fit
+from ..kernels.scores import (
+    balanced_allocation,
+    interpod_score,
+    least_allocated,
+    maxabs_normalize,
+    minmax_normalize,
+    simon_share,
+    taint_toleration_score,
+)
+from .state import SchedState, build_state
+
+# Failure-reason codes (host maps to messages mirroring the scheduler's
+# "0/N nodes are available: ..." status strings, scheduler.go:500)
+OK = 0
+FAIL_STATIC = 1  # affinity / selector / taints / pin — no node passed
+FAIL_RESOURCES = 2  # insufficient free resources on every remaining node
+FAIL_INTERPOD = 3  # inter-pod (anti-)affinity rules
+FAIL_NO_NODE = 4  # forced pod names an unknown node
+
+REASON_TEXT = {
+    FAIL_STATIC: "node(s) didn't match node selector/affinity or had untolerated taints",
+    FAIL_RESOURCES: "insufficient cpu/memory/extended resources on every feasible node",
+    FAIL_INTERPOD: "node(s) didn't satisfy inter-pod affinity/anti-affinity rules",
+    FAIL_NO_NODE: "pod references a node that does not exist",
+}
+
+
+class StaticArrays(NamedTuple):
+    """Per-simulation constants handed to the jitted scan."""
+
+    alloc: jnp.ndarray  # [N, R]
+    static_mask: jnp.ndarray  # [G, N]
+    node_pref: jnp.ndarray  # [G, N]
+    taint_intol: jnp.ndarray  # [G, N]
+    node_dom: jnp.ndarray  # [K, N]
+    term_topo: jnp.ndarray  # [T]
+    s_match: jnp.ndarray  # [G, T]
+    a_aff_req: jnp.ndarray  # [G, T]
+    a_anti_req: jnp.ndarray  # [G, T]
+    w_aff_pref: jnp.ndarray  # [G, T]
+    w_anti_pref: jnp.ndarray  # [G, T]
+
+
+def statics_from(tensors: ClusterTensors) -> StaticArrays:
+    return StaticArrays(
+        alloc=jnp.asarray(tensors.alloc, jnp.float32),
+        static_mask=jnp.asarray(tensors.static_mask),
+        node_pref=jnp.asarray(tensors.node_pref_score),
+        taint_intol=jnp.asarray(tensors.taint_intolerable),
+        node_dom=jnp.asarray(tensors.node_dom, jnp.int32),
+        term_topo=jnp.asarray(tensors.term_topo_key, jnp.int32),
+        s_match=jnp.asarray(tensors.s_match),
+        a_aff_req=jnp.asarray(tensors.a_aff_req),
+        a_anti_req=jnp.asarray(tensors.a_anti_req),
+        w_aff_pref=jnp.asarray(tensors.w_aff_pref),
+        w_anti_pref=jnp.asarray(tensors.w_anti_pref),
+    )
+
+
+def schedule_step(
+    statics: StaticArrays, state: SchedState, pod
+) -> Tuple[SchedState, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """One scheduling cycle for one pod against every node."""
+    g, req, pin, forced = pod
+    n = statics.alloc.shape[0]
+    node_ids = jnp.arange(n)
+
+    static_m = statics.static_mask[g]
+    pin_m = jnp.where(pin >= 0, node_ids == pin, True)
+    m_static = static_m & pin_m
+    m_res = m_static & resources_fit(state.free, req)
+    m_all = m_res & interpod_filter(
+        state.cnt_match,
+        state.cnt_own_anti,
+        statics.node_dom,
+        statics.term_topo,
+        statics.s_match[g],
+        statics.a_aff_req[g],
+        statics.a_anti_req[g],
+    )
+    feasible = jnp.any(m_all)
+
+    # -- scores (weights: registry.go:101-145 + Simon extension) ----------
+    score = least_allocated(state.free, statics.alloc, req)
+    score += balanced_allocation(state.free, statics.alloc, req)
+    score += minmax_normalize(simon_share(statics.alloc, req), m_all)
+    score += minmax_normalize(statics.node_pref[g], m_all)
+    score += taint_toleration_score(statics.taint_intol[g], m_all)
+    raw_ipa = interpod_score(
+        state.cnt_match,
+        state.cnt_own_aff,
+        state.w_own_aff_pref,
+        state.w_own_anti_pref,
+        statics.node_dom,
+        statics.term_topo,
+        statics.s_match[g],
+        statics.w_aff_pref[g],
+        statics.w_anti_pref[g],
+    )
+    score += maxabs_normalize(raw_ipa, m_all)
+    score = jnp.where(m_all, score, -jnp.inf)
+
+    chosen = jnp.where(forced, pin, jnp.argmax(score).astype(jnp.int32))
+    placed = jnp.where(forced, pin >= 0, feasible)
+    reason = jnp.where(
+        placed,
+        OK,
+        jnp.where(
+            forced,
+            FAIL_NO_NODE,
+            jnp.where(
+                ~jnp.any(m_static),
+                FAIL_STATIC,
+                jnp.where(~jnp.any(m_res), FAIL_RESOURCES, FAIL_INTERPOD),
+            ),
+        ),
+    ).astype(jnp.int32)
+
+    # -- state update (no-op when not placed) -----------------------------
+    safe = jnp.clip(chosen, 0)
+    w = jnp.where(placed, 1.0, 0.0)
+    free = state.free.at[safe].add(-req * w)
+
+    t_count = statics.term_topo.shape[0]
+    if t_count:
+        dom_t = statics.node_dom[statics.term_topo, safe]  # [T]
+        valid = (dom_t >= 0) & placed
+        dsafe = jnp.where(dom_t >= 0, dom_t, 0)
+        t_idx = jnp.arange(t_count)
+        vw = jnp.where(valid, 1.0, 0.0)
+
+        def bump(arr, vals):
+            return arr.at[t_idx, dsafe].add(vals * vw)
+
+        new_state = SchedState(
+            free=free,
+            cnt_match=bump(state.cnt_match, statics.s_match[g]),
+            cnt_own_anti=bump(state.cnt_own_anti, statics.a_anti_req[g]),
+            cnt_own_aff=bump(state.cnt_own_aff, statics.a_aff_req[g]),
+            w_own_aff_pref=bump(state.w_own_aff_pref, statics.w_aff_pref[g]),
+            w_own_anti_pref=bump(state.w_own_anti_pref, statics.w_anti_pref[g]),
+        )
+    else:
+        new_state = state._replace(free=free)
+
+    out_node = jnp.where(placed, chosen, -1)
+    return new_state, (out_node, reason)
+
+
+@partial(jax.jit, static_argnums=(), donate_argnums=(1,))
+def _run_scan(statics: StaticArrays, state: SchedState, pods):
+    return jax.lax.scan(partial(schedule_step, statics), state, pods)
+
+
+class Engine:
+    """Host-side driver: threads the placement log across app batches.
+
+    One Engine per simulation (the reference builds a fresh Simulator per
+    `Simulate` call, `pkg/simulator/core.go:64-70`).
+    """
+
+    def __init__(self, tensorizer):
+        self.tensorizer = tensorizer
+        self.placed_group: List[int] = []
+        self.placed_node: List[int] = []
+        self.placed_req: List[np.ndarray] = []
+
+    def place(self, batch: PodBatch) -> Tuple[np.ndarray, np.ndarray]:
+        """Schedule one batch; returns (node index per pod [-1 = failed],
+        reason codes)."""
+        tensors = self.tensorizer.freeze()
+        r = tensors.alloc.shape[1]
+        req = batch.req
+        if req.shape[1] < r:
+            req = np.pad(req, ((0, 0), (0, r - req.shape[1])))
+        state = build_state(
+            tensors,
+            np.asarray(self.placed_group, np.int32),
+            np.asarray(self.placed_node, np.int32),
+            (
+                np.stack([np.pad(q, (0, r - q.shape[0])) for q in self.placed_req])
+                if self.placed_req
+                else np.zeros((0, r), np.float32)
+            ),
+        )
+        statics = statics_from(tensors)
+        pods = (
+            jnp.asarray(batch.group),
+            jnp.asarray(req, jnp.float32),
+            jnp.asarray(batch.pin, jnp.int32),
+            jnp.asarray(batch.forced),
+        )
+        _, (nodes, reasons) = _run_scan(statics, state, pods)
+        nodes = np.asarray(nodes)
+        reasons = np.asarray(reasons)
+        for i in range(len(nodes)):
+            if nodes[i] >= 0:
+                self.placed_group.append(int(batch.group[i]))
+                self.placed_node.append(int(nodes[i]))
+                self.placed_req.append(req[i])
+        return nodes, reasons

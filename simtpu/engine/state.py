@@ -1,0 +1,96 @@
+"""Functional scheduling state — the scan carry.
+
+Replaces the reference's mutable scheduler cache + assume-cache + node
+annotations (`vendor/.../scheduler/internal/cache/cache.go:57`,
+`pkg/simulator/plugin/open-local.go:174-253`) with a pytree of dense arrays
+threaded through `lax.scan`. No locks, no event bus: every placement is a pure
+state transition (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class SchedState(NamedTuple):
+    """Mutable-under-scan cluster state.
+
+    free:            [N, R] remaining allocatable per node
+    cnt_match:       [T, D] placed pods matching term t's selector+ns, per domain
+    cnt_own_anti:    [T, D] placed pods owning required anti-affinity term t
+    cnt_own_aff:     [T, D] placed pods owning required affinity term t
+    w_own_aff_pref:  [T, D] summed preferred-affinity weights of placed owners
+    w_own_anti_pref: [T, D] summed preferred-anti-affinity weights
+    """
+
+    free: jnp.ndarray
+    cnt_match: jnp.ndarray
+    cnt_own_anti: jnp.ndarray
+    cnt_own_aff: jnp.ndarray
+    w_own_aff_pref: jnp.ndarray
+    w_own_anti_pref: jnp.ndarray
+
+
+def init_state(alloc: np.ndarray, n_terms: int, n_domains: int) -> SchedState:
+    t, d = max(n_terms, 0), max(n_domains, 1)
+    zeros = jnp.zeros((t, d), jnp.float32)
+    return SchedState(
+        free=jnp.asarray(alloc, jnp.float32),
+        cnt_match=zeros,
+        cnt_own_anti=zeros,
+        cnt_own_aff=zeros,
+        w_own_aff_pref=zeros,
+        w_own_anti_pref=zeros,
+    )
+
+
+def build_state(
+    tensors, placed_group: np.ndarray, placed_node: np.ndarray, placed_req: np.ndarray
+) -> SchedState:
+    """Reconstruct the full scan carry from the host-side placement log.
+
+    Called at the start of every app batch (group/term vocabularies may have
+    grown since the last batch, so counts are recomputed from scratch — the
+    reference equivalently recounts topology pairs from the live cache every
+    PreFilter, `plugins/interpodaffinity/filtering.go`). O(P·T) numpy work.
+    """
+    n, r = tensors.alloc.shape
+    t, d = tensors.n_terms, tensors.n_domains
+    free = tensors.alloc.astype(np.float32).copy()
+    cnt = np.zeros((5, max(t, 0), d), np.float32)
+    if len(placed_group):
+        req = placed_req
+        if req.shape[1] < r:  # resource vocab grew after this pod was logged
+            req = np.pad(req, ((0, 0), (0, r - req.shape[1])))
+        np.add.at(free, placed_node, -req)
+        if t:
+            # domain of each placement for each term's topology key: [P, T]
+            dom_pt = tensors.node_dom[tensors.term_topo_key][:, placed_node].T
+            valid = dom_pt >= 0
+            incid = np.stack(
+                [
+                    tensors.s_match[placed_group],
+                    tensors.a_anti_req[placed_group],
+                    tensors.a_aff_req[placed_group],
+                    tensors.w_aff_pref[placed_group],
+                    tensors.w_anti_pref[placed_group],
+                ]
+            ).astype(np.float32)  # [5, P, T]
+            t_idx = np.broadcast_to(np.arange(t), dom_pt.shape)
+            for s in range(5):
+                np.add.at(
+                    cnt[s],
+                    (t_idx[valid], dom_pt[valid]),
+                    incid[s][valid],
+                )
+    return SchedState(
+        free=jnp.asarray(free),
+        cnt_match=jnp.asarray(cnt[0]),
+        cnt_own_anti=jnp.asarray(cnt[1]),
+        cnt_own_aff=jnp.asarray(cnt[2]),
+        w_own_aff_pref=jnp.asarray(cnt[3]),
+        w_own_anti_pref=jnp.asarray(cnt[4]),
+    )

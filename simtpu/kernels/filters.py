@@ -1,0 +1,85 @@
+"""Filter kernels: each returns a boolean feasibility mask over all nodes.
+
+One kernel per vendored filter-plugin family (the checklist in SURVEY.md §2.2,
+`vendor/.../scheduler/algorithmprovider/registry.go:75-145`). The reference
+evaluates these per (pod, node) with 16 goroutines
+(`core/generic_scheduler.go:271-341`); here the node axis is a vector lane and
+one call covers every node at once.
+
+Stateless filters (NodeUnschedulable, TaintToleration, NodeAffinity/selector,
+NodeName pinning) are precomputed per pod-group in core/tensorize.py; the
+kernels here are the ones that depend on mutable scan state.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Relative slack for float32 resource comparisons; the reference compares exact
+# integer milli-quantities, so allow only rounding-level drift.
+_RES_EPS = 1e-5
+
+
+def resources_fit(free: jnp.ndarray, req: jnp.ndarray) -> jnp.ndarray:
+    """NodeResourcesFit: every requested resource fits in the node's free
+    allocatable (incl. the synthetic `pods` count resource).
+
+    free: [N, R], req: [R] → mask [N].
+    Mirrors `plugins/noderesources/fit.go` fitsRequest.
+    """
+    slack = _RES_EPS * jnp.maximum(jnp.abs(free), 1.0)
+    return jnp.all(free + slack >= req, axis=-1)
+
+
+def interpod_filter(
+    cnt_match: jnp.ndarray,  # [T, D] placed pods matching term selector+ns
+    cnt_own_anti: jnp.ndarray,  # [T, D] placed pods owning required anti term
+    node_dom: jnp.ndarray,  # [K, N] global domain id per topo key (-1 absent)
+    term_topo: jnp.ndarray,  # [T] topo-key index per term
+    s_match: jnp.ndarray,  # [T] incoming pod matches term selector+ns
+    a_aff: jnp.ndarray,  # [T] incoming pod requires affinity term t
+    a_anti: jnp.ndarray,  # [T] incoming pod requires anti-affinity term t
+) -> jnp.ndarray:
+    """InterPodAffinity filter over all nodes.
+
+    Mirrors `plugins/interpodaffinity/filtering.go`:
+    - satisfyPodAffinity: every required affinity term must have ≥1 matching
+      placed pod in the node's domain (node must carry the topology key); if no
+      matching pod exists cluster-wide for any term and the pod matches its own
+      terms, it may pass anywhere.
+    - satisfyPodAntiAffinity: no required anti-affinity term of the incoming
+      pod may have a matching placed pod in the node's domain.
+    - satisfyExistingPodsAntiAffinity: no placed pod owning a required
+      anti-affinity term that matches the incoming pod may share its domain.
+    Returns mask [N].
+    """
+    t_count, _ = cnt_match.shape
+    if t_count == 0:
+        return jnp.ones(node_dom.shape[-1] if node_dom.ndim else 0, bool)
+
+    dom_tn = node_dom[term_topo]  # [T, N] domain id of each node for each term's key
+    valid = dom_tn >= 0
+    safe = jnp.where(valid, dom_tn, 0)
+    t_idx = jnp.arange(t_count)[:, None]
+    match_at = jnp.where(valid, cnt_match[t_idx, safe], 0.0)  # [T, N]
+    own_anti_at = jnp.where(valid, cnt_own_anti[t_idx, safe], 0.0)
+
+    # anti-affinity: incoming pod's terms
+    anti_violated = jnp.any(a_anti[:, None] & (match_at > 0), axis=0)  # [N]
+    # symmetry: existing pods' anti terms that select the incoming pod
+    sym_violated = jnp.any(s_match[:, None] & (own_anti_at > 0), axis=0)
+
+    # affinity: every required term satisfied in-domain (key must exist)
+    aff_term_ok = (~a_aff[:, None]) | (valid & (match_at > 0))  # [T, N]
+    aff_ok = jnp.all(aff_term_ok, axis=0)
+    # first-pod-in-series escape: no matching pod anywhere for any required
+    # term AND the pod matches all its own terms AND node has all topo keys
+    total_match = jnp.sum(jnp.where(a_aff, jnp.sum(cnt_match, axis=1), 0.0))
+    self_ok = (
+        (total_match == 0)
+        & jnp.all(jnp.where(a_aff, s_match, True))
+        & jnp.all((~a_aff[:, None]) | valid, axis=0)
+    )
+    aff_ok = aff_ok | (jnp.any(a_aff) & self_ok)
+
+    return aff_ok & ~anti_violated & ~sym_violated

@@ -1,0 +1,132 @@
+"""Score kernels: each returns a float score vector over all nodes.
+
+One kernel per score plugin active in the reference's profile — the default
+algorithm provider (`vendor/.../algorithmprovider/registry.go:101-145`) plus
+the Simon plugin (`pkg/simulator/plugin/simon.go:44-100`). Weights follow the
+registry: LeastAllocated 1, BalancedAllocation 1, NodeAffinity 1,
+TaintToleration 1, InterPodAffinity 1, Simon 1 (extension scores).
+
+Normalization mirrors each plugin's NormalizeScore; scores are computed over
+the full node axis but normalized over the feasible mask only, exactly like
+`prioritizeNodes` running on the filtered list (`core/generic_scheduler.go:470`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensorize import RES_CPU, RES_MEMORY
+
+MAX_NODE_SCORE = 100.0
+
+
+def minmax_normalize(score: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Min-max to [0, 100] over feasible nodes (SimonPlugin.NormalizeScore,
+    `plugin/simon.go:76-100`; same default for NodeAffinity)."""
+    big = jnp.float32(3.4e38)
+    lo = jnp.min(jnp.where(mask, score, big))
+    hi = jnp.max(jnp.where(mask, score, -big))
+    rng = hi - lo
+    return jnp.where(rng > 0, (score - lo) * MAX_NODE_SCORE / jnp.maximum(rng, 1e-30), 0.0)
+
+
+def maxabs_normalize(score: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Scale by max |score| to [-100, 100] (InterPodAffinity NormalizeScore)."""
+    m = jnp.max(jnp.where(mask, jnp.abs(score), 0.0))
+    return jnp.where(m > 0, score * MAX_NODE_SCORE / jnp.maximum(m, 1e-30), 0.0)
+
+
+def least_allocated(
+    free: jnp.ndarray, alloc: jnp.ndarray, req: jnp.ndarray
+) -> jnp.ndarray:
+    """NodeResourcesLeastAllocated over cpu+memory
+    (`plugins/noderesources/least_allocated.go`): mean of free-fraction × 100
+    after placing the pod."""
+    cols = jnp.array([RES_CPU, RES_MEMORY])
+    fa = free[:, cols] - req[cols]  # [N, 2] free after placement
+    al = alloc[:, cols]
+    frac = jnp.where(al > 0, jnp.clip(fa, 0.0) / jnp.maximum(al, 1e-30), 0.0)
+    return jnp.mean(frac, axis=-1) * MAX_NODE_SCORE
+
+
+def balanced_allocation(
+    free: jnp.ndarray, alloc: jnp.ndarray, req: jnp.ndarray
+) -> jnp.ndarray:
+    """NodeResourcesBalancedAllocation (`plugins/noderesources/
+    balanced_allocation.go`, two-resource form): 100 - |cpuFrac - memFrac|·100."""
+    cols = jnp.array([RES_CPU, RES_MEMORY])
+    used_after = alloc[:, cols] - free[:, cols] + req[cols]
+    frac = jnp.where(
+        alloc[:, cols] > 0, used_after / jnp.maximum(alloc[:, cols], 1e-30), 1.0
+    )
+    return (1.0 - jnp.abs(frac[:, 0] - frac[:, 1])) * MAX_NODE_SCORE
+
+
+def simon_share(alloc: jnp.ndarray, req: jnp.ndarray) -> jnp.ndarray:
+    """Simon plugin raw score (`plugin/simon.go:44-67`): dominant share of the
+    pod request against (static allocatable − request), per node, ×100.
+
+    Uses the *static* allocatable, not remaining free — the fake-client node
+    object never shrinks as pods bind, and the plugin reads it directly.
+    """
+    denom = alloc - req[None, :]  # [N, R]
+    share = jnp.where(
+        denom == 0,
+        jnp.where(req[None, :] == 0, 0.0, 1.0),
+        req[None, :] / jnp.where(denom == 0, 1.0, denom),
+    )
+    # only resources the node allocates participate; Go's `share > res` fold
+    # starts at 0 so negatives never win
+    share = jnp.where(alloc > 0, share, 0.0)
+    return jnp.clip(jnp.max(share, axis=-1), 0.0) * MAX_NODE_SCORE
+
+
+def taint_toleration_score(intolerable_cnt: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """TaintToleration score (`plugins/tainttoleration`): fewer intolerable
+    PreferNoSchedule taints → higher, reverse-normalized to [0, 100]."""
+    hi = jnp.max(jnp.where(mask, intolerable_cnt, 0.0))
+    return jnp.where(
+        hi > 0,
+        MAX_NODE_SCORE * (1.0 - intolerable_cnt / jnp.maximum(hi, 1e-30)),
+        MAX_NODE_SCORE,
+    )
+
+
+def interpod_score(
+    cnt_match: jnp.ndarray,  # [T, D]
+    own_aff_req: jnp.ndarray,  # [T, D] placed owners of required affinity terms
+    w_own_aff_pref: jnp.ndarray,  # [T, D] summed weights of placed owners
+    w_own_anti_pref: jnp.ndarray,  # [T, D]
+    node_dom: jnp.ndarray,  # [K, N]
+    term_topo: jnp.ndarray,  # [T]
+    s_match: jnp.ndarray,  # [T] incoming pod matches term
+    w_aff_pref: jnp.ndarray,  # [T] incoming pod's preferred affinity weights
+    w_anti_pref: jnp.ndarray,  # [T]
+    hard_pod_affinity_weight: float = 1.0,
+) -> jnp.ndarray:
+    """InterPodAffinity score (`plugins/interpodaffinity/scoring.go`):
+
+    + weight × matching placed pods in domain, for the incoming pod's
+      preferred (anti-)affinity terms, and symmetrically
+    + placed pods' preferred terms (and required affinity terms, scaled by
+      HardPodAffinityWeight=1) that select the incoming pod.
+    Raw, un-normalized; caller applies maxabs_normalize.
+    """
+    t_count = cnt_match.shape[0]
+    if t_count == 0:
+        return jnp.zeros(node_dom.shape[-1] if node_dom.ndim else 0, jnp.float32)
+    dom_tn = node_dom[term_topo]  # [T, N]
+    valid = dom_tn >= 0
+    safe = jnp.where(valid, dom_tn, 0)
+    t_idx = jnp.arange(t_count)[:, None]
+
+    def at(counts):
+        return jnp.where(valid, counts[t_idx, safe], 0.0)
+
+    incoming = (w_aff_pref - w_anti_pref)[:, None] * at(cnt_match)
+    symmetric = s_match[:, None] * (
+        at(w_own_aff_pref)
+        - at(w_own_anti_pref)
+        + hard_pod_affinity_weight * at(own_aff_req)
+    )
+    return jnp.sum(incoming + symmetric, axis=0)

@@ -178,18 +178,20 @@ def make_valid_pods_by_job(job: dict) -> List[dict]:
 
 
 def generate_job_from_cron_job(cronjob: dict) -> dict:
-    """CronJob → one manual Job instance (`utils.go:229-241`)."""
+    """CronJob → one Job instance (`utils.go:229-241`).
+
+    Job metadata (incl. annotations) comes from the CronJob's own metadata via
+    SetObjectMetaFromObject — the reference builds an `instantiate=manual`
+    annotation map at `utils.go:230-234` but never attaches it, so we mirror
+    the observable behavior and attach nothing extra.
+    """
     job_template = (cronjob.get("spec") or {}).get("jobTemplate") or {}
-    job = {
+    return {
         "apiVersion": "batch/v1",
         "kind": "Job",
         "metadata": _object_meta_from_owner(cronjob, C.KIND_CRON_JOB, gen_pod=False),
         "spec": deep_copy(job_template.get("spec") or {}),
     }
-    annos = {"cronjob.kubernetes.io/instantiate": "manual"}
-    annos.update((job_template.get("metadata") or {}).get("annotations") or {})
-    ensure_meta(job)["annotations"] = annos
-    return job
 
 
 def make_valid_pods_by_cron_job(cronjob: dict) -> List[dict]:

@@ -1,0 +1,220 @@
+"""Test fixture factory — functional-options builders for k8s objects.
+
+Python port of `pkg/test/*.go` (MakeFakeNode, MakeFakePod, MakeFakeDeployment,
+MakeFakeStatefulSet, MakeFakeDaemonSet, MakeFakeReplicaSet, MakeFakeJob,
+MakeFakeCronJob and their With* options). Builders return plain manifest dicts.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List
+
+
+def _resources(cpu: str, memory: str) -> dict:
+    req = {}
+    if cpu:
+        req["cpu"] = cpu
+    if memory:
+        req["memory"] = memory
+    return {"requests": req} if req else {}
+
+
+def _container(cpu: str, memory: str) -> dict:
+    c = {"name": "container", "image": "nginx"}
+    res = _resources(cpu, memory)
+    if res:
+        c["resources"] = res
+    return c
+
+
+def make_fake_node(name: str, cpu: str, memory: str, *opts: Callable) -> dict:
+    node = {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": {}, "annotations": {}},
+        "spec": {},
+        "status": {
+            "allocatable": {"cpu": cpu, "memory": memory, "pods": "110"},
+            "capacity": {"cpu": cpu, "memory": memory, "pods": "110"},
+        },
+    }
+    for opt in opts:
+        opt(node)
+    return node
+
+
+def with_node_labels(labels: Dict[str, str]) -> Callable:
+    def opt(node):
+        node["metadata"]["labels"].update(labels)
+
+    return opt
+
+
+def with_node_taints(taints: List[dict]) -> Callable:
+    def opt(node):
+        node["spec"]["taints"] = taints
+
+    return opt
+
+
+def with_node_local_storage(storage: dict) -> Callable:
+    """storage = {"vgs": [...], "devices": [...]} — the reference's
+    utils.NodeStorage JSON (`pkg/test/node.go` WithNodeLocalStorage)."""
+
+    def opt(node):
+        node["metadata"]["annotations"]["simon/node-local-storage"] = json.dumps(storage)
+
+    return opt
+
+
+def with_node_allocatable(resources: Dict[str, str]) -> Callable:
+    def opt(node):
+        node["status"]["allocatable"].update(resources)
+        node["status"]["capacity"].update(resources)
+
+    return opt
+
+
+def make_fake_pod(name: str, namespace: str, cpu: str, memory: str, *opts: Callable) -> dict:
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"containers": [_container(cpu, memory)]},
+    }
+    for opt in opts:
+        opt(pod)
+    return pod
+
+
+def with_pod_node_name(node_name: str) -> Callable:
+    def opt(pod):
+        pod["spec"]["nodeName"] = node_name
+
+    return opt
+
+
+def with_pod_labels(labels: Dict[str, str]) -> Callable:
+    def opt(pod):
+        pod["metadata"]["labels"] = labels
+
+    return opt
+
+
+def with_pod_annotations(annotations: Dict[str, str]) -> Callable:
+    def opt(pod):
+        pod["metadata"]["annotations"] = annotations
+
+    return opt
+
+
+def with_pod_tolerations(tolerations: List[dict]) -> Callable:
+    def opt(pod):
+        pod["spec"]["tolerations"] = tolerations
+
+    return opt
+
+
+def with_pod_node_selector(selector: Dict[str, str]) -> Callable:
+    def opt(pod):
+        pod["spec"]["nodeSelector"] = selector
+
+    return opt
+
+
+def with_pod_affinity(affinity: dict) -> Callable:
+    def opt(pod):
+        pod["spec"]["affinity"] = affinity
+
+    return opt
+
+
+def _workload(kind: str, name: str, namespace: str, cpu: str, memory: str) -> dict:
+    return {
+        "apiVersion": "apps/v1",
+        "kind": kind,
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"template": {"spec": {"containers": [_container(cpu, memory)]}}},
+    }
+
+
+def _template_opt(setter: Callable[[dict], None]) -> Callable:
+    def opt(obj):
+        setter(obj["spec"]["template"]["spec"])
+
+    return opt
+
+
+def make_fake_deployment(name, namespace, replicas, cpu, memory, *opts) -> dict:
+    d = _workload("Deployment", name, namespace, cpu, memory)
+    d["spec"]["replicas"] = replicas
+    for opt in opts:
+        opt(d)
+    return d
+
+
+def make_fake_replica_set(name, namespace, replicas, cpu, memory, *opts) -> dict:
+    rs = _workload("ReplicaSet", name, namespace, cpu, memory)
+    rs["spec"]["replicas"] = replicas
+    for opt in opts:
+        opt(rs)
+    return rs
+
+
+def make_fake_stateful_set(name, namespace, replicas, cpu, memory, *opts) -> dict:
+    sts = _workload("StatefulSet", name, namespace, cpu, memory)
+    sts["spec"]["replicas"] = replicas
+    for opt in opts:
+        opt(sts)
+    return sts
+
+
+def make_fake_daemon_set(name, namespace, cpu, memory, *opts) -> dict:
+    ds = _workload("DaemonSet", name, namespace, cpu, memory)
+    for opt in opts:
+        opt(ds)
+    return ds
+
+
+def make_fake_job(name, namespace, completions, cpu, memory, *opts) -> dict:
+    job = _workload("Job", name, namespace, cpu, memory)
+    job["apiVersion"] = "batch/v1"
+    job["kind"] = "Job"
+    job["spec"]["completions"] = completions
+    for opt in opts:
+        opt(job)
+    return job
+
+
+def make_fake_cron_job(name, namespace, completions, cpu, memory, *opts) -> dict:
+    job = _workload("Job", name, namespace, cpu, memory)
+    cj = {
+        "apiVersion": "batch/v1beta1",
+        "kind": "CronJob",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"schedule": "* * * * *", "jobTemplate": {"spec": job["spec"]}},
+    }
+    for opt in opts:
+        opt(cj)
+    return cj
+
+
+# template-level options shared by workload kinds (mirror With*Tolerations etc.)
+def with_template_tolerations(tolerations: List[dict]) -> Callable:
+    return _template_opt(lambda s: s.update({"tolerations": tolerations}))
+
+
+def with_template_node_selector(selector: Dict[str, str]) -> Callable:
+    return _template_opt(lambda s: s.update({"nodeSelector": selector}))
+
+
+def with_template_affinity(affinity: dict) -> Callable:
+    return _template_opt(lambda s: s.update({"affinity": affinity}))
+
+
+def with_cronjob_template_tolerations(tolerations: List[dict]) -> Callable:
+    def opt(cj):
+        cj["spec"]["jobTemplate"]["spec"]["template"]["spec"]["tolerations"] = tolerations
+
+    return opt

@@ -1,0 +1,520 @@
+"""Conformance test — port of the reference's TestSimulate scenario.
+
+Rebuilds the exact cluster and app from `pkg/simulator/core_test.go:32-362`
+(4 nodes: 3 tainted masters + 1 worker; static pods; metrics-server deployment
+with master affinity + zone anti-affinity; 3 daemonsets; "simple" app with
+deployment/daemonset/job/bare-pod/statefulset/replicaset) and asserts the same
+result contract as `checkResult` (`core_test.go:364-591`): zero unscheduled
+pods, and every workload produced exactly its expected number of placed pods
+(daemonset expectations recomputed per node via NodeShouldRunPod).
+"""
+
+from collections import defaultdict
+
+import pytest
+
+import simtpu.constants as C
+from simtpu import AppResource, ResourceTypes, simulate
+from simtpu.core.match import node_should_run_pod
+from simtpu.core.objects import annotations_of, name_of, namespace_of
+from simtpu.workloads.expand import new_daemon_pod, seed_name_hashes
+
+from .fixtures import (
+    make_fake_daemon_set,
+    make_fake_deployment,
+    make_fake_job,
+    make_fake_node,
+    make_fake_pod,
+    make_fake_replica_set,
+    make_fake_stateful_set,
+    with_node_labels,
+    with_node_local_storage,
+    with_node_taints,
+    with_pod_node_name,
+    with_pod_node_selector,
+    with_pod_tolerations,
+    with_template_affinity,
+    with_template_node_selector,
+    with_template_tolerations,
+)
+
+MASTER_TAINT = [{"key": "node-role.kubernetes.io/master", "effect": "NoSchedule"}]
+MASTER_TOLERATION = [
+    {"key": "node-role.kubernetes.io/master", "operator": "Exists", "effect": "NoSchedule"}
+]
+LOCAL_STORAGE = {
+    "vgs": [
+        {"name": "yoda-pool0", "capacity": 107374182400},
+        {"name": "yoda-pool1", "capacity": 107374182400},
+    ],
+    "devices": [
+        {
+            "name": "/dev/vdd",
+            "device": "/dev/vdd",
+            "capacity": 107374182400,
+            "isAllocated": False,
+            "mediaType": "hdd",
+        }
+    ],
+}
+
+
+def _node_labels(name, role):
+    return {
+        "beta.kubernetes.io/arch": "amd64",
+        "beta.kubernetes.io/os": "linux",
+        "kubernetes.io/arch": "amd64",
+        "kubernetes.io/hostname": name,
+        "kubernetes.io/os": "linux",
+        f"node-role.kubernetes.io/{role}": "",
+    }
+
+
+def build_cluster() -> ResourceTypes:
+    cluster = ResourceTypes()
+    cluster.nodes = [
+        make_fake_node(
+            "master-1",
+            "8",
+            "16Gi",
+            with_node_labels(_node_labels("master-1", "master")),
+            with_node_taints(MASTER_TAINT),
+            with_node_local_storage(LOCAL_STORAGE),
+        ),
+        make_fake_node(
+            "master-2", "8", "16Gi", with_node_labels(_node_labels("master-2", "master"))
+        ),
+        make_fake_node(
+            "master-3", "8", "16Gi", with_node_labels(_node_labels("master-3", "master"))
+        ),
+        make_fake_node(
+            "worker-1",
+            "8",
+            "16Gi",
+            with_node_labels(_node_labels("worker-1", "worker")),
+            with_node_local_storage(LOCAL_STORAGE),
+        ),
+    ]
+    cluster.pods = [
+        make_fake_pod("etcd-master-1", "kube-system", "", "", with_pod_node_name("master-1")),
+        make_fake_pod(
+            "kube-apiserver-master-1", "kube-system", "250m", "", with_pod_node_name("master-1")
+        ),
+        make_fake_pod(
+            "kube-controller-manager-master-1",
+            "kube-system",
+            "200m",
+            "",
+            with_pod_node_name("master-1"),
+        ),
+        make_fake_pod(
+            "kube-scheduler-master-1", "kube-system", "100m", "", with_pod_node_name("master-1")
+        ),
+    ]
+    cluster.deployments = [
+        make_fake_deployment(
+            "metrics-server",
+            "kube-system",
+            1,
+            "1",
+            "500Mi",
+            with_template_affinity(
+                {
+                    "nodeAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": {
+                            "nodeSelectorTerms": [
+                                {
+                                    "matchExpressions": [
+                                        {
+                                            "key": "node-role.kubernetes.io/master",
+                                            "operator": "Exists",
+                                        }
+                                    ]
+                                }
+                            ]
+                        }
+                    },
+                    "podAntiAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {
+                                "labelSelector": {
+                                    "matchLabels": {"k8s-app": "metrics-server"}
+                                },
+                                "topologyKey": "failure-domain.beta.kubernetes.io/zone",
+                            }
+                        ]
+                    },
+                }
+            ),
+        )
+    ]
+    cluster.daemon_sets = [
+        make_fake_daemon_set(
+            "kube-proxy-master",
+            "kube-system",
+            "",
+            "",
+            with_template_tolerations([{"operator": "Exists"}]),
+            with_template_node_selector({"node-role.kubernetes.io/master": ""}),
+        ),
+        make_fake_daemon_set(
+            "kube-proxy-worker",
+            "kube-system",
+            "",
+            "",
+            with_template_tolerations([{"operator": "Exists"}]),
+            with_template_node_selector({"node-role.kubernetes.io/worker": ""}),
+        ),
+        make_fake_daemon_set(
+            "coredns",
+            "kube-system",
+            "100m",
+            "70Mi",
+            with_template_affinity(
+                {
+                    "nodeAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": {
+                            "nodeSelectorTerms": [
+                                {
+                                    "matchExpressions": [
+                                        {
+                                            "key": "node-role.kubernetes.io/master",
+                                            "operator": "Exists",
+                                        }
+                                    ]
+                                }
+                            ]
+                        }
+                    }
+                }
+            ),
+            with_template_tolerations(
+                [{"effect": "NoSchedule", "key": "node-role.kubernetes.io/master"}]
+            ),
+            with_template_node_selector({"beta.kubernetes.io/os": "linux"}),
+        ),
+    ]
+    return cluster
+
+
+def build_simple_app() -> AppResource:
+    res = ResourceTypes()
+    res.deployments = [
+        make_fake_deployment(
+            "busybox-deploy",
+            "simple",
+            4,
+            "1500m",
+            "1Gi",
+            with_template_tolerations(
+                [
+                    {
+                        "effect": "NoSchedule",
+                        "key": "node-role.kubernetes.io/master",
+                        "operator": "Exists",
+                    }
+                ]
+            ),
+        )
+    ]
+    res.daemon_sets = [
+        make_fake_daemon_set(
+            "busybox-ds",
+            "simple",
+            "500m",
+            "512Mi",
+            with_template_node_selector({"beta.kubernetes.io/os": "linux"}),
+            with_template_affinity(
+                {
+                    "nodeAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": {
+                            "nodeSelectorTerms": [
+                                {
+                                    "matchExpressions": [
+                                        {
+                                            "key": "node-role.kubernetes.io/master",
+                                            "operator": "DoesNotExist",
+                                        }
+                                    ]
+                                }
+                            ]
+                        }
+                    }
+                }
+            ),
+        )
+    ]
+    res.jobs = [make_fake_job("pi", "default", 1, "100m", "100Mi")]
+    res.pods = [
+        make_fake_pod(
+            "single-pod",
+            "simple",
+            "100m",
+            "100Mi",
+            with_pod_node_selector({"node-role.kubernetes.io/master": ""}),
+            with_pod_tolerations(
+                [
+                    {
+                        "effect": "NoSchedule",
+                        "key": "node-role.kubernetes.io/master",
+                        "operator": "Exists",
+                    }
+                ]
+            ),
+        )
+    ]
+    res.stateful_sets = [
+        make_fake_stateful_set(
+            "busybox-sts",
+            "simple",
+            4,
+            "1",
+            "512Mi",
+            with_template_tolerations(
+                [
+                    {
+                        "effect": "NoSchedule",
+                        "key": "node-role.kubernetes.io/master",
+                        "operator": "Exists",
+                    }
+                ]
+            ),
+            with_template_affinity(
+                {
+                    "podAntiAffinity": {
+                        "preferredDuringSchedulingIgnoredDuringExecution": [
+                            {
+                                "weight": 100,
+                                "podAffinityTerm": {
+                                    "labelSelector": {
+                                        "matchExpressions": [
+                                            {
+                                                "key": "app",
+                                                "operator": "In",
+                                                "values": ["busybox-sts"],
+                                            }
+                                        ]
+                                    },
+                                    "topologyKey": "kubernetes.io/hostname",
+                                },
+                            }
+                        ]
+                    }
+                }
+            ),
+        )
+    ]
+    res.replica_sets = [
+        make_fake_replica_set(
+            "calico-kube-controllers",
+            "kube-system",
+            2,
+            "",
+            "",
+            with_template_tolerations(
+                [
+                    {"effect": "NoSchedule", "operator": "Exists"},
+                    {"key": "CriticalAddonsOnly", "operator": "Exists"},
+                    {"effect": "NoExecute", "operator": "Exists"},
+                ]
+            ),
+        )
+    ]
+    return AppResource(name="simple", resource=res)
+
+
+def check_result(cluster, apps, result, expect_failed=0):
+    """Port of checkResult (`core_test.go:364-591`)."""
+    assert len(result.unscheduled_pods) == expect_failed, [
+        u.reason for u in result.unscheduled_pods
+    ]
+
+    all_pods = [p for st in result.node_status for p in st.pods]
+    all_pods += [u.pod for u in result.unscheduled_pods]
+
+    expected = {}
+    actual = defaultdict(int)
+
+    def workloads(field, kind, count_of):
+        items = list(getattr(cluster, field))
+        for app in apps:
+            items += getattr(app.resource, field)
+        for item in items:
+            key = (name_of(item), namespace_of(item), kind)
+            expected[key] = count_of(item)
+            actual[key] = 0
+
+    workloads("deployments", "Deployment", lambda d: d["spec"].get("replicas", 1))
+    workloads("replica_sets", "ReplicaSet", lambda r: r["spec"].get("replicas", 1))
+    workloads("stateful_sets", "StatefulSet", lambda s: s["spec"].get("replicas", 1))
+    workloads("jobs", "Job", lambda j: j["spec"].get("completions", 1))
+    workloads(
+        "cron_jobs",
+        "CronJob",
+        lambda c: c["spec"]["jobTemplate"]["spec"].get("completions", 1),
+    )
+
+    nodes = list(cluster.nodes)
+    ds_items = list(cluster.daemon_sets)
+    for app in apps:
+        ds_items += app.resource.daemon_sets
+    for ds in ds_items:
+        key = (name_of(ds), namespace_of(ds), "DaemonSet")
+        expected[key] = sum(
+            1 for node in nodes if node_should_run_pod(node, new_daemon_pod(ds, name_of(node)))
+        )
+        actual[key] = 0
+
+    individual = len(cluster.pods) + sum(len(a.resource.pods) for a in apps)
+    got_individual = 0
+
+    for pod in all_pods:
+        refs = (pod.get("metadata") or {}).get("ownerReferences") or []
+        if not refs:
+            got_individual += 1
+            continue
+        ref = refs[0]
+        ns = namespace_of(pod)
+        kind, rname = ref["kind"], ref["name"]
+        if kind == "ReplicaSet":
+            if (rname, ns, "ReplicaSet") in expected:
+                actual[(rname, ns, "ReplicaSet")] += 1
+            else:  # deployment-owned: strip the hash suffix
+                dname = rname.rsplit("-", 1)[0]
+                actual[(dname, ns, "Deployment")] += 1
+        elif kind == "Job":
+            if (rname, ns, "Job") in expected:
+                actual[(rname, ns, "Job")] += 1
+            else:
+                cname = rname.rsplit("-", 1)[0]
+                actual[(cname, ns, "CronJob")] += 1
+        elif kind in ("StatefulSet", "DaemonSet"):
+            actual[(rname, ns, kind)] += 1
+
+    assert dict(actual) == expected
+    assert got_individual == individual
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    seed_name_hashes(7)
+
+
+class TestSimulate:
+    def test_simple_scenario(self):
+        cluster = build_cluster()
+        apps = [build_simple_app()]
+        result = simulate(cluster, apps)
+        check_result(cluster, apps, result, expect_failed=0)
+
+    def test_pod_placements_respect_constraints(self):
+        cluster = build_cluster()
+        apps = [build_simple_app()]
+        result = simulate(cluster, apps)
+        placements = {}
+        for st in result.node_status:
+            for pod in st.pods:
+                placements[name_of(pod)] = name_of(st.node)
+        # single-pod has a master nodeSelector + toleration
+        assert placements["single-pod"].startswith("master")
+        for st in result.node_status:
+            for pod in st.pods:
+                # busybox-ds is pinned off masters by its DoesNotExist affinity
+                if annotations_of(pod).get(C.ANNO_WORKLOAD_NAME) == "busybox-ds":
+                    assert name_of(st.node) == "worker-1"
+                # pi has no toleration → never on the tainted master-1
+                if annotations_of(pod).get(C.ANNO_WORKLOAD_NAME) == "pi":
+                    assert name_of(st.node) != "master-1"
+
+    def test_sts_preferred_anti_affinity_spreads(self):
+        """A labeled STS with preferred hostname anti-affinity should spread
+        its replicas across distinct nodes when capacity allows."""
+        cluster = ResourceTypes()
+        cluster.nodes = [
+            make_fake_node(f"n{i}", "8", "16Gi", with_node_labels(_node_labels(f"n{i}", "worker")))
+            for i in range(4)
+        ]
+        sts = make_fake_stateful_set("web", "default", 4, "500m", "256Mi")
+        sts["metadata"]["labels"] = {"app": "web"}
+        sts["spec"]["template"]["spec"]["affinity"] = {
+            "podAntiAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "weight": 100,
+                        "podAffinityTerm": {
+                            "labelSelector": {"matchLabels": {"app": "web"}},
+                            "topologyKey": "kubernetes.io/hostname",
+                        },
+                    }
+                ]
+            }
+        }
+        res = ResourceTypes()
+        res.stateful_sets = [sts]
+        result = simulate(cluster, [AppResource(name="sts", resource=res)])
+        assert not result.unscheduled_pods
+        nodes_used = {
+            name_of(st.node) for st in result.node_status for p in st.pods
+        }
+        assert len(nodes_used) == 4
+
+    def test_required_anti_affinity_blocks_colocation(self):
+        cluster = ResourceTypes()
+        cluster.nodes = [
+            make_fake_node(f"n{i}", "8", "16Gi", with_node_labels(_node_labels(f"n{i}", "worker")))
+            for i in range(2)
+        ]
+        deploy = make_fake_deployment("web", "default", 3, "100m", "100Mi")
+        deploy["metadata"]["labels"] = {"app": "web"}
+        deploy["spec"]["template"]["spec"]["affinity"] = {
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "labelSelector": {"matchLabels": {"app": "web"}},
+                        "topologyKey": "kubernetes.io/hostname",
+                    }
+                ]
+            }
+        }
+        res = ResourceTypes()
+        res.deployments = [deploy]
+        result = simulate(cluster, [AppResource(name="app", resource=res)])
+        # 2 nodes, 3 replicas mutually exclusive per hostname → 1 fails
+        assert len(result.unscheduled_pods) == 1
+        assert "anti-affinity" in result.unscheduled_pods[0].reason
+
+    def test_required_affinity_colocates(self):
+        cluster = ResourceTypes()
+        cluster.nodes = [
+            make_fake_node(f"n{i}", "8", "16Gi", with_node_labels(_node_labels(f"n{i}", "worker")))
+            for i in range(3)
+        ]
+        backend = make_fake_deployment("backend", "default", 1, "100m", "100Mi")
+        backend["metadata"]["labels"] = {"tier": "backend"}
+        frontend = make_fake_deployment("frontend", "default", 2, "100m", "100Mi")
+        frontend["metadata"]["labels"] = {"tier": "frontend"}
+        frontend["spec"]["template"]["spec"]["affinity"] = {
+            "podAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "labelSelector": {"matchLabels": {"tier": "backend"}},
+                        "topologyKey": "kubernetes.io/hostname",
+                    }
+                ]
+            }
+        }
+        res = ResourceTypes()
+        res.deployments = [backend, frontend]
+        result = simulate(cluster, [AppResource(name="app", resource=res)])
+        assert not result.unscheduled_pods
+        placements = {}
+        for st in result.node_status:
+            for pod in st.pods:
+                placements[name_of(pod)] = name_of(st.node)
+        backend_nodes = {
+            n for p, n in placements.items() if p.startswith("backend")
+        }
+        frontend_nodes = {
+            n for p, n in placements.items() if p.startswith("frontend")
+        }
+        assert frontend_nodes == backend_nodes
