@@ -22,8 +22,10 @@ from .core.objects import (
     deep_copy,
     name_of,
     namespace_of,
+    set_annotation,
     set_label,
 )
+from .core.quantity import parse_quantity
 from .core.tensorize import Tensorizer
 from .engine.scan import OK, REASON_TEXT, Engine
 from .workloads.expand import (
@@ -51,6 +53,7 @@ class Simulator:
         self._nodes: List[dict] = []
         self._scheduled: List[dict] = []  # placed pods, nodeName set
         self._unscheduled: List[UnscheduledPod] = []
+        self._storage_classes: List[dict] = []
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -58,7 +61,10 @@ class Simulator:
         """Install nodes and schedule the cluster's own pods
         (`pkg/simulator/simulator.go:159-164,251-332`)."""
         self._nodes = [deep_copy(n) for n in cluster.nodes]
-        self._tensorizer = Tensorizer(self._nodes, self._extra_resources)
+        self._storage_classes = list(cluster.storage_classes)
+        self._tensorizer = Tensorizer(
+            self._nodes, self._extra_resources, storage_classes=self._storage_classes
+        )
         self._engine = Engine(self._tensorizer)
         self._schedule_pods(cluster.pods)
         return self._result()
@@ -85,13 +91,22 @@ class Simulator:
         if not pods:
             return
         batch = self._tensorizer.add_pods(pods)
-        nodes, reasons = self._engine.place(batch)
+        nodes, reasons, extras = self._engine.place(batch)
         n_total = len(self._nodes)
-        for pod, node_idx, reason in zip(batch.pods, nodes, reasons):
+        for i, (pod, node_idx, reason) in enumerate(zip(batch.pods, nodes, reasons)):
             if node_idx >= 0:
                 placed = deep_copy(pod)
                 placed["spec"]["nodeName"] = self._nodes[node_idx]["metadata"]["name"]
                 placed.setdefault("status", {})["phase"] = "Running"
+                # GPU device assignment annotation (GpuSharePlugin.Bind applies
+                # the pod copy with the gpu-index annotation,
+                # open-gpu-share.go:221-241 + utils/pod.go:117-127)
+                shares = extras["gpu_shares"][i]
+                if shares.sum() > 0:
+                    ids = []
+                    for dev_id, cnt in enumerate(shares):
+                        ids.extend([str(dev_id)] * int(round(float(cnt))))
+                    set_annotation(placed, C.ANNO_POD_GPU_INDEX, "-".join(ids))
                 self._scheduled.append(placed)
             else:
                 msg = REASON_TEXT.get(int(reason), "unschedulable")
@@ -109,12 +124,78 @@ class Simulator:
         by_node = {name_of(n): [] for n in self._nodes}
         for pod in self._scheduled:
             by_node[pod["spec"]["nodeName"]].append(deep_copy(pod))
-        statuses = [
-            NodeStatus(node=deep_copy(n), pods=by_node[name_of(n)]) for n in self._nodes
-        ]
+        nodes = [deep_copy(n) for n in self._nodes]
+        self._write_extended_annotations(nodes)
+        statuses = [NodeStatus(node=n, pods=by_node[name_of(n)]) for n in nodes]
         return SimulateResult(
             unscheduled_pods=list(self._unscheduled), node_status=statuses
         )
+
+    def _write_extended_annotations(self, nodes: List[dict]) -> None:
+        """Mirror the storage/GPU state the reference's Bind/Reserve plugins
+        write back into node annotations (`plugin/open-local.go:218-249`,
+        `plugin/open-gpu-share.go:146-189`)."""
+        import json as _json
+
+        import numpy as np
+
+        from .core.extended import NodeStorage
+
+        ext = self._tensorizer.ext
+        log = self._engine.ext_log
+        n = len(nodes)
+        v = ext.vg_cap.shape[1]
+        sd = ext.sdev_cap.shape[1]
+        gd = ext.gpu_dev_total.shape[1]
+        vg_used = np.zeros((n, v), np.float64)
+        sdev_taken = np.zeros((n, sd), bool)
+        gpu_used = np.zeros((n, gd), np.float64)
+        gpu_pods = np.zeros(n, np.int64)
+        for node_idx, vg_alloc, take, shares, mem in zip(
+            log["node"], log["vg_alloc"], log["sdev_take"], log["gpu_shares"], log["gpu_mem"]
+        ):
+            vg_used[node_idx] += vg_alloc
+            sdev_taken[node_idx] |= take
+            gpu_used[node_idx] += np.asarray(shares) * mem
+            if mem > 0:
+                gpu_pods[node_idx] += 1
+        for i, node in enumerate(nodes):
+            storage = NodeStorage.from_node(node)
+            if storage is not None:
+                for j, vg in enumerate(storage.vgs):
+                    if j < v:
+                        prev = parse_quantity(vg.get("requested") or 0)
+                        vg["requested"] = int(prev + vg_used[i, j])
+                        if isinstance(vg.get("capacity"), str):
+                            vg["capacity"] = int(parse_quantity(vg["capacity"]))
+                for j, dev in enumerate(storage.devices):
+                    if j < sd and sdev_taken[i, j]:
+                        dev["isAllocated"] = True
+                set_annotation(
+                    node,
+                    C.ANNO_NODE_LOCAL_STORAGE,
+                    _json.dumps({"vgs": storage.vgs, "devices": storage.devices}),
+                )
+            if ext.gpu_total[i] > 0:
+                devs = {
+                    str(j): {
+                        "gpuTotalMemory": int(ext.gpu_dev_total[i, j]),
+                        "gpuUsedMemory": int(gpu_used[i, j]),
+                    }
+                    for j in range(gd)
+                    if ext.gpu_dev_total[i, j] > 0
+                }
+                info = {
+                    "gpuCount": int((ext.gpu_dev_total[i] > 0).sum()),
+                    "gpuAllocatable": int(
+                        ((ext.gpu_dev_total[i] > 0) & (gpu_used[i] == 0)).sum()
+                    ),
+                    "gpuTotalMemory": int(ext.gpu_total[i]),
+                    "gpuUsedMemory": int(gpu_used[i].sum()),
+                    "numPods": int(gpu_pods[i]),
+                    "devs": devs,
+                }
+                set_annotation(node, C.ANNO_NODE_GPU_SHARE, _json.dumps(info))
 
 
 def simulate(

@@ -32,6 +32,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import constants as C
+from .extended import (
+    ExtendedNodeArrays,
+    StorageClassCatalog,
+    pod_extended_demand,
+    stack_demands,
+    tensorize_node_storage,
+)
 from .match import (
     OP_DOES_NOT_EXIST,
     OP_EXISTS,
@@ -212,11 +219,12 @@ def _extract_pin(node_affinity_required: Optional[dict]) -> Tuple[Optional[str],
             return None, node_affinity_required
         t = {k: v for k, v in term.items() if k != "matchFields"}
         stripped_terms.append(t)
-    # if stripping fields left a term empty, the term was pure pin → drop it;
-    # if no terms remain, the whole required clause was the pin
-    stripped_terms = [t for t in stripped_terms if t.get("matchExpressions")]
-    stripped = {"nodeSelectorTerms": stripped_terms} if stripped_terms else None
-    return pin, stripped
+    # a term left empty after stripping was pure pin — its expression part is
+    # vacuously true, and terms are OR'd, so the whole required clause reduces
+    # to just the pin
+    if any(not t.get("matchExpressions") for t in stripped_terms):
+        return pin, None
+    return pin, {"nodeSelectorTerms": stripped_terms}
 
 
 @dataclass
@@ -341,6 +349,9 @@ class ClusterTensors:
     w_aff_pref: np.ndarray  # [G, T] f32 (summed weights)
     w_anti_pref: np.ndarray  # [G, T] f32
 
+    # extended resources (Open-Local storage + GPU share)
+    ext: ExtendedNodeArrays = field(repr=False, default=None)
+
     label_index: NodeLabelIndex = field(repr=False, default=None)
 
     @property
@@ -365,6 +376,7 @@ class PodBatch:
     req: np.ndarray  # [P, R] f32 (includes the synthetic `pods`=1 resource)
     pin: np.ndarray  # [P] i32 node index or -1
     forced: np.ndarray  # [P] bool — pre-assigned via spec.nodeName
+    ext: dict = None  # stacked extended demand (see extended.stack_demands)
 
 
 class Tensorizer:
@@ -375,10 +387,18 @@ class Tensorizer:
     simulator.go:167-184`); node-side arrays are fixed at construction.
     """
 
-    def __init__(self, nodes: Sequence[dict], extra_resources: Sequence[str] = ()):
+    def __init__(
+        self,
+        nodes: Sequence[dict],
+        extra_resources: Sequence[str] = (),
+        storage_classes: Sequence[dict] = (),
+    ):
         self.nodes = list(nodes)
         self.label_index = NodeLabelIndex(self.nodes)
         self.node_idx = {name: i for i, name in enumerate(self.label_index.names)}
+        self.vg_names = Interner()
+        self.ext = tensorize_node_storage(self.nodes, self.vg_names)
+        self.catalog = StorageClassCatalog(storage_classes)
 
         # resource vocabulary: base + everything any node allocates
         self.resources = Interner()
@@ -563,6 +583,7 @@ class Tensorizer:
         pin = np.full(p, -1, np.int32)
         forced = np.zeros(p, bool)
         reqs: List[Dict[str, float]] = []
+        demands = [pod_extended_demand(pod, self.catalog, self.vg_names) for pod in pods]
         for i, pod in enumerate(pods):
             g, pin_name = _group_of_pod(pod)
             group[i] = self._intern_group(g)
@@ -571,7 +592,9 @@ class Tensorizer:
                 pin[i] = self.node_idx.get(node_name, -1)
                 forced[i] = True
             elif pin_name is not None:
-                pin[i] = self.node_idx.get(pin_name, -1)
+                # -2 = pinned to a node that does not exist → unschedulable
+                # everywhere (the NodeAffinity filter would match no node)
+                pin[i] = self.node_idx.get(pin_name, -2)
             reqs.append(pod_requests(pod))
         self._refresh_s_match()
         req = np.zeros((p, len(self.resources)), np.float32)
@@ -588,7 +611,14 @@ class Tensorizer:
                     self.alloc = np.pad(self.alloc, ((0, 0), (0, 1)))
                     req = np.pad(req, ((0, 0), (0, 1)))
                     req[i, ridx] = val
-        return PodBatch(pods=list(pods), group=group, req=req, pin=pin, forced=forced)
+        return PodBatch(
+            pods=list(pods),
+            group=group,
+            req=req,
+            pin=pin,
+            forced=forced,
+            ext=stack_demands(demands),
+        )
 
     def freeze(self) -> ClusterTensors:
         """Materialize the dense arrays for the current vocabularies."""
@@ -628,5 +658,6 @@ class Tensorizer:
             a_anti_req=dense(self._a_anti, bool),
             w_aff_pref=dense(self._w_aff, np.float32),
             w_anti_pref=dense(self._w_anti, np.float32),
+            ext=self.ext,
             label_index=self.label_index,
         )

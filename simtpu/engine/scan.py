@@ -25,6 +25,7 @@ import numpy as np
 
 from ..core.tensorize import ClusterTensors, PodBatch
 from ..kernels.filters import interpod_filter, resources_fit
+from ..kernels.gpushare import gpu_plan
 from ..kernels.scores import (
     balanced_allocation,
     interpod_score,
@@ -34,6 +35,7 @@ from ..kernels.scores import (
     simon_share,
     taint_toleration_score,
 )
+from ..kernels.storage import device_plan, lvm_plan, open_local_score
 from .state import SchedState, build_state
 
 # Failure-reason codes (host maps to messages mirroring the scheduler's
@@ -43,12 +45,16 @@ FAIL_STATIC = 1  # affinity / selector / taints / pin — no node passed
 FAIL_RESOURCES = 2  # insufficient free resources on every remaining node
 FAIL_INTERPOD = 3  # inter-pod (anti-)affinity rules
 FAIL_NO_NODE = 4  # forced pod names an unknown node
+FAIL_STORAGE = 5  # Open-Local LVM/device storage
+FAIL_GPU = 6  # GPU-share memory/devices
 
 REASON_TEXT = {
     FAIL_STATIC: "node(s) didn't match node selector/affinity or had untolerated taints",
     FAIL_RESOURCES: "insufficient cpu/memory/extended resources on every feasible node",
     FAIL_INTERPOD: "node(s) didn't satisfy inter-pod affinity/anti-affinity rules",
     FAIL_NO_NODE: "pod references a node that does not exist",
+    FAIL_STORAGE: "insufficient open-local storage (LVM volume groups / exclusive devices)",
+    FAIL_GPU: "insufficient GPU memory on every feasible node's devices",
 }
 
 
@@ -66,9 +72,18 @@ class StaticArrays(NamedTuple):
     a_anti_req: jnp.ndarray  # [G, T]
     w_aff_pref: jnp.ndarray  # [G, T]
     w_anti_pref: jnp.ndarray  # [G, T]
+    # extended resources
+    has_storage: jnp.ndarray  # [N]
+    vg_cap: jnp.ndarray  # [N, V]
+    vg_name_id: jnp.ndarray  # [N, V]
+    sdev_cap: jnp.ndarray  # [N, SD]
+    sdev_media: jnp.ndarray  # [N, SD]
+    gpu_dev_exists: jnp.ndarray  # [N, GD]
+    gpu_total: jnp.ndarray  # [N]
 
 
 def statics_from(tensors: ClusterTensors) -> StaticArrays:
+    ext = tensors.ext
     return StaticArrays(
         alloc=jnp.asarray(tensors.alloc, jnp.float32),
         static_mask=jnp.asarray(tensors.static_mask),
@@ -81,6 +96,13 @@ def statics_from(tensors: ClusterTensors) -> StaticArrays:
         a_anti_req=jnp.asarray(tensors.a_anti_req),
         w_aff_pref=jnp.asarray(tensors.w_aff_pref),
         w_anti_pref=jnp.asarray(tensors.w_anti_pref),
+        has_storage=jnp.asarray(ext.has_storage),
+        vg_cap=jnp.asarray(ext.vg_cap, jnp.float32),
+        vg_name_id=jnp.asarray(ext.vg_name_id, jnp.int32),
+        sdev_cap=jnp.asarray(ext.sdev_cap, jnp.float32),
+        sdev_media=jnp.asarray(ext.sdev_media, jnp.int32),
+        gpu_dev_exists=jnp.asarray(ext.gpu_dev_total > 0),
+        gpu_total=jnp.asarray(ext.gpu_total, jnp.float32),
     )
 
 
@@ -88,15 +110,33 @@ def schedule_step(
     statics: StaticArrays, state: SchedState, pod
 ) -> Tuple[SchedState, Tuple[jnp.ndarray, jnp.ndarray]]:
     """One scheduling cycle for one pod against every node."""
-    g, req, pin, forced = pod
+    g, req, pin, forced, lvm_size, lvm_vg, dev_size, dev_media, gpu_mem, gpu_count = pod
     n = statics.alloc.shape[0]
     node_ids = jnp.arange(n)
 
     static_m = statics.static_mask[g]
-    pin_m = jnp.where(pin >= 0, node_ids == pin, True)
+    # pin: -1 = unpinned, -2 = pinned to a nonexistent node (matches nothing)
+    pin_m = jnp.where(pin >= 0, node_ids == pin, pin > -2)
     m_static = static_m & pin_m
     m_res = m_static & resources_fit(state.free, req)
-    m_all = m_res & interpod_filter(
+
+    # Open-Local storage (plugin Filter, open-local.go:50-91): pods that need
+    # storage only fit nodes carrying the storage annotation
+    needs_storage = jnp.any(lvm_size > 0) | jnp.any(dev_size > 0)
+    lvm_ok, lvm_alloc = lvm_plan(state.vg_free, statics.vg_name_id, lvm_size, lvm_vg)
+    dev_ok, dev_take, dev_tight = device_plan(
+        state.sdev_free, statics.sdev_cap, statics.sdev_media, dev_size, dev_media
+    )
+    storage_ok = jnp.where(needs_storage, statics.has_storage & lvm_ok & dev_ok, True)
+    m_storage = m_res & storage_ok
+
+    # GPU share (plugin Filter, open-gpu-share.go:51-81)
+    gpu_ok, gpu_shares = gpu_plan(
+        state.gpu_free, statics.gpu_dev_exists, statics.gpu_total, gpu_mem, gpu_count
+    )
+    m_gpu = m_storage & gpu_ok
+
+    m_all = m_gpu & interpod_filter(
         state.cnt_match,
         state.cnt_own_anti,
         statics.node_dom,
@@ -125,6 +165,20 @@ def schedule_step(
         statics.w_anti_pref[g],
     )
     score += maxabs_normalize(raw_ipa, m_all)
+    # Open-Local score (binpack; plugin weight 1) + GPU-share score — the
+    # latter is the same dominant-share formula as Simon's
+    # (open-gpu-share.go:84-110), so its normalized term repeats
+    score += minmax_normalize(
+        open_local_score(
+            lvm_alloc,
+            statics.vg_cap,
+            dev_tight,
+            jnp.sum(lvm_size > 0),
+            jnp.sum(dev_size > 0),
+        ),
+        m_all,
+    )
+    score += minmax_normalize(simon_share(statics.alloc, req), m_all)
     score = jnp.where(m_all, score, -jnp.inf)
 
     chosen = jnp.where(forced, pin, jnp.argmax(score).astype(jnp.int32))
@@ -138,7 +192,15 @@ def schedule_step(
             jnp.where(
                 ~jnp.any(m_static),
                 FAIL_STATIC,
-                jnp.where(~jnp.any(m_res), FAIL_RESOURCES, FAIL_INTERPOD),
+                jnp.where(
+                    ~jnp.any(m_res),
+                    FAIL_RESOURCES,
+                    jnp.where(
+                        ~jnp.any(m_storage),
+                        FAIL_STORAGE,
+                        jnp.where(~jnp.any(m_gpu), FAIL_GPU, FAIL_INTERPOD),
+                    ),
+                ),
             ),
         ),
     ).astype(jnp.int32)
@@ -147,6 +209,14 @@ def schedule_step(
     safe = jnp.clip(chosen, 0)
     w = jnp.where(placed, 1.0, 0.0)
     free = state.free.at[safe].add(-req * w)
+    vg_free = state.vg_free.at[safe].add(-lvm_alloc[safe] * w)
+    sdev_free = state.sdev_free.at[safe].set(
+        state.sdev_free[safe] & ~(dev_take[safe] & placed)
+    )
+    gpu_free = state.gpu_free.at[safe].add(-gpu_shares[safe] * gpu_mem * w)
+    pod_lvm_alloc = lvm_alloc[safe] * w
+    pod_dev_take = dev_take[safe] & placed
+    pod_gpu_shares = gpu_shares[safe] * w
 
     t_count = statics.term_topo.shape[0]
     if t_count:
@@ -166,12 +236,17 @@ def schedule_step(
             cnt_own_aff=bump(state.cnt_own_aff, statics.a_aff_req[g]),
             w_own_aff_pref=bump(state.w_own_aff_pref, statics.w_aff_pref[g]),
             w_own_anti_pref=bump(state.w_own_anti_pref, statics.w_anti_pref[g]),
+            vg_free=vg_free,
+            sdev_free=sdev_free,
+            gpu_free=gpu_free,
         )
     else:
-        new_state = state._replace(free=free)
+        new_state = state._replace(
+            free=free, vg_free=vg_free, sdev_free=sdev_free, gpu_free=gpu_free
+        )
 
     out_node = jnp.where(placed, chosen, -1)
-    return new_state, (out_node, reason)
+    return new_state, (out_node, reason, pod_lvm_alloc, pod_dev_take, pod_gpu_shares)
 
 
 @partial(jax.jit, static_argnums=(), donate_argnums=(1,))
@@ -191,10 +266,23 @@ class Engine:
         self.placed_group: List[int] = []
         self.placed_node: List[int] = []
         self.placed_req: List[np.ndarray] = []
+        # extended-resource placement log, keyed parallel to placed_node
+        self.ext_log = {
+            "node": [],
+            "vg_alloc": [],
+            "sdev_take": [],
+            "gpu_shares": [],
+            "gpu_mem": [],
+        }
+        self.last_state: SchedState = None
 
-    def place(self, batch: PodBatch) -> Tuple[np.ndarray, np.ndarray]:
-        """Schedule one batch; returns (node index per pod [-1 = failed],
-        reason codes)."""
+    def place(self, batch: PodBatch):
+        """Schedule one batch.
+
+        Returns (node index per pod [-1 = failed], reason codes, extras) where
+        extras carries each pod's extended-resource allocation at its node
+        (LVM per-VG bytes, device take mask, GPU device shares).
+        """
         tensors = self.tensorizer.freeze()
         r = tensors.alloc.shape[1]
         req = batch.req
@@ -209,20 +297,43 @@ class Engine:
                 if self.placed_req
                 else np.zeros((0, r), np.float32)
             ),
+            self.ext_log,
         )
         statics = statics_from(tensors)
+        ext = batch.ext
         pods = (
             jnp.asarray(batch.group),
             jnp.asarray(req, jnp.float32),
             jnp.asarray(batch.pin, jnp.int32),
             jnp.asarray(batch.forced),
+            jnp.asarray(ext["lvm_size"]),
+            jnp.asarray(ext["lvm_vg"]),
+            jnp.asarray(ext["dev_size"]),
+            jnp.asarray(ext["dev_media"]),
+            jnp.asarray(ext["gpu_mem"]),
+            jnp.asarray(ext["gpu_count"]),
         )
-        _, (nodes, reasons) = _run_scan(statics, state, pods)
+        final_state, (nodes, reasons, lvm_alloc, dev_take, gpu_shares) = _run_scan(
+            statics, state, pods
+        )
+        self.last_state = final_state
         nodes = np.asarray(nodes)
         reasons = np.asarray(reasons)
+        lvm_alloc = np.asarray(lvm_alloc)
+        dev_take = np.asarray(dev_take)
+        gpu_shares = np.asarray(gpu_shares)
         for i in range(len(nodes)):
             if nodes[i] >= 0:
                 self.placed_group.append(int(batch.group[i]))
                 self.placed_node.append(int(nodes[i]))
                 self.placed_req.append(req[i])
-        return nodes, reasons
+                self.ext_log["node"].append(int(nodes[i]))
+                self.ext_log["vg_alloc"].append(lvm_alloc[i])
+                self.ext_log["sdev_take"].append(dev_take[i])
+                self.ext_log["gpu_shares"].append(gpu_shares[i])
+                self.ext_log["gpu_mem"].append(float(ext["gpu_mem"][i]))
+        return nodes, reasons, {
+            "lvm_alloc": lvm_alloc,
+            "dev_take": dev_take,
+            "gpu_shares": gpu_shares,
+        }

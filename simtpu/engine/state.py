@@ -24,6 +24,9 @@ class SchedState(NamedTuple):
     cnt_own_aff:     [T, D] placed pods owning required affinity term t
     w_own_aff_pref:  [T, D] summed preferred-affinity weights of placed owners
     w_own_anti_pref: [T, D] summed preferred-anti-affinity weights
+    vg_free:         [N, V] free LVM volume-group space (Open-Local)
+    sdev_free:       [N, SD] exclusive storage devices still unallocated
+    gpu_free:        [N, GD] free GPU memory per device (GPU-share)
     """
 
     free: jnp.ndarray
@@ -32,23 +35,17 @@ class SchedState(NamedTuple):
     cnt_own_aff: jnp.ndarray
     w_own_aff_pref: jnp.ndarray
     w_own_anti_pref: jnp.ndarray
-
-
-def init_state(alloc: np.ndarray, n_terms: int, n_domains: int) -> SchedState:
-    t, d = max(n_terms, 0), max(n_domains, 1)
-    zeros = jnp.zeros((t, d), jnp.float32)
-    return SchedState(
-        free=jnp.asarray(alloc, jnp.float32),
-        cnt_match=zeros,
-        cnt_own_anti=zeros,
-        cnt_own_aff=zeros,
-        w_own_aff_pref=zeros,
-        w_own_anti_pref=zeros,
-    )
+    vg_free: jnp.ndarray
+    sdev_free: jnp.ndarray
+    gpu_free: jnp.ndarray
 
 
 def build_state(
-    tensors, placed_group: np.ndarray, placed_node: np.ndarray, placed_req: np.ndarray
+    tensors,
+    placed_group: np.ndarray,
+    placed_node: np.ndarray,
+    placed_req: np.ndarray,
+    placed_ext: dict = None,
 ) -> SchedState:
     """Reconstruct the full scan carry from the host-side placement log.
 
@@ -60,6 +57,22 @@ def build_state(
     n, r = tensors.alloc.shape
     t, d = tensors.n_terms, tensors.n_domains
     free = tensors.alloc.astype(np.float32).copy()
+    ext = tensors.ext
+    vg_free = (ext.vg_cap - ext.vg_req0).astype(np.float32)
+    sdev_free = (ext.sdev_cap > 0) & ~ext.sdev_alloc0
+    gpu_free = ext.gpu_dev_total.astype(np.float32).copy()
+    if placed_ext and len(placed_ext.get("node", ())):
+        pn = np.asarray(placed_ext["node"], np.int32)
+        np.add.at(vg_free, pn, -np.asarray(placed_ext["vg_alloc"], np.float32))
+        np.minimum.at(
+            sdev_free, pn, ~np.asarray(placed_ext["sdev_take"], bool)
+        )
+        np.add.at(
+            gpu_free,
+            pn,
+            -np.asarray(placed_ext["gpu_shares"], np.float32)
+            * np.asarray(placed_ext["gpu_mem"], np.float32)[:, None],
+        )
     cnt = np.zeros((5, max(t, 0), d), np.float32)
     if len(placed_group):
         req = placed_req
@@ -93,4 +106,7 @@ def build_state(
         cnt_own_aff=jnp.asarray(cnt[2]),
         w_own_aff_pref=jnp.asarray(cnt[3]),
         w_own_anti_pref=jnp.asarray(cnt[4]),
+        vg_free=jnp.asarray(vg_free),
+        sdev_free=jnp.asarray(sdev_free),
+        gpu_free=jnp.asarray(gpu_free),
     )

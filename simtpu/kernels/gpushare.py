@@ -1,0 +1,64 @@
+"""GPU-share kernels: per-device GPU-memory fit and device assignment.
+
+Mirrors `GpuNodeInfo.AllocateGpuId` (`vendor/github.com/alibaba/open-gpu-share/
+pkg/cache/gpunodeinfo.go:231-291`):
+
+- 1-GPU pods take the tightest-fitting device (min idle memory ≥ request,
+  lowest index on ties — the Go loop uses strict `<`)
+- multi-GPU pods greedily stack shares device-by-device in index order; one
+  device may host several of the requested GPU shares
+  (`gpunodeinfo.go:271-288` two-pointer walk)
+
+plus the node-level total check from `GpuSharePlugin.Filter`
+(`pkg/simulator/plugin/open-gpu-share.go:51-81`).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+_BIG = jnp.float32(3.4e38)
+
+
+def gpu_plan(
+    gpu_free: jnp.ndarray,  # [N, GD] free memory per device
+    dev_exists: jnp.ndarray,  # [N, GD] bool
+    gpu_total: jnp.ndarray,  # [N] node total GPU memory (static capacity)
+    mem: jnp.ndarray,  # scalar — per-GPU memory request
+    count: jnp.ndarray,  # scalar — number of GPU shares requested
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (fits [N], shares [N, GD]) — shares = how many of the pod's GPU
+    shares land on each device. Non-GPU pods fit everywhere with zero shares."""
+    n, gd = gpu_free.shape
+    # Filter triggers on mem > 0 alone (open-gpu-share.go:53-57); a pod with
+    # gpu-mem but no/zero gpu-count then fails AllocateGpuId on every node
+    # (gpunodeinfo.go:236-240) — valid_req captures that.
+    is_gpu_pod = mem > 0
+    valid_req = count > 0
+
+    free = jnp.where(dev_exists, gpu_free, -1.0)
+    # capacity in shares per device
+    per_dev = jnp.where(free >= mem, jnp.floor(free / jnp.maximum(mem, 1e-30)), 0.0)
+
+    # multi-GPU greedy: fill devices in index order (two-pointer walk)
+    cum = jnp.cumsum(per_dev, axis=1)
+    prev = cum - per_dev
+    greedy = jnp.clip(jnp.minimum(cum, count) - prev, 0.0, per_dev)
+
+    # 1-GPU tightest fit: min free among devices that fit, lowest index tie
+    fit1 = free >= mem
+    key = jnp.where(fit1, free, _BIG)
+    tight_idx = jnp.argmin(key, axis=1)
+    tight = jnp.zeros((n, gd)).at[jnp.arange(n), tight_idx].set(
+        jnp.where(jnp.any(fit1, axis=1), 1.0, 0.0)
+    )
+
+    shares = jnp.where(count == 1, tight, greedy)
+    enough = jnp.sum(shares, axis=1) >= count
+    node_total_ok = gpu_total >= mem  # Filter's node-level pre-check
+    has_dev = jnp.any(dev_exists, axis=1)
+    fits = jnp.where(is_gpu_pod, node_total_ok & has_dev & valid_req & enough, True)
+    shares = jnp.where(is_gpu_pod & fits[:, None], shares, 0.0)
+    return fits, shares

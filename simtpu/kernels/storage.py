@@ -1,0 +1,120 @@
+"""Open-Local storage kernels: LVM + exclusive-device fit, plan, and score.
+
+Vectorized over nodes; the per-PVC loops are short static unrolls (a pod has
+a handful of volume claims). Semantics mirror the vendored open-local algo:
+
+- LVM named-VG fit and binpack placement of unnamed PVCs into the
+  smallest-free VG that fits (`vendor/.../algo/common.go:59-144,511-560`)
+- exclusive devices: per media class, PVCs ascending take the smallest free
+  device with enough capacity (`common.go:290-345,394-446`)
+- binpack scores: LVM = mean over used VGs of pod-usage/capacity × 10;
+  device = mean over units of requested/capacity × 10 (`common.go:660-692,
+  753-762`, MaxScore=10, binpack strategy default)
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+MAX_LOCAL_SCORE = 10.0
+_BIG = jnp.float32(3.4e38)
+
+
+def lvm_plan(
+    vg_free: jnp.ndarray,  # [N, V] capacity - requested (current)
+    vg_name_id: jnp.ndarray,  # [N, V] interned VG name, -1 pad
+    sizes: jnp.ndarray,  # [L] pvc sizes, 0 = padding
+    vg_ids: jnp.ndarray,  # [L] -1 unnamed, -2 missing VG, >=0 named
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (fits [N], alloc [N, V]) — the pod's LVM allocation per node."""
+    n, v = vg_free.shape
+    l = sizes.shape[0]
+    exists = vg_name_id >= 0
+    has_any_vg = jnp.any(exists, axis=1)
+    fits = jnp.ones(n, bool)
+    alloc = jnp.zeros_like(vg_free)
+    free = vg_free
+    for i in range(l):
+        size, vid = sizes[i], vg_ids[i]
+        active = size > 0
+        named = vid >= 0
+        # named path: the VG must exist on the node and have room
+        slot_named = exists & (vg_name_id == vid)  # [N, V]
+        has_named = jnp.any(slot_named, axis=1)
+        # unnamed path: binpack — smallest free VG that still fits
+        eligible = exists & (free >= size)
+        key = jnp.where(eligible, free, _BIG)
+        slot_binpack = jnp.zeros((n, v), bool).at[
+            jnp.arange(n), jnp.argmin(key, axis=1)
+        ].set(jnp.any(eligible, axis=1))
+        slot = jnp.where(named, slot_named, slot_binpack)
+        room = jnp.any(slot & (free >= size), axis=1)
+        ok = jnp.where(
+            named, has_named & room, jnp.any(eligible, axis=1)
+        ) & (vid != -2) & has_any_vg
+        take = slot & (free >= size)
+        # named VG may match one slot only; guard double-count anyway
+        upd = jnp.where(active & ok[:, None] & take, size, 0.0)
+        alloc = alloc + upd
+        free = free - upd
+        fits = fits & jnp.where(active, ok, True)
+    return fits, alloc
+
+
+def device_plan(
+    sdev_free: jnp.ndarray,  # [N, SD] bool — device exists and unallocated
+    sdev_cap: jnp.ndarray,  # [N, SD]
+    sdev_media: jnp.ndarray,  # [N, SD] media code (0 none)
+    sizes: jnp.ndarray,  # [K] ascending per media class, 0 padding
+    medias: jnp.ndarray,  # [K] media code per pvc
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Returns (fits [N], take [N, SD] bool, tightness [N]) where tightness is
+    Σ requested/allocated over assigned devices (for ScoreDevice)."""
+    n, sd = sdev_cap.shape
+    k = sizes.shape[0]
+    fits = jnp.ones(n, bool)
+    take = jnp.zeros((n, sd), bool)
+    free = sdev_free
+    tightness = jnp.zeros(n, jnp.float32)
+    for i in range(k):
+        size, media = sizes[i], medias[i]
+        active = size > 0
+        eligible = free & (sdev_media == media) & (sdev_cap >= size)
+        key = jnp.where(eligible, sdev_cap, _BIG)
+        choice = jnp.argmin(key, axis=1)  # smallest adequate device
+        found = jnp.any(eligible, axis=1)
+        sel = jnp.zeros((n, sd), bool).at[jnp.arange(n), choice].set(found)
+        sel = sel & active
+        take = take | sel
+        free = free & ~sel
+        cap_chosen = jnp.sum(jnp.where(sel, sdev_cap, 0.0), axis=1)
+        tightness = tightness + jnp.where(
+            found & active, size / jnp.maximum(cap_chosen, 1e-30), 0.0
+        )
+        fits = fits & jnp.where(active, found, True)
+    return fits, take, tightness
+
+
+def open_local_score(
+    alloc: jnp.ndarray,  # [N, V] pod's LVM allocation (from lvm_plan)
+    vg_cap: jnp.ndarray,  # [N, V]
+    dev_tightness: jnp.ndarray,  # [N] Σ req/cap over assigned devices
+    n_lvm: jnp.ndarray,  # scalar — number of LVM PVCs (for zero check)
+    n_dev: jnp.ndarray,  # scalar — number of device PVCs
+) -> jnp.ndarray:
+    """LocalPlugin.Score raw value (`plugin/open-local.go:93-137`): ScoreLVM +
+    ScoreDevice, each int-truncated in the reference; we keep floats."""
+    used = alloc > 0
+    per_vg = jnp.where(used, alloc / jnp.maximum(vg_cap, 1e-30), 0.0)
+    vg_count = jnp.sum(used, axis=1)
+    lvm_score = jnp.where(
+        (n_lvm > 0) & (vg_count > 0),
+        jnp.sum(per_vg, axis=1) / jnp.maximum(vg_count, 1) * MAX_LOCAL_SCORE,
+        0.0,
+    )
+    dev_score = jnp.where(
+        n_dev > 0, dev_tightness / jnp.maximum(n_dev, 1) * MAX_LOCAL_SCORE, 0.0
+    )
+    return lvm_score + dev_score

@@ -483,6 +483,72 @@ class TestSimulate:
         assert len(result.unscheduled_pods) == 1
         assert "anti-affinity" in result.unscheduled_pods[0].reason
 
+    def test_pin_to_nonexistent_node_is_unschedulable(self):
+        cluster = ResourceTypes()
+        cluster.nodes = [make_fake_node("n0", "8", "16Gi")]
+        res = ResourceTypes()
+        pod = make_fake_pod("ghost-pinned", "default", "100m", "100Mi")
+        pod["spec"]["affinity"] = {
+            "nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [
+                        {
+                            "matchFields": [
+                                {
+                                    "key": "metadata.name",
+                                    "operator": "In",
+                                    "values": ["ghost-node"],
+                                }
+                            ]
+                        }
+                    ]
+                }
+            }
+        }
+        res.pods = [pod]
+        result = simulate(cluster, [AppResource(name="app", resource=res)])
+        assert len(result.unscheduled_pods) == 1
+
+    def test_pure_pin_term_does_not_tighten_sibling_terms(self):
+        # OR semantics: a second term that is pure pin makes the pin alone
+        # sufficient, regardless of the first term's expressions
+        cluster = ResourceTypes()
+        cluster.nodes = [make_fake_node("n1", "8", "16Gi")]
+        res = ResourceTypes()
+        pod = make_fake_pod("orpin", "default", "100m", "100Mi")
+        pod["spec"]["affinity"] = {
+            "nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [
+                        {
+                            "matchExpressions": [
+                                {"key": "nonexistent-label", "operator": "Exists"}
+                            ],
+                            "matchFields": [
+                                {
+                                    "key": "metadata.name",
+                                    "operator": "In",
+                                    "values": ["n1"],
+                                }
+                            ],
+                        },
+                        {
+                            "matchFields": [
+                                {
+                                    "key": "metadata.name",
+                                    "operator": "In",
+                                    "values": ["n1"],
+                                }
+                            ]
+                        },
+                    ]
+                }
+            }
+        }
+        res.pods = [pod]
+        result = simulate(cluster, [AppResource(name="app", resource=res)])
+        assert not result.unscheduled_pods
+
     def test_required_affinity_colocates(self):
         cluster = ResourceTypes()
         cluster.nodes = [

@@ -1,0 +1,316 @@
+"""Extended-resource conformance: Open-Local storage + GPU-share.
+
+Exercises the same golden fixtures the reference documents
+(`example/simon-gpushare-config.yaml`, `example/application/open_local`) plus
+kernel-level unit checks of the vendored algorithms' semantics."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import simtpu.constants as C
+from simtpu import AppResource, ResourceTypes, simulate
+from simtpu.core.objects import annotations_of, name_of
+from simtpu.core.quantity import parse_quantity
+from simtpu.io.cluster import create_cluster_resource_from_cluster_config
+from simtpu.io.yaml_loader import load_resources
+from simtpu.workloads.expand import seed_name_hashes
+
+from .fixtures import (
+    make_fake_node,
+    make_fake_pod,
+    with_node_allocatable,
+    with_node_labels,
+    with_node_local_storage,
+    with_pod_annotations,
+)
+
+GI = 2**30
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    seed_name_hashes(3)
+
+
+def _placements(result):
+    out = {}
+    for st in result.node_status:
+        for pod in st.pods:
+            out[name_of(pod)] = (name_of(st.node), pod)
+    return out
+
+
+class TestGpuShareFixtures:
+    def test_pai_gpu_app_places_with_device_assignments(self, example_dir):
+        cluster = create_cluster_resource_from_cluster_config(
+            os.path.join(example_dir, "cluster/gpushare")
+        )
+        app = AppResource(
+            name="pai_gpu",
+            resource=load_resources(os.path.join(example_dir, "application/gpushare")),
+        )
+        result = simulate(cluster, [app], extended_resources=["gpu"])
+        # 2 nodes × 2 GPUs × 16280Mi per device; demand: 1×1024Mi, 1×10240Mi(×2 GPUs),
+        # 6×10240Mi + (pod-01 unknown) — every gpu pod that fits must carry gpu-index
+        per_device = {}
+        for pname, (node, pod) in _placements(result).items():
+            annos = annotations_of(pod)
+            mem = parse_quantity(annos.get(C.ANNO_POD_GPU_MEM, 0))
+            if mem > 0 and annos.get(C.ANNO_POD_GPU_COUNT, "0") != "0":
+                idx = annos.get(C.ANNO_POD_GPU_INDEX)
+                assert idx is not None, f"{pname} placed without gpu-index"
+                for dev in idx.split("-"):
+                    key = (node, int(dev))
+                    per_device[key] = per_device.get(key, 0) + mem
+        # per-device capacity is totalMem/count = 16280Mi
+        cap = parse_quantity("32560Mi") / 2
+        for key, used in per_device.items():
+            assert used <= cap + 1, f"device {key} over capacity: {used}"
+        assert per_device, "no GPU pods were placed"
+
+    def test_multi_gpu_pod_stacks_onto_devices(self):
+        node = make_fake_node(
+            "g0",
+            "64",
+            "256Gi",
+            with_node_labels({"kubernetes.io/hostname": "g0"}),
+            with_node_allocatable(
+                {"alibabacloud.com/gpu-mem": "32Gi", "alibabacloud.com/gpu-count": "2"}
+            ),
+        )
+        # 4 GPU shares of 8Gi each; devices hold 16Gi → 2 shares per device
+        pod = make_fake_pod(
+            "multi",
+            "default",
+            "1",
+            "1Gi",
+            with_pod_annotations(
+                {C.ANNO_POD_GPU_MEM: "8Gi", C.ANNO_POD_GPU_COUNT: "4"}
+            ),
+        )
+        cluster = ResourceTypes()
+        cluster.nodes = [node]
+        res = ResourceTypes()
+        res.pods = [pod]
+        result = simulate(cluster, [AppResource(name="app", resource=res)])
+        assert not result.unscheduled_pods
+        _, placed = _placements(result)["multi"]
+        assert annotations_of(placed)[C.ANNO_POD_GPU_INDEX] == "0-0-1-1"
+
+    def test_tightest_fit_single_gpu(self):
+        node = make_fake_node(
+            "g0",
+            "64",
+            "256Gi",
+            with_node_allocatable(
+                {"alibabacloud.com/gpu-mem": "32Gi", "alibabacloud.com/gpu-count": "2"}
+            ),
+        )
+        cluster = ResourceTypes()
+        cluster.nodes = [node]
+
+        def gpu_pod(name, mem):
+            return make_fake_pod(
+                name,
+                "default",
+                "100m",
+                "128Mi",
+                with_pod_annotations({C.ANNO_POD_GPU_MEM: mem, C.ANNO_POD_GPU_COUNT: "1"}),
+            )
+
+        res = ResourceTypes()
+        # first pod takes 12Gi on dev 0; second (3Gi) should tightest-fit onto
+        # dev 0 (4Gi idle < 16Gi idle on dev 1)
+        res.pods = [gpu_pod("big", "12Gi"), gpu_pod("small", "3Gi")]
+        result = simulate(cluster, [AppResource(name="app", resource=res)])
+        assert not result.unscheduled_pods
+        placements = _placements(result)
+        assert annotations_of(placements["big"][1])[C.ANNO_POD_GPU_INDEX] == "0"
+        assert annotations_of(placements["small"][1])[C.ANNO_POD_GPU_INDEX] == "0"
+
+    def test_gpu_mem_without_count_is_unschedulable(self):
+        # GpuSharePlugin.Filter triggers on gpu-mem alone; AllocateGpuId then
+        # fails for reqGpuNum<=0 → unschedulable everywhere
+        node = make_fake_node(
+            "g0",
+            "64",
+            "256Gi",
+            with_node_allocatable(
+                {"alibabacloud.com/gpu-mem": "32Gi", "alibabacloud.com/gpu-count": "2"}
+            ),
+        )
+        cluster = ResourceTypes()
+        cluster.nodes = [node]
+        res = ResourceTypes()
+        res.pods = [
+            make_fake_pod(
+                "no-count",
+                "default",
+                "100m",
+                "128Mi",
+                with_pod_annotations({C.ANNO_POD_GPU_MEM: "8Gi"}),
+            )
+        ]
+        result = simulate(cluster, [AppResource(name="app", resource=res)])
+        assert len(result.unscheduled_pods) == 1
+
+    def test_gpu_pod_unschedulable_without_gpu_nodes(self):
+        cluster = ResourceTypes()
+        cluster.nodes = [make_fake_node("plain", "8", "16Gi")]
+        res = ResourceTypes()
+        res.pods = [
+            make_fake_pod(
+                "gp",
+                "default",
+                "100m",
+                "128Mi",
+                with_pod_annotations({C.ANNO_POD_GPU_MEM: "1Gi", C.ANNO_POD_GPU_COUNT: "1"}),
+            )
+        ]
+        result = simulate(cluster, [AppResource(name="app", resource=res)])
+        assert len(result.unscheduled_pods) == 1
+        assert "GPU" in result.unscheduled_pods[0].reason
+
+
+STORAGE = {
+    "vgs": [
+        {"name": "pool0", "capacity": 100 * GI},
+        {"name": "pool1", "capacity": 200 * GI},
+    ],
+    "devices": [
+        {
+            "name": "/dev/vdd",
+            "device": "/dev/vdd",
+            "capacity": 100 * GI,
+            "isAllocated": False,
+            "mediaType": "hdd",
+        },
+        {
+            "name": "/dev/vde",
+            "device": "/dev/vde",
+            "capacity": 50 * GI,
+            "isAllocated": False,
+            "mediaType": "ssd",
+        },
+    ],
+}
+
+
+def _sc(name, media=None, vg=None):
+    params = {}
+    if media:
+        params["mediaType"] = media
+    if vg:
+        params["vgName"] = vg
+    return {
+        "apiVersion": "storage.k8s.io/v1",
+        "kind": "StorageClass",
+        "metadata": {"name": name},
+        "parameters": params,
+    }
+
+
+def _storage_pod(name, volumes):
+    return make_fake_pod(
+        name,
+        "default",
+        "100m",
+        "128Mi",
+        with_pod_annotations({C.ANNO_POD_LOCAL_STORAGE: json.dumps({"volumes": volumes})}),
+    )
+
+
+class TestOpenLocal:
+    def _cluster(self):
+        cluster = ResourceTypes()
+        cluster.nodes = [
+            make_fake_node("s0", "8", "16Gi", with_node_local_storage(STORAGE)),
+            make_fake_node("plain", "8", "16Gi"),
+        ]
+        cluster.storage_classes = [
+            _sc("open-local-lvm"),
+            _sc("open-local-device-hdd", media="hdd"),
+            _sc("open-local-device-ssd", media="ssd"),
+        ]
+        return cluster
+
+    def test_lvm_binpack_picks_smallest_fitting_vg(self):
+        cluster = self._cluster()
+        res = ResourceTypes()
+        res.pods = [
+            _storage_pod(
+                "lvm-pod",
+                [{"size": str(60 * GI), "kind": "LVM", "scName": "open-local-lvm"}],
+            )
+        ]
+        result = simulate(cluster, [AppResource(name="app", resource=res)])
+        assert not result.unscheduled_pods
+        node_name, _ = _placements(result)["lvm-pod"]
+        assert node_name == "s0"
+        status = {name_of(st.node): st.node for st in result.node_status}
+        storage = json.loads(
+            annotations_of(status["s0"])[C.ANNO_NODE_LOCAL_STORAGE]
+        )
+        # 60Gi binpacks into pool0 (100Gi free < 200Gi free)
+        by_name = {vg["name"]: vg for vg in storage["vgs"]}
+        assert int(by_name["pool0"]["requested"]) == 60 * GI
+        assert int(by_name["pool1"]["requested"]) == 0
+
+    def test_device_exclusive_allocation(self):
+        cluster = self._cluster()
+        res = ResourceTypes()
+        res.pods = [
+            _storage_pod(
+                "dev-pod-1",
+                [{"size": str(30 * GI), "kind": "HDD", "scName": "open-local-device-hdd"}],
+            ),
+            _storage_pod(
+                "dev-pod-2",
+                [{"size": str(30 * GI), "kind": "HDD", "scName": "open-local-device-hdd"}],
+            ),
+        ]
+        result = simulate(cluster, [AppResource(name="app", resource=res)])
+        # only one hdd device exists → second pod unschedulable
+        assert len(result.unscheduled_pods) == 1
+        assert "storage" in result.unscheduled_pods[0].reason
+        status = {name_of(st.node): st.node for st in result.node_status}
+        storage = json.loads(annotations_of(status["s0"])[C.ANNO_NODE_LOCAL_STORAGE])
+        hdd = [d for d in storage["devices"] if d["mediaType"] == "hdd"][0]
+        assert hdd["isAllocated"] is True
+
+    def test_storage_pod_avoids_storageless_node(self):
+        cluster = self._cluster()
+        res = ResourceTypes()
+        res.pods = [
+            _storage_pod(
+                "p",
+                [{"size": str(10 * GI), "kind": "LVM", "scName": "open-local-lvm"}],
+            )
+        ]
+        result = simulate(cluster, [AppResource(name="app", resource=res)])
+        assert not result.unscheduled_pods
+        assert _placements(result)["p"][0] == "s0"
+
+    def test_open_local_app_fixture(self, example_dir):
+        cluster = create_cluster_resource_from_cluster_config(
+            os.path.join(example_dir, "cluster/demo_1")
+        )
+        app = AppResource(
+            name="open_local",
+            resource=load_resources(os.path.join(example_dir, "application/open_local")),
+        )
+        result = simulate(cluster, [app], extended_resources=["open-local"])
+        # nginx-lvm: 4 replicas each wanting 10Gi+40Gi LVM and a 100Gi HDD
+        # device; only master-1 (tainted, no toleration) and worker-1 carry
+        # storage with ONE hdd device each → exactly 1 replica fits (worker-1)
+        failed = [name_of(u.pod) for u in result.unscheduled_pods]
+        assert len(failed) == 3, (failed, [u.reason for u in result.unscheduled_pods])
+        placed = [
+            (p, n)
+            for p, (n, _) in _placements(result).items()
+            if p.startswith("nginx-lvm")
+        ]
+        assert placed == [(placed[0][0], "worker-1")]
