@@ -19,6 +19,7 @@ from .core.objects import (
     ResourceTypes,
     SimulateResult,
     UnscheduledPod,
+    annotations_of,
     deep_copy,
     name_of,
     namespace_of,
@@ -102,7 +103,8 @@ class Simulator:
                 # the pod copy with the gpu-index annotation,
                 # open-gpu-share.go:221-241 + utils/pod.go:117-127)
                 shares = extras["gpu_shares"][i]
-                if shares.sum() > 0:
+                already = annotations_of(placed).get(C.ANNO_POD_GPU_INDEX)
+                if shares.sum() > 0 and not already:
                     ids = []
                     for dev_id, cnt in enumerate(shares):
                         ids.extend([str(dev_id)] * int(round(float(cnt))))

@@ -1,0 +1,161 @@
+"""The `simtpu` command-line interface.
+
+Mirrors the reference's cobra tree `simon {apply, version, gen-doc}`
+(`cmd/simon/simon.go:26-42`) with the same `apply` flags
+(`cmd/apply/apply.go:26-37`): -f/--simon-config, --default-scheduler-config,
+--use-greed, -i/--interactive, --extended-resources. Log level comes from the
+`LogLevel` env var (`cmd/simon/simon.go:44-64`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+from typing import List, Optional
+
+from . import __version__, constants as C
+from .plan.capacity import Applier, ApplierOptions
+from .report import report
+
+log = logging.getLogger("simtpu")
+
+
+def _setup_logging() -> None:
+    level = os.environ.get("LogLevel", "info").lower()
+    logging.basicConfig(
+        level={"debug": logging.DEBUG, "info": logging.INFO, "warn": logging.WARNING}.get(
+            level, logging.INFO
+        ),
+        format="%(levelname)s %(message)s",
+    )
+
+
+def _interactive_select(names: List[str]) -> List[str]:
+    """Multi-select stand-in for survey.Ask (`pkg/apply/apply.go:153-169`)."""
+    print("Confirm your apps (comma-separated indices, empty = all):")
+    for i, n in enumerate(names):
+        print(f"  [{i}] {n}")
+    raw = input("> ").strip()
+    if not raw:
+        return names
+    picked = []
+    for token in raw.split(","):
+        token = token.strip()
+        if token.isdigit() and int(token) < len(names):
+            picked.append(names[int(token)])
+        elif token in names:
+            picked.append(token)
+    return picked
+
+
+def cmd_apply(args: argparse.Namespace) -> int:
+    opts = ApplierOptions(
+        simon_config=args.simon_config,
+        default_scheduler_config=args.default_scheduler_config or "",
+        use_greed=args.use_greed,
+        interactive=args.interactive,
+        extended_resources=args.extended_resources or [],
+        search=args.search,
+    )
+    try:
+        applier = Applier(opts)
+    except (ValueError, FileNotFoundError) as exc:
+        print(exc, file=sys.stderr)
+        return 1
+    select = _interactive_select if opts.interactive else None
+
+    def progress(msg: str) -> None:
+        print(f"{C.COLOR_YELLOW}{msg}{C.COLOR_RESET}")
+
+    plan = applier.run(select_apps=select, progress=progress)
+    if plan.success:
+        print(f"{C.COLOR_GREEN}Success!{C.COLOR_RESET}")
+        print(C.COLOR_GREEN, end="")
+        print(report(plan.result.node_status, opts.extended_resources))
+        print(C.COLOR_RESET, end="")
+        return 0
+    print(f"{C.COLOR_RED}{plan.message}{C.COLOR_RESET}")
+    if plan.result is not None:
+        print(C.COLOR_RED, end="")
+        print(report(plan.result.node_status, opts.extended_resources))
+        print(C.COLOR_RESET, end="")
+    return 1
+
+
+def cmd_version(_args: argparse.Namespace) -> int:
+    print(f"simtpu version {__version__}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="simtpu",
+        description="TPU-native cluster simulator and capacity planner "
+        "(Open-Simulator capabilities, JAX engine)",
+    )
+    sub = parser.add_subparsers(dest="command")
+
+    apply_p = sub.add_parser("apply", help="simulate deploying applications in a cluster")
+    apply_p.add_argument(
+        "-f", "--simon-config", required=True, help="path of simon config (required)"
+    )
+    apply_p.add_argument(
+        "-d",
+        "--default-scheduler-config",
+        help="path of scheduler-config overrides",
+    )
+    apply_p.add_argument(
+        "-g", "--use-greed", action="store_true", help="use greed algorithm to queue pods"
+    )
+    apply_p.add_argument(
+        "-i", "--interactive", action="store_true", help="interactively choose apps"
+    )
+    apply_p.add_argument(
+        "-e",
+        "--extended-resources",
+        nargs="*",
+        choices=["open-local", "gpu"],
+        help="show extended resources in the report (open-local, gpu)",
+    )
+    apply_p.add_argument(
+        "--search",
+        choices=["binary", "linear"],
+        default="binary",
+        help="min-node-add search strategy (linear = reference-exact walk)",
+    )
+    apply_p.set_defaults(func=cmd_apply)
+
+    ver_p = sub.add_parser("version", help="print version")
+    ver_p.set_defaults(func=cmd_version)
+
+    doc_p = sub.add_parser("gen-doc", help="generate CLI markdown docs")
+    doc_p.add_argument("--output", default="docs/commandline", help="output directory")
+    doc_p.set_defaults(func=cmd_gen_doc)
+    return parser
+
+
+def cmd_gen_doc(args: argparse.Namespace) -> int:
+    """Markdown docs from the parser tree (`cmd/doc/generate_markdown.go`)."""
+    parser = build_parser()
+    os.makedirs(args.output, exist_ok=True)
+    path = os.path.join(args.output, "simtpu.md")
+    with open(path, "w") as f:
+        f.write(f"## simtpu\n\n```\n{parser.format_help()}\n```\n")
+    print(f"wrote {path}")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    _setup_logging()
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not getattr(args, "command", None):
+        parser.print_help()
+        return 0
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

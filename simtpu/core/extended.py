@@ -168,6 +168,7 @@ class PodExtendedDemand:
     dev_medias: List[int]
     gpu_mem: float
     gpu_count: int
+    gpu_preset: List[int]  # device ids from an existing gpu-index annotation
 
 
 def pod_extended_demand(
@@ -218,6 +219,16 @@ def pod_extended_demand(
         gpu_count = int(annos.get(C.ANNO_POD_GPU_COUNT, "0"))
     except ValueError:
         gpu_count = 0
+    # an existing gpu-index annotation short-circuits device planning
+    # (AllocateGpuId, gpunodeinfo.go:247-253) — e.g. running pods from a live
+    # cluster snapshot keep their device assignment
+    gpu_preset: List[int] = []
+    raw_idx = annos.get(C.ANNO_POD_GPU_INDEX, "")
+    if raw_idx:
+        try:
+            gpu_preset = [int(tok) for tok in raw_idx.split("-")]
+        except ValueError:
+            gpu_preset = []
     return PodExtendedDemand(
         lvm_sizes=lvm_sizes,
         lvm_vg_ids=lvm_vg_ids,
@@ -225,14 +236,16 @@ def pod_extended_demand(
         dev_medias=[p[1] for p in dev_pairs],
         gpu_mem=gpu_mem,
         gpu_count=gpu_count,
+        gpu_preset=gpu_preset,
     )
 
 
-def stack_demands(demands: List[PodExtendedDemand]) -> dict:
+def stack_demands(demands: List[PodExtendedDemand], n_gpu_devices: int = 1) -> dict:
     """Pad per-pod ragged demand lists into dense arrays for the scan."""
     p = len(demands)
     l_max = max([len(d.lvm_sizes) for d in demands] + [1])
     k_max = max([len(d.dev_sizes) for d in demands] + [1])
+    gd = max(n_gpu_devices, 1)
     out = {
         "lvm_size": np.zeros((p, l_max), np.float32),
         "lvm_vg": np.full((p, l_max), -1, np.int32),
@@ -240,6 +253,7 @@ def stack_demands(demands: List[PodExtendedDemand]) -> dict:
         "dev_media": np.zeros((p, k_max), np.int32),
         "gpu_mem": np.zeros(p, np.float32),
         "gpu_count": np.zeros(p, np.int32),
+        "gpu_preset": np.zeros((p, gd), np.float32),
     }
     for i, d in enumerate(demands):
         out["lvm_size"][i, : len(d.lvm_sizes)] = d.lvm_sizes
@@ -248,4 +262,7 @@ def stack_demands(demands: List[PodExtendedDemand]) -> dict:
         out["dev_media"][i, : len(d.dev_medias)] = d.dev_medias
         out["gpu_mem"][i] = d.gpu_mem
         out["gpu_count"][i] = d.gpu_count
+        for dev_id in d.gpu_preset:
+            if 0 <= dev_id < gd:
+                out["gpu_preset"][i, dev_id] += 1.0
     return out

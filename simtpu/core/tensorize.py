@@ -617,7 +617,7 @@ class Tensorizer:
             req=req,
             pin=pin,
             forced=forced,
-            ext=stack_demands(demands),
+            ext=stack_demands(demands, self.ext.gpu_dev_total.shape[1]),
         )
 
     def freeze(self) -> ClusterTensors:

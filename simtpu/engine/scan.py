@@ -110,7 +110,19 @@ def schedule_step(
     statics: StaticArrays, state: SchedState, pod
 ) -> Tuple[SchedState, Tuple[jnp.ndarray, jnp.ndarray]]:
     """One scheduling cycle for one pod against every node."""
-    g, req, pin, forced, lvm_size, lvm_vg, dev_size, dev_media, gpu_mem, gpu_count = pod
+    (
+        g,
+        req,
+        pin,
+        forced,
+        lvm_size,
+        lvm_vg,
+        dev_size,
+        dev_media,
+        gpu_mem,
+        gpu_count,
+        gpu_preset,
+    ) = pod
     n = statics.alloc.shape[0]
     node_ids = jnp.arange(n)
 
@@ -132,7 +144,12 @@ def schedule_step(
 
     # GPU share (plugin Filter, open-gpu-share.go:51-81)
     gpu_ok, gpu_shares = gpu_plan(
-        state.gpu_free, statics.gpu_dev_exists, statics.gpu_total, gpu_mem, gpu_count
+        state.gpu_free,
+        statics.gpu_dev_exists,
+        statics.gpu_total,
+        gpu_mem,
+        gpu_count,
+        gpu_preset,
     )
     m_gpu = m_storage & gpu_ok
 
@@ -312,6 +329,7 @@ class Engine:
             jnp.asarray(ext["dev_media"]),
             jnp.asarray(ext["gpu_mem"]),
             jnp.asarray(ext["gpu_count"]),
+            jnp.asarray(ext["gpu_preset"]),
         )
         final_state, (nodes, reasons, lvm_alloc, dev_take, gpu_shares) = _run_scan(
             statics, state, pods

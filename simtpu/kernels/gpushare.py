@@ -28,9 +28,15 @@ def gpu_plan(
     gpu_total: jnp.ndarray,  # [N] node total GPU memory (static capacity)
     mem: jnp.ndarray,  # scalar — per-GPU memory request
     count: jnp.ndarray,  # scalar — number of GPU shares requested
+    preset: jnp.ndarray = None,  # [GD] shares from an existing gpu-index anno
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Returns (fits [N], shares [N, GD]) — shares = how many of the pod's GPU
-    shares land on each device. Non-GPU pods fit everywhere with zero shares."""
+    shares land on each device. Non-GPU pods fit everywhere with zero shares.
+
+    A non-empty `preset` mirrors AllocateGpuId's annotation short-circuit
+    (gpunodeinfo.go:247-253): the recorded assignment is honored verbatim
+    without re-checking per-device memory.
+    """
     n, gd = gpu_free.shape
     # Filter triggers on mem > 0 alone (open-gpu-share.go:53-57); a pod with
     # gpu-mem but no/zero gpu-count then fails AllocateGpuId on every node
@@ -61,4 +67,19 @@ def gpu_plan(
     has_dev = jnp.any(dev_exists, axis=1)
     fits = jnp.where(is_gpu_pod, node_total_ok & has_dev & valid_req & enough, True)
     shares = jnp.where(is_gpu_pod & fits[:, None], shares, 0.0)
+    if preset is not None:
+        has_preset = jnp.sum(preset) > 0
+        preset_fits = jnp.where(
+            is_gpu_pod, node_total_ok & has_dev & valid_req, True
+        )
+        fits = jnp.where(has_preset, preset_fits, fits)
+        shares = jnp.where(
+            has_preset,
+            jnp.where(
+                (is_gpu_pod & preset_fits)[:, None],
+                jnp.broadcast_to(preset, (n, gd)),
+                0.0,
+            ),
+            shares,
+        )
     return fits, shares

@@ -1,0 +1,345 @@
+"""Capacity planner: minimum node additions for a full deployment.
+
+Mirrors `Applier.Run` (`pkg/apply/apply.go:88-245`): load apps + cluster +
+new-node template, then find the smallest number of template-node clones that
+lets every pod schedule, subject to the MaxCPU/MaxMemory/MaxVG average
+utilization caps (`apply.go:580-666`), with "adding nodes can never help"
+diagnostics (`apply.go:213-231` → `utils.NodeShouldRunPod`,
+`utils.MeetResourceRequests`).
+
+Search strategy: the reference walks i = 0,1,2,…,100 re-simulating from
+scratch each time (`apply.go:183`, `MaxNumNewNode=100`). Feasibility is
+monotone in the clone count (clones only add capacity), so the default here is
+a doubling probe + binary search — O(log N) full simulations instead of O(N) —
+with `search="linear"` available for reference-exact behavior.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .. import constants as C
+from ..api import simulate
+from ..config import AppInfo, SimonConfig, validate_config
+from ..core.match import node_should_run_pod
+from ..core.objects import (
+    AppResource,
+    ResourceTypes,
+    SimulateResult,
+    name_of,
+    namespace_of,
+    pod_requests,
+    set_label,
+)
+from ..core.quantity import parse_quantity
+from ..io.cluster import (
+    create_cluster_resource_from_client,
+    create_cluster_resource_from_cluster_config,
+    match_and_set_local_storage_annotation_on_node,
+)
+from ..io.yaml_loader import get_objects_from_yaml_content, get_yaml_content_from_directory
+from ..workloads.expand import make_valid_node_by_node, new_daemon_pod
+
+
+@dataclass
+class PlanResult:
+    success: bool
+    nodes_added: int
+    result: Optional[SimulateResult]
+    message: str = ""
+    # per-candidate-count unscheduled totals, for transparency
+    probes: Dict[int, int] = field(default_factory=dict)
+
+
+def new_fake_nodes(template: dict, count: int) -> List[dict]:
+    """Clone the template node `count` times as simon-%02d with the new-node
+    label (`pkg/apply/apply.go:286-303`)."""
+    nodes = []
+    for i in range(count):
+        hostname = f"{C.NEW_NODE_NAME_PREFIX}-{i:02d}"
+        node = make_valid_node_by_node(template, hostname)
+        set_label(node, C.LABEL_NEW_NODE, "")
+        nodes.append(node)
+    return nodes
+
+
+def _env_cap(name: str) -> int:
+    """0-100 percentage cap from env; out-of-range falls back to 100
+    (`apply.go:580-610`)."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return 100
+    val = int(raw)
+    return 100 if (val > 100 or val < 0) else val
+
+
+def satisfy_resource_setting(result: SimulateResult) -> (bool, str):
+    """Average cluster occupancy caps MaxCPU/MaxMemory/MaxVG
+    (`apply.go:580-666`)."""
+    import json
+
+    max_cpu = _env_cap(C.ENV_MAX_CPU)
+    max_mem = _env_cap(C.ENV_MAX_MEMORY)
+    max_vg = _env_cap(C.ENV_MAX_VG)
+
+    total = {"cpu": 0.0, "memory": 0.0}
+    used = {"cpu": 0.0, "memory": 0.0}
+    vg_cap = vg_req = 0.0
+    for status in result.node_status:
+        alloc = ((status.node.get("status") or {}).get("allocatable")) or {}
+        total["cpu"] += parse_quantity(alloc.get("cpu"))
+        total["memory"] += parse_quantity(alloc.get("memory"))
+        for pod in status.pods:
+            req = pod_requests(pod)
+            used["cpu"] += req.get("cpu", 0.0)
+            used["memory"] += req.get("memory", 0.0)
+        anno = (status.node.get("metadata") or {}).get("annotations") or {}
+        raw = anno.get(C.ANNO_NODE_LOCAL_STORAGE)
+        if raw:
+            storage = json.loads(raw)
+            for vg in storage.get("vgs") or []:
+                vg_cap += parse_quantity(vg.get("capacity"))
+                vg_req += parse_quantity(vg.get("requested"))
+
+    cpu_rate = int(used["cpu"] / total["cpu"] * 100) if total["cpu"] else 0
+    mem_rate = int(used["memory"] / total["memory"] * 100) if total["memory"] else 0
+    if cpu_rate > max_cpu:
+        return False, (
+            f"the average occupancy rate({cpu_rate}%) of cpu goes beyond "
+            f"the env setting({max_cpu}%)\n"
+        )
+    if mem_rate > max_mem:
+        return False, (
+            f"the average occupancy rate({mem_rate}%) of memory goes beyond "
+            f"the env setting({max_mem}%)\n"
+        )
+    if vg_cap:
+        vg_rate = int(vg_req / vg_cap * 100)
+        if vg_rate > max_vg:
+            return False, (
+                f"the average occupancy rate({vg_rate}%) of vg goes beyond "
+                f"the env setting({max_vg}%)\n"
+            )
+    return True, ""
+
+
+def meet_resource_requests(node: dict, pod: dict, daemon_sets: Sequence[dict]) -> bool:
+    """Could the new-node template EVER hold this pod, once its daemonsets are
+    accounted for? (`pkg/utils/utils.go:768-818`).
+
+    Reference quirk preserved: the probe daemon pod is pinned to a node named
+    `simon` (`utils.go:777` passes NewNodeNamePrefix as the node name), so
+    unless the template node is literally named "simon" the matchFields pin
+    fails NodeShouldRunPod and daemonset overhead contributes nothing.
+    """
+    import json
+
+    total_cpu = total_mem = 0.0
+    for ds in daemon_sets:
+        daemon_pod = new_daemon_pod(ds, C.NEW_NODE_NAME_PREFIX)
+        if node_should_run_pod(node, daemon_pod):
+            req = pod_requests(daemon_pod)
+            total_cpu += req.get("cpu", 0.0)
+            total_mem += req.get("memory", 0.0)
+    req = pod_requests(pod)
+    total_cpu += req.get("cpu", 0.0)
+    total_mem += req.get("memory", 0.0)
+    alloc = ((node.get("status") or {}).get("allocatable")) or {}
+    if total_cpu > parse_quantity(alloc.get("cpu")) or total_mem > parse_quantity(
+        alloc.get("memory")
+    ):
+        return False
+    # local storage: sum of LVM claims must fit the largest VG
+    anno = (node.get("metadata") or {}).get("annotations") or {}
+    raw = anno.get(C.ANNO_NODE_LOCAL_STORAGE)
+    if not raw:
+        return True
+    storage = json.loads(raw)
+    vg_max = max(
+        [parse_quantity(vg.get("capacity")) for vg in storage.get("vgs") or []] or [0.0]
+    )
+    pod_anno = (pod.get("metadata") or {}).get("annotations") or {}
+    pvc_raw = pod_anno.get(C.ANNO_POD_LOCAL_STORAGE)
+    pvc_sum = 0.0
+    if pvc_raw:
+        for vol in (json.loads(pvc_raw) or {}).get("volumes") or []:
+            if vol.get("kind") == "LVM":
+                pvc_sum += parse_quantity(vol.get("size"))
+    return pvc_sum <= vg_max
+
+
+def plan_capacity(
+    cluster: ResourceTypes,
+    apps: Sequence[AppResource],
+    new_node: dict,
+    max_new_nodes: int = C.MAX_NUM_NEW_NODE,
+    extended_resources: Sequence[str] = (),
+    search: str = "binary",
+    progress: Optional[Callable[[str], None]] = None,
+) -> PlanResult:
+    """Find the minimum clone count of `new_node` that deploys everything."""
+    say = progress or (lambda s: None)
+    probes: Dict[int, int] = {}
+    all_daemon_sets = list(cluster.daemon_sets)
+    for app in apps:
+        all_daemon_sets += app.resource.daemon_sets
+
+    def run(i: int) -> SimulateResult:
+        say(f"add {i} node(s)")
+        trial = ResourceTypes(**{k: list(v) for k, v in vars(cluster).items()})
+        trial.nodes = list(cluster.nodes) + new_fake_nodes(new_node, i)
+        result = simulate(trial, apps, extended_resources=extended_resources)
+        probes[i] = len(result.unscheduled_pods)
+        return result
+
+    def diagnose(result: SimulateResult) -> Optional[str]:
+        """Return a message when adding template nodes can never help
+        (`apply.go:213-231`)."""
+        for unsched in result.unscheduled_pods:
+            pod = unsched.pod
+            if not node_should_run_pod(new_node, pod):
+                return (
+                    f"failed to schedule pod {namespace_of(pod)}/{name_of(pod)}: "
+                    "the pod cannot be scheduled successfully by adding node: "
+                    "pod does not fit new node affinity or taints"
+                )
+            if not meet_resource_requests(new_node, pod, all_daemon_sets):
+                return (
+                    f"failed to schedule pod {namespace_of(pod)}/{name_of(pod)}: "
+                    "new node cannot meet resource requests of pod: the total "
+                    "requested resource of daemonset pods in new node is too large"
+                )
+        return None
+
+    def finish(i: int, result: SimulateResult) -> PlanResult:
+        ok, reason = satisfy_resource_setting(result)
+        if not ok:
+            return PlanResult(False, i, result, reason, probes)
+        return PlanResult(True, i, result, "Success!", probes)
+
+    result = run(0)
+    if not result.unscheduled_pods:
+        return finish(0, result)
+    msg = diagnose(result)
+    if msg:
+        return PlanResult(False, 0, result, msg, probes)
+
+    if search == "linear":
+        for i in range(1, max_new_nodes):
+            result = run(i)
+            if not result.unscheduled_pods:
+                return finish(i, result)
+            msg = diagnose(result)
+            if msg:
+                return PlanResult(False, i, result, msg, probes)
+        return PlanResult(
+            False,
+            max_new_nodes,
+            result,
+            f"we have added {max_new_nodes} nodes but it still failed!!",
+            probes,
+        )
+
+    # doubling probe then binary search (feasibility monotone in clone count)
+    hi, hi_result = None, None
+    probe = 1
+    while probe < max_new_nodes:
+        result = run(probe)
+        if not result.unscheduled_pods:
+            hi, hi_result = probe, result
+            break
+        msg = diagnose(result)
+        if msg:
+            return PlanResult(False, probe, result, msg, probes)
+        probe *= 2
+    if hi is None:
+        probe = max_new_nodes
+        result = run(probe)
+        if result.unscheduled_pods:
+            return PlanResult(
+                False,
+                max_new_nodes,
+                result,
+                f"we have added {max_new_nodes} nodes but it still failed!!",
+                probes,
+            )
+        hi, hi_result = probe, result
+    lo = hi // 2  # lowest infeasible known is hi//2 (or 0)
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        result = run(mid)
+        if result.unscheduled_pods:
+            lo = mid
+        else:
+            hi, hi_result = mid, result
+    return finish(hi, hi_result)
+
+
+@dataclass
+class ApplierOptions:
+    """CLI options (`pkg/apply/apply.go:32-38`)."""
+
+    simon_config: str = ""
+    default_scheduler_config: str = ""
+    use_greed: bool = False
+    interactive: bool = False
+    extended_resources: Sequence[str] = ()
+    search: str = "binary"
+
+
+class Applier:
+    """End-to-end capacity-planning run (`pkg/apply/apply.go:55-245`)."""
+
+    def __init__(self, opts: ApplierOptions):
+        self.opts = opts
+        self.config = SimonConfig.from_file(opts.simon_config)
+        validate_config(self.config, opts.default_scheduler_config)
+
+    def load_apps(self) -> List[AppResource]:
+        apps = []
+        for info in self.config.app_list:
+            if info.chart:
+                from .. import chart as chart_mod
+
+                content = chart_mod.process_chart(info.name, info.path)
+            else:
+                content = get_yaml_content_from_directory(info.path)
+            apps.append(
+                AppResource(name=info.name, resource=get_objects_from_yaml_content(content))
+            )
+        return apps
+
+    def load_cluster(self) -> ResourceTypes:
+        if self.config.cluster.kube_config:
+            return create_cluster_resource_from_client(self.config.cluster.kube_config)
+        return create_cluster_resource_from_cluster_config(self.config.cluster.custom_config)
+
+    def load_new_node(self) -> dict:
+        content = get_yaml_content_from_directory(self.config.new_node)
+        resources = get_objects_from_yaml_content(content)
+        if not resources.nodes:
+            raise ValueError(f"the new node directory({self.config.new_node}) has no nodes")
+        match_and_set_local_storage_annotation_on_node(resources.nodes, self.config.new_node)
+        return resources.nodes[0]
+
+    def run(
+        self,
+        select_apps: Optional[Callable[[List[str]], List[str]]] = None,
+        progress: Optional[Callable[[str], None]] = None,
+    ) -> PlanResult:
+        apps = self.load_apps()
+        if select_apps is not None:
+            chosen = set(select_apps([a.name for a in apps]))
+            apps = [a for a in apps if a.name in chosen]
+        cluster = self.load_cluster()
+        new_node = self.load_new_node()
+        return plan_capacity(
+            cluster,
+            apps,
+            new_node,
+            extended_resources=self.opts.extended_resources,
+            search=self.opts.search,
+            progress=progress,
+        )

@@ -131,6 +131,52 @@ class TestGpuShareFixtures:
         assert annotations_of(placements["big"][1])[C.ANNO_POD_GPU_INDEX] == "0"
         assert annotations_of(placements["small"][1])[C.ANNO_POD_GPU_INDEX] == "0"
 
+    def test_preexisting_gpu_index_annotation_is_honored(self):
+        # a running pod from a snapshot keeps its recorded device assignment
+        # (AllocateGpuId short-circuit) and its usage blocks later pods
+        node = make_fake_node(
+            "g0",
+            "64",
+            "256Gi",
+            with_node_allocatable(
+                {"alibabacloud.com/gpu-mem": "32Gi", "alibabacloud.com/gpu-count": "2"}
+            ),
+        )
+        running = make_fake_pod(
+            "running",
+            "default",
+            "1",
+            "1Gi",
+            with_pod_annotations(
+                {
+                    C.ANNO_POD_GPU_MEM: "6Gi",
+                    C.ANNO_POD_GPU_COUNT: "2",
+                    C.ANNO_POD_GPU_INDEX: "0-1",
+                }
+            ),
+        )
+        running["spec"]["nodeName"] = "g0"
+        cluster = ResourceTypes()
+        cluster.nodes = [node]
+        cluster.pods = [running]
+        # with 6Gi used on EACH device (10Gi idle each), a 12Gi pod can't fit;
+        # a greedy re-plan would have stacked both shares on dev 0 and left
+        # dev 1 free at 16Gi
+        res = ResourceTypes()
+        res.pods = [
+            make_fake_pod(
+                "newpod",
+                "default",
+                "1",
+                "1Gi",
+                with_pod_annotations({C.ANNO_POD_GPU_MEM: "12Gi", C.ANNO_POD_GPU_COUNT: "1"}),
+            )
+        ]
+        result = simulate(cluster, [AppResource(name="app", resource=res)])
+        assert len(result.unscheduled_pods) == 1
+        _, placed = _placements(result)["running"]
+        assert annotations_of(placed)[C.ANNO_POD_GPU_INDEX] == "0-1"
+
     def test_gpu_mem_without_count_is_unschedulable(self):
         # GpuSharePlugin.Filter triggers on gpu-mem alone; AllocateGpuId then
         # fails for reqGpuNum<=0 → unschedulable everywhere
