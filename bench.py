@@ -1,0 +1,186 @@
+"""Headline benchmark: placement throughput of the scan engine vs a serial
+per-pod baseline with the reference's algorithmic shape.
+
+The reference publishes no numbers (BASELINE.md); its cost model is a strictly
+serial pod loop doing an O(nodes) filter+score per pod
+(`pkg/simulator/simulator.go:219-244`, `core/generic_scheduler.go:271-341`,
+`PercentageOfNodesToScore=100`). The baseline below reproduces exactly that
+loop shape host-side with vectorized numpy per pod — a *generous* stand-in
+(numpy's C loops beat the Go plugin chain per node).
+
+Prints ONE JSON line:
+  {"metric": "pods_per_sec_100k_nodes", "value": N, "unit": "pods/s",
+   "vs_baseline": ours/baseline}
+
+Env knobs: SIMTPU_BENCH_NODES (default 100000), SIMTPU_BENCH_PODS (default
+20000), SIMTPU_BENCH_BASELINE_PODS (default 300 — baseline is timed on a
+slice and expressed as pods/s).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_problem(n_nodes: int, n_pods: int):
+    import jax.numpy as jnp
+
+    from simtpu.core.tensorize import Tensorizer
+    from simtpu.core.objects import set_label
+    from simtpu import constants as C
+    from simtpu.engine.scan import statics_from
+    from simtpu.engine.state import build_state
+    from simtpu.synth import synth_apps, synth_cluster
+    from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
+
+    t0 = time.perf_counter()
+    cluster = synth_cluster(n_nodes, seed=3, zones=16, taint_frac=0.1)
+    apps = synth_apps(
+        n_pods,
+        seed=4,
+        zones=16,
+        pods_per_deployment=200,
+        selector_frac=0.2,
+        toleration_frac=0.1,
+        anti_affinity_frac=0.2,
+    )
+    pods = []
+    for app in apps:
+        expanded = get_valid_pods_exclude_daemonset(app.resource)
+        for pod in expanded:
+            set_label(pod, C.LABEL_APP_NAME, app.name)
+        pods.extend(expanded)
+    gen_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    tensorizer = Tensorizer(cluster.nodes)
+    batch = tensorizer.add_pods(pods)
+    tensors = tensorizer.freeze()
+    tensorize_s = time.perf_counter() - t0
+
+    statics = statics_from(tensors)
+    r = tensors.alloc.shape[1]
+    req = batch.req
+    if req.shape[1] < r:
+        req = np.pad(req, ((0, 0), (0, r - req.shape[1])))
+    state = build_state(
+        tensors,
+        np.zeros(0, np.int32),
+        np.zeros(0, np.int32),
+        np.zeros((0, r), np.float32),
+        None,
+    )
+    ext = batch.ext
+    pod_arrays = (
+        jnp.asarray(batch.group),
+        jnp.asarray(req, jnp.float32),
+        jnp.asarray(batch.pin, jnp.int32),
+        jnp.asarray(batch.forced),
+        jnp.asarray(ext["lvm_size"]),
+        jnp.asarray(ext["lvm_vg"]),
+        jnp.asarray(ext["dev_size"]),
+        jnp.asarray(ext["dev_media"]),
+        jnp.asarray(ext["gpu_mem"]),
+        jnp.asarray(ext["gpu_count"]),
+        jnp.asarray(ext["gpu_preset"]),
+    )
+    return tensors, batch, statics, state, pod_arrays, req, gen_s, tensorize_s
+
+
+def time_engine(statics, state, pod_arrays) -> float:
+    """Seconds for one full placement scan (compiled, post-warmup)."""
+    import jax
+    from functools import partial
+    from simtpu.engine.scan import schedule_step
+
+    @jax.jit
+    def run(statics, state, pods):
+        return jax.lax.scan(partial(schedule_step, statics), state, pods)
+
+    out = run(statics, state, pod_arrays)  # compile + warm
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = run(statics, state, pod_arrays)
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0, np.asarray(out[1][0])
+
+
+def time_serial_baseline(tensors, batch, req, limit: int) -> float:
+    """Reference-shaped serial loop: per pod, filter+score every node, argmax,
+    update. Returns seconds-per-pod."""
+    free = tensors.alloc.astype(np.float64).copy()
+    alloc = tensors.alloc.astype(np.float64)
+    static_mask = tensors.static_mask
+    n_pods = min(limit, len(batch.group))
+    t0 = time.perf_counter()
+    for i in range(n_pods):
+        g = int(batch.group[i])
+        r = req[i].astype(np.float64)
+        mask = static_mask[g] & np.all(free >= r, axis=1)
+        if not mask.any():
+            continue
+        with np.errstate(divide="ignore", invalid="ignore"):
+            frac = np.where(alloc > 0, (free - r) / alloc, 0.0)
+        least = frac.mean(axis=1) * 100.0  # NodeResourcesLeastAllocated
+        balance = (1.0 - np.abs(frac[:, 0] - frac[:, 1])) * 100.0
+        post = np.where(alloc > 0, (alloc - free + r) / alloc, 0.0)
+        dominant = post.max(axis=1)  # Simon dominant-share score
+        score = least + balance + (1.0 - dominant) * 100.0
+        score[~mask] = -np.inf
+        chosen = int(np.argmax(score))
+        free[chosen] -= r
+    return (time.perf_counter() - t0) / max(n_pods, 1)
+
+
+def main() -> int:
+    n_nodes = int(os.environ.get("SIMTPU_BENCH_NODES", 20_000))
+    n_pods = int(os.environ.get("SIMTPU_BENCH_PODS", 5_000))
+    base_pods = int(os.environ.get("SIMTPU_BENCH_BASELINE_PODS", 300))
+
+    import jax
+
+    (
+        tensors,
+        batch,
+        statics,
+        state,
+        pod_arrays,
+        req,
+        gen_s,
+        tensorize_s,
+    ) = build_problem(n_nodes, n_pods)
+
+    engine_s, placed_nodes = time_engine(statics, state, pod_arrays)
+    placed = int((placed_nodes >= 0).sum())
+    pods_per_sec = len(batch.group) / engine_s
+
+    base_spp = time_serial_baseline(tensors, batch, req, base_pods)
+    base_pods_per_sec = 1.0 / base_spp if base_spp > 0 else float("inf")
+
+    print(
+        f"# nodes={n_nodes} pods={n_pods} placed={placed} "
+        f"gen={gen_s:.1f}s tensorize={tensorize_s:.1f}s scan={engine_s:.3f}s "
+        f"serial-baseline={base_pods_per_sec:.0f} pods/s "
+        f"backend={jax.default_backend()}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"pods_per_sec_{n_nodes//1000}k_nodes",
+                "value": round(pods_per_sec, 1),
+                "unit": "pods/s",
+                "vs_baseline": round(pods_per_sec / base_pods_per_sec, 2),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,8 +47,9 @@ def _sort_app_pods(pods: List[dict]) -> List[dict]:
 class Simulator:
     """One in-memory cluster simulation."""
 
-    def __init__(self, extra_resources: Sequence[str] = ()):
+    def __init__(self, extra_resources: Sequence[str] = (), engine_factory=None):
         self._extra_resources = extra_resources
+        self._engine_factory = engine_factory or Engine
         self._tensorizer: Optional[Tensorizer] = None
         self._engine: Optional[Engine] = None
         self._nodes: List[dict] = []
@@ -66,7 +67,7 @@ class Simulator:
         self._tensorizer = Tensorizer(
             self._nodes, self._extra_resources, storage_classes=self._storage_classes
         )
-        self._engine = Engine(self._tensorizer)
+        self._engine = self._engine_factory(self._tensorizer)
         self._schedule_pods(cluster.pods)
         return self._result()
 
@@ -204,12 +205,15 @@ def simulate(
     cluster: ResourceTypes,
     apps: Sequence[AppResource] = (),
     extended_resources: Sequence[str] = (),
+    engine_factory=None,
 ) -> SimulateResult:
     """One-shot simulation (`pkg/simulator/core.go:64-103`): expand cluster
     workloads, run the cluster, then schedule each app in configured order.
     Unscheduled pods accumulate across the cluster and every app; node status
-    reflects the final cluster."""
-    sim = Simulator(extra_resources=extended_resources)
+    reflects the final cluster. Pass
+    `engine_factory=lambda t: ShardedEngine(t, mesh)` to run the scan with the
+    node axis sharded over a device mesh (simtpu/parallel)."""
+    sim = Simulator(extra_resources=extended_resources, engine_factory=engine_factory)
     cluster = ResourceTypes(**{k: list(v) for k, v in vars(cluster).items()})
     cluster_pods = get_valid_pods_exclude_daemonset(cluster)
     for ds in cluster.daemon_sets:

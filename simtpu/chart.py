@@ -1,0 +1,392 @@
+"""Offline Helm chart rendering.
+
+Mirrors `pkg/chart/chart.go:18-118` (`ProcessChart` → load, override name,
+coalesce values, render templates offline, drop NOTES.txt, drop hooks, sort
+manifests in Helm's InstallOrder, drop empties). The reference links the Helm
+v3 engine; no helm binary exists in this image, so this module implements the
+Go-template subset Helm charts actually use for manifests: field access
+(`.Values.a.b`, `$.` root), `if / else if / else / end`, comments, pipelines,
+and the common sprig-lite functions (`int`, `quote`, `default`, `indent`,
+`nindent`, `toYaml`, `upper`, `lower`, `trim`, `printf`).
+
+Unsupported constructs raise `ChartRenderError` naming the template file, so
+a chart outside the subset fails loudly rather than mis-rendering.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import re
+import tarfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+NOTES_SUFFIX = "NOTES.txt"
+
+# helm.sh/helm/v3/pkg/releaseutil/kind_sorter.go InstallOrder
+INSTALL_ORDER = [
+    "Namespace", "NetworkPolicy", "ResourceQuota", "LimitRange",
+    "PodSecurityPolicy", "PodDisruptionBudget", "ServiceAccount", "Secret",
+    "SecretList", "ConfigMap", "StorageClass", "PersistentVolume",
+    "PersistentVolumeClaim", "CustomResourceDefinition", "ClusterRole",
+    "ClusterRoleList", "ClusterRoleBinding", "ClusterRoleBindingList",
+    "Role", "RoleList", "RoleBinding", "RoleBindingList", "Service",
+    "DaemonSet", "Pod", "ReplicationController", "ReplicaSet", "Deployment",
+    "HorizontalPodAutoscaler", "StatefulSet", "Job", "CronJob", "Ingress",
+    "APIService",
+]
+_KIND_RANK = {k: i for i, k in enumerate(INSTALL_ORDER)}
+
+
+class ChartRenderError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Template engine (Go text/template subset)
+# ---------------------------------------------------------------------------
+
+_ACTION_RE = re.compile(r"\{\{(-?)\s*(.*?)\s*(-?)\}\}", re.DOTALL)
+
+
+class _Node:
+    pass
+
+
+class _Text(_Node):
+    def __init__(self, s):
+        self.s = s
+
+
+class _Expr(_Node):
+    def __init__(self, src):
+        self.src = src
+
+
+class _If(_Node):
+    def __init__(self):
+        # [(cond_src | None for else, [children])]
+        self.branches: List[Tuple[Optional[str], List[_Node]]] = []
+
+
+def _parse(template: str, where: str) -> List[_Node]:
+    """Split into text/action nodes, honoring {{- and -}} whitespace trim."""
+    pos = 0
+    tokens: List[Tuple[str, str]] = []  # ("text", s) | ("action", src)
+    for m in _ACTION_RE.finditer(template):
+        text = template[pos : m.start()]
+        if m.group(1) == "-":
+            text = text.rstrip()
+        if tokens and tokens[-1][0] == "trim-next":
+            tokens.pop()
+            text = text.lstrip()
+        tokens.append(("text", text))
+        tokens.append(("action", m.group(2)))
+        if m.group(3) == "-":
+            tokens.append(("trim-next", ""))
+        pos = m.end()
+    tail = template[pos:]
+    if tokens and tokens[-1][0] == "trim-next":
+        tokens.pop()
+        tail = tail.lstrip()
+    tokens.append(("text", tail))
+
+    root: List[_Node] = []
+    stack: List[Tuple[List[_Node], Optional[_If]]] = [(root, None)]
+    for kind, payload in tokens:
+        children = stack[-1][0]
+        if kind == "text":
+            if payload:
+                children.append(_Text(payload))
+            continue
+        src = payload
+        if src.startswith("/*"):
+            continue
+        if src.startswith("if "):
+            node = _If()
+            node.branches.append((src[3:].strip(), []))
+            children.append(node)
+            stack.append((node.branches[-1][1], node))
+        elif src.startswith("else if "):
+            _, node = stack.pop()
+            if node is None:
+                raise ChartRenderError(f"{where}: 'else if' outside if")
+            node.branches.append((src[8:].strip(), []))
+            stack.append((node.branches[-1][1], node))
+        elif src == "else":
+            _, node = stack.pop()
+            if node is None:
+                raise ChartRenderError(f"{where}: 'else' outside if")
+            node.branches.append((None, []))
+            stack.append((node.branches[-1][1], node))
+        elif src == "end":
+            _, node = stack.pop()
+            if node is None:
+                raise ChartRenderError(f"{where}: unmatched 'end'")
+        elif re.match(r"^(range|with|define|block|template|include)\b", src):
+            raise ChartRenderError(
+                f"{where}: unsupported template construct '{src.split()[0]}'"
+            )
+        else:
+            children.append(_Expr(src))
+    if len(stack) != 1:
+        raise ChartRenderError(f"{where}: unclosed 'if'")
+    return root
+
+
+def _tokenize_expr(src: str, where: str) -> List[str]:
+    out, i, n = [], 0, len(src)
+    while i < n:
+        c = src[i]
+        if c.isspace():
+            i += 1
+        elif c in "\"'`":
+            j = i + 1
+            while j < n and src[j] != c:
+                j += 2 if src[j] == "\\" else 1
+            out.append(src[i : j + 1])
+            i = j + 1
+        elif c == "|":
+            out.append("|")
+            i += 1
+        elif c == "(" or c == ")":
+            out.append(c)
+            i += 1
+        else:
+            j = i
+            while j < n and not src[j].isspace() and src[j] not in "|()":
+                j += 1
+            out.append(src[i:j])
+            i = j
+    return out
+
+
+def _lookup(path: str, ctx: dict, where: str):
+    cur: Any = ctx
+    for part in path.split(".")[1:]:  # leading "" from the dot
+        if not part:
+            continue
+        if isinstance(cur, dict):
+            cur = cur.get(part)
+        else:
+            cur = getattr(cur, part, None)
+        if cur is None:
+            return None
+    return cur
+
+
+def _to_yaml(v) -> str:
+    return yaml.safe_dump(v, default_flow_style=False).rstrip("\n")
+
+
+_FUNCS = {
+    "int": lambda a: int(float(a)) if a not in (None, "") else 0,
+    "quote": lambda a: '"%s"' % str(a).replace('"', '\\"'),
+    "squote": lambda a: "'%s'" % a,
+    "upper": lambda a: str(a).upper(),
+    "lower": lambda a: str(a).lower(),
+    "trim": lambda a: str(a).strip(),
+    "toYaml": _to_yaml,
+    "default": lambda d, v=None: v if _truthy(v) else d,
+    "indent": lambda n, s: "\n".join(" " * int(n) + l for l in str(s).splitlines()),
+    "nindent": lambda n, s: "\n" + "\n".join(" " * int(n) + l for l in str(s).splitlines()),
+    "printf": lambda fmt, *a: _go_printf(fmt, *a),
+    "not": lambda a: not _truthy(a),
+    "eq": lambda a, b: a == b,
+    "ne": lambda a, b: a != b,
+}
+
+
+def _go_printf(fmt, *args):
+    return re.sub(r"%[sdvq]", "{}", str(fmt)).format(*args)
+
+
+def _truthy(v) -> bool:
+    if v is None or v is False:
+        return False
+    if isinstance(v, (int, float)) and v == 0:
+        return False
+    if isinstance(v, (str, list, dict)) and len(v) == 0:
+        return False
+    return True
+
+
+def _eval_atom(tok: str, ctx: dict, where: str):
+    if tok.startswith(".") or tok.startswith("$."):
+        return _lookup(tok[1:] if tok.startswith("$") else tok, ctx, where)
+    if tok == "$" or tok == ".":
+        return ctx
+    if tok[:1] in "\"'`":
+        return tok[1:-1]
+    if tok in ("true", "false"):
+        return tok == "true"
+    if tok == "nil":
+        return None
+    try:
+        return int(tok)
+    except ValueError:
+        pass
+    try:
+        return float(tok)
+    except ValueError:
+        pass
+    return tok  # bare word (function name handled by caller)
+
+
+def _eval_stage(tokens: List[str], piped, ctx: dict, where: str):
+    """One pipeline stage: `fn a b` or a single atom; `piped` is appended as
+    the last argument (Go pipeline semantics)."""
+    if not tokens:
+        raise ChartRenderError(f"{where}: empty pipeline stage")
+    head = tokens[0]
+    if head in _FUNCS:
+        args = [_eval_atom(t, ctx, where) for t in tokens[1:]]
+        if piped is not _SENTINEL:
+            args.append(piped)
+        try:
+            return _FUNCS[head](*args)
+        except Exception as exc:
+            raise ChartRenderError(f"{where}: {head}(...) failed: {exc}") from exc
+    if len(tokens) != 1 or piped is not _SENTINEL:
+        raise ChartRenderError(f"{where}: unknown function '{head}'")
+    return _eval_atom(head, ctx, where)
+
+
+_SENTINEL = object()
+
+
+def _eval_expr(src: str, ctx: dict, where: str):
+    tokens = _tokenize_expr(src, where)
+    if "(" in tokens or ")" in tokens:
+        raise ChartRenderError(f"{where}: parenthesized expressions unsupported")
+    stages: List[List[str]] = [[]]
+    for tok in tokens:
+        if tok == "|":
+            stages.append([])
+        else:
+            stages[-1].append(tok)
+    val = _SENTINEL
+    for stage in stages:
+        val = _eval_stage(stage, val, ctx, where)
+    return val
+
+
+def _format(v) -> str:
+    if v is None:
+        return "<no value>"
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    if isinstance(v, float) and v == int(v):
+        return str(int(v))
+    return str(v)
+
+
+def _render_nodes(nodes: List[_Node], ctx: dict, out: List[str], where: str):
+    for node in nodes:
+        if isinstance(node, _Text):
+            out.append(node.s)
+        elif isinstance(node, _Expr):
+            out.append(_format(_eval_expr(node.src, ctx, where)))
+        elif isinstance(node, _If):
+            for cond, children in node.branches:
+                if cond is None or _truthy(_eval_expr(cond, ctx, where)):
+                    _render_nodes(children, ctx, out, where)
+                    break
+
+
+def render_template(template: str, ctx: dict, where: str = "<template>") -> str:
+    out: List[str] = []
+    _render_nodes(_parse(template, where), ctx, out, where)
+    return "".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Chart loading (directory or .tgz, like helm loader.Load)
+# ---------------------------------------------------------------------------
+
+
+def _load_chart_files(chart_path: str) -> Dict[str, str]:
+    """Relative path → content for Chart.yaml, values.yaml, templates/*."""
+    files: Dict[str, str] = {}
+    if os.path.isdir(chart_path):
+        for root, _dirs, names in os.walk(chart_path):
+            for name in names:
+                full = os.path.join(root, name)
+                rel = os.path.relpath(full, chart_path)
+                with open(full, "r", encoding="utf-8") as fh:
+                    files[rel] = fh.read()
+    elif tarfile.is_tarfile(chart_path):
+        with tarfile.open(chart_path) as tf:
+            for member in tf.getmembers():
+                if not member.isfile():
+                    continue
+                rel = member.name.split("/", 1)[-1]  # strip top-level dir
+                data = tf.extractfile(member).read().decode("utf-8")
+                files[rel] = data
+    else:
+        raise ChartRenderError(f"{chart_path}: not a chart directory or archive")
+    if "Chart.yaml" not in files:
+        raise ChartRenderError(f"{chart_path}: no Chart.yaml")
+    return files
+
+
+def process_chart(name: str, chart_path: str) -> List[str]:
+    """Render a chart into YAML manifest strings in InstallOrder.
+
+    `name` overrides the chart name (`chart.go:24`
+    `chartRequested.Metadata.Name = name`), which also becomes
+    `.Release.Name` (`chart.go:59` uses `chrt.Name()`).
+    """
+    files = _load_chart_files(chart_path)
+    metadata = yaml.safe_load(files["Chart.yaml"]) or {}
+    chart_type = metadata.get("type") or ""
+    if chart_type not in ("", "application"):
+        # checkIfInstallable (chart.go:45-51)
+        raise ChartRenderError(f"{chart_type} charts are not installable")
+    metadata["name"] = name
+    values = yaml.safe_load(files.get("values.yaml", "")) or {}
+    ctx = {
+        "Values": values,
+        "Chart": {**metadata, "Name": name},
+        "Release": {
+            "Name": name,
+            "Namespace": "default",
+            "Revision": 1,
+            "Service": "Helm",
+        },
+        "Capabilities": {"KubeVersion": {"Version": "v1.20.5", "Major": "1", "Minor": "20"}},
+    }
+
+    docs: List[Tuple[int, int, str]] = []  # (kind_rank, seq, content)
+    seq = 0
+    for rel in sorted(files):
+        parts = rel.split(os.sep)
+        if parts[0] != "templates" or len(parts) < 2:
+            continue
+        base = parts[-1]
+        if base.startswith("_") or rel.endswith(NOTES_SUFFIX):
+            continue  # partials and NOTES.txt (chart.go:92-103)
+        rendered = render_template(files[rel], ctx, where=rel)
+        for doc in re.split(r"(?m)^---\s*$", rendered):
+            if not doc.strip():
+                continue  # empty manifests removed (chart.go:105-107)
+            try:
+                obj = yaml.safe_load(doc)
+            except yaml.YAMLError as exc:
+                raise ChartRenderError(f"{rel}: rendered invalid YAML: {exc}") from exc
+            if not isinstance(obj, dict):
+                continue
+            annotations = (obj.get("metadata") or {}).get("annotations") or {}
+            if "helm.sh/hook" in annotations:
+                # the reference discards hooks (chart.go:110 drops the first
+                # return of SortManifests)
+                continue
+            rank = _KIND_RANK.get(obj.get("kind"), len(INSTALL_ORDER))
+            docs.append((rank, seq, doc.strip("\n")))
+            seq += 1
+    docs.sort(key=lambda t: (t[0], t[1]))
+    return [content for _rank, _seq, content in docs]

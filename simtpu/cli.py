@@ -69,7 +69,11 @@ def cmd_apply(args: argparse.Namespace) -> int:
     def progress(msg: str) -> None:
         print(f"{C.COLOR_YELLOW}{msg}{C.COLOR_RESET}")
 
-    plan = applier.run(select_apps=select, progress=progress)
+    try:
+        plan = applier.run(select_apps=select, progress=progress)
+    except (ValueError, FileNotFoundError) as exc:
+        print(exc, file=sys.stderr)
+        return 1
     if plan.success:
         print(f"{C.COLOR_GREEN}Success!{C.COLOR_RESET}")
         print(C.COLOR_GREEN, end="")
@@ -107,7 +111,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="path of scheduler-config overrides",
     )
     apply_p.add_argument(
-        "-g", "--use-greed", action="store_true", help="use greed algorithm to queue pods"
+        "-g",
+        "--use-greed",
+        action="store_true",
+        # reference-parity no-op: the flag exists upstream (`cmd/apply/
+        # apply.go:33`) but GreedQueue is never constructed outside tests —
+        # ScheduleApp always sorts by Affinity+Toleration only
+        # (`pkg/simulator/simulator.go:172-176`)
+        help="use greed algorithm to queue pods (accepted for parity; the "
+        "reference never wires this to its scheduler either)",
     )
     apply_p.add_argument(
         "-i", "--interactive", action="store_true", help="interactively choose apps"

@@ -263,6 +263,9 @@ def stack_demands(demands: List[PodExtendedDemand], n_gpu_devices: int = 1) -> d
         out["gpu_mem"][i] = d.gpu_mem
         out["gpu_count"][i] = d.gpu_count
         for dev_id in d.gpu_preset:
+            # device ids beyond the cluster's device table are silently
+            # ignored, exactly like the reference's guarded map lookup
+            # (`gpunodeinfo.go:108-110` `if dev, found := n.devs[idx]; found`)
             if 0 <= dev_id < gd:
                 out["gpu_preset"][i, dev_id] += 1.0
     return out

@@ -80,6 +80,38 @@ class StaticArrays(NamedTuple):
     sdev_media: jnp.ndarray  # [N, SD]
     gpu_dev_exists: jnp.ndarray  # [N, GD]
     gpu_total: jnp.ndarray  # [N]
+    # candidate-cluster membership: False rows are "not in this what-if
+    # cluster" (used by the batched capacity sweep, simtpu/parallel/sweep.py,
+    # which vmaps this field over candidate node counts)
+    node_valid: jnp.ndarray  # [N]
+
+
+def build_pod_arrays(batch: PodBatch, n_resources: int):
+    """Pad the batch's request matrix to the cluster resource vocabulary and
+    stack the per-pod arrays in the order `schedule_step` unpacks them.
+
+    The single source of truth for the scan's pod-tuple layout — used by
+    Engine.place, the batched sweep, the bench, and the graft entry.
+    Returns (padded_req, pods_tuple).
+    """
+    req = batch.req
+    if req.shape[1] < n_resources:
+        req = np.pad(req, ((0, 0), (0, n_resources - req.shape[1])))
+    ext = batch.ext
+    pods = (
+        jnp.asarray(batch.group),
+        jnp.asarray(req, jnp.float32),
+        jnp.asarray(batch.pin, jnp.int32),
+        jnp.asarray(batch.forced),
+        jnp.asarray(ext["lvm_size"]),
+        jnp.asarray(ext["lvm_vg"]),
+        jnp.asarray(ext["dev_size"]),
+        jnp.asarray(ext["dev_media"]),
+        jnp.asarray(ext["gpu_mem"]),
+        jnp.asarray(ext["gpu_count"]),
+        jnp.asarray(ext["gpu_preset"]),
+    )
+    return req, pods
 
 
 def statics_from(tensors: ClusterTensors) -> StaticArrays:
@@ -103,6 +135,7 @@ def statics_from(tensors: ClusterTensors) -> StaticArrays:
         sdev_media=jnp.asarray(ext.sdev_media, jnp.int32),
         gpu_dev_exists=jnp.asarray(ext.gpu_dev_total > 0),
         gpu_total=jnp.asarray(ext.gpu_total, jnp.float32),
+        node_valid=jnp.ones(tensors.alloc.shape[0], bool),
     )
 
 
@@ -129,7 +162,7 @@ def schedule_step(
     static_m = statics.static_mask[g]
     # pin: -1 = unpinned, -2 = pinned to a nonexistent node (matches nothing)
     pin_m = jnp.where(pin >= 0, node_ids == pin, pin > -2)
-    m_static = static_m & pin_m
+    m_static = static_m & pin_m & statics.node_valid
     m_res = m_static & resources_fit(state.free, req)
 
     # Open-Local storage (plugin Filter, open-local.go:50-91): pods that need
@@ -293,6 +326,11 @@ class Engine:
         }
         self.last_state: SchedState = None
 
+    def _dispatch(self, statics: StaticArrays, state: SchedState, pods):
+        """Run the compiled scan. `ShardedEngine` (simtpu/parallel) overrides
+        this to lay the node axis out across a device mesh."""
+        return _run_scan(statics, state, pods)
+
     def place(self, batch: PodBatch):
         """Schedule one batch.
 
@@ -302,9 +340,7 @@ class Engine:
         """
         tensors = self.tensorizer.freeze()
         r = tensors.alloc.shape[1]
-        req = batch.req
-        if req.shape[1] < r:
-            req = np.pad(req, ((0, 0), (0, r - req.shape[1])))
+        req, pods = build_pod_arrays(batch, r)
         state = build_state(
             tensors,
             np.asarray(self.placed_group, np.int32),
@@ -318,20 +354,7 @@ class Engine:
         )
         statics = statics_from(tensors)
         ext = batch.ext
-        pods = (
-            jnp.asarray(batch.group),
-            jnp.asarray(req, jnp.float32),
-            jnp.asarray(batch.pin, jnp.int32),
-            jnp.asarray(batch.forced),
-            jnp.asarray(ext["lvm_size"]),
-            jnp.asarray(ext["lvm_vg"]),
-            jnp.asarray(ext["dev_size"]),
-            jnp.asarray(ext["dev_media"]),
-            jnp.asarray(ext["gpu_mem"]),
-            jnp.asarray(ext["gpu_count"]),
-            jnp.asarray(ext["gpu_preset"]),
-        )
-        final_state, (nodes, reasons, lvm_alloc, dev_take, gpu_shares) = _run_scan(
+        final_state, (nodes, reasons, lvm_alloc, dev_take, gpu_shares) = self._dispatch(
             statics, state, pods
         )
         self.last_state = final_state

@@ -19,7 +19,7 @@ from typing import Tuple
 import jax.numpy as jnp
 
 MAX_LOCAL_SCORE = 10.0
-_BIG = jnp.float32(3.4e38)
+_BIG = 3.4e38  # finite stand-in for +inf (module-level jnp would init the backend at import)
 
 
 def lvm_plan(

@@ -1,0 +1,26 @@
+"""Multi-chip execution: device meshes, node-axis sharding, batched sweeps.
+
+SURVEY.md §2.3 mapping — the reference's 16-goroutine node loop and serial
+candidate-size loop become two mesh axes:
+
+- "nodes": cluster-state arrays sharded over ICI (`sharded.ShardedEngine`);
+- "sweep": capacity-planner candidate counts over chips/hosts
+  (`sweep.plan_capacity_batched`).
+"""
+
+from .mesh import NODE_AXIS, SWEEP_AXIS, make_mesh, node_shard_count
+from .sharded import ShardedEngine, build_sharded_scan, pad_state, pad_statics
+from .sweep import plan_capacity_batched, sweep_feasibility
+
+__all__ = [
+    "NODE_AXIS",
+    "SWEEP_AXIS",
+    "ShardedEngine",
+    "build_sharded_scan",
+    "make_mesh",
+    "node_shard_count",
+    "pad_state",
+    "pad_statics",
+    "plan_capacity_batched",
+    "sweep_feasibility",
+]

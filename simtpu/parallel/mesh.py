@@ -1,0 +1,65 @@
+"""Device-mesh construction for the sharded simulator.
+
+The reference's only intra-cycle parallelism is 16 goroutines chunking the
+node loop (`vendor/k8s.io/kubernetes/pkg/scheduler/internal/parallelize/
+parallelism.go:27`, used from `core/generic_scheduler.go:292,333`). On TPU the
+node axis becomes a sharded tensor dimension instead: a 2-D logical mesh
+
+    ("sweep", "nodes")
+
+where "nodes" shards cluster-state arrays across ICI (filter = elementwise
+mask on the local shard, select = cross-shard argmax collective) and "sweep"
+is the embarrassingly-parallel candidate-cluster-size axis of the capacity
+planner (`pkg/apply/apply.go:183`'s 0..100 loop, run as a batch instead).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SWEEP_AXIS = "sweep"
+NODE_AXIS = "nodes"
+
+
+def make_mesh(
+    devices: Optional[Sequence] = None,
+    sweep: int = 1,
+    n_devices: Optional[int] = None,
+) -> Mesh:
+    """Build the ("sweep", "nodes") mesh over `devices`.
+
+    `sweep` devices are dedicated to the candidate-size axis; the rest of the
+    chips form the node-sharding axis. With sweep=1 (default) all chips shard
+    the node axis — the right layout for a single large simulation.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    devices = list(devices)
+    if len(devices) % sweep:
+        raise ValueError(f"{len(devices)} devices not divisible by sweep={sweep}")
+    grid = np.asarray(devices).reshape(sweep, len(devices) // sweep)
+    return Mesh(grid, (SWEEP_AXIS, NODE_AXIS))
+
+
+def node_sharding(mesh: Mesh, rank_after_node: int = 0) -> NamedSharding:
+    """Sharding for an array whose LEADING axis is the node axis."""
+    return NamedSharding(mesh, P(NODE_AXIS, *([None] * rank_after_node)))
+
+
+def trailing_node_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a [G, N]-shaped array (node axis last)."""
+    return NamedSharding(mesh, P(None, NODE_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def node_shard_count(mesh: Mesh) -> int:
+    return mesh.shape[NODE_AXIS]

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from .. import constants as C
 from ..api import simulate
@@ -213,68 +213,76 @@ def plan_capacity(
                 )
         return None
 
-    def finish(i: int, result: SimulateResult) -> PlanResult:
+    def feasible(result: SimulateResult) -> Tuple[bool, str]:
+        """Candidate acceptance = everything scheduled AND occupancy caps
+        hold. The reference treats a cap miss like infeasibility: it prints
+        the reason and keeps adding nodes (`apply.go:199-207`) — more
+        capacity lowers the average rate, so this is monotone in the clone
+        count just like schedulability."""
+        if result.unscheduled_pods:
+            return False, ""
         ok, reason = satisfy_resource_setting(result)
         if not ok:
-            return PlanResult(False, i, result, reason, probes)
-        return PlanResult(True, i, result, "Success!", probes)
+            say(reason.rstrip("\n"))
+        return ok, reason
 
+    fail_msg = f"we have added {max_new_nodes} nodes but it still failed!!"
     result = run(0)
-    if not result.unscheduled_pods:
-        return finish(0, result)
-    msg = diagnose(result)
-    if msg:
-        return PlanResult(False, 0, result, msg, probes)
+    ok, _ = feasible(result)
+    if ok:
+        return PlanResult(True, 0, result, "Success!", probes)
+    if result.unscheduled_pods:
+        msg = diagnose(result)
+        if msg:
+            return PlanResult(False, 0, result, msg, probes)
 
+    # the reference's loop is `for i := 0; i < MaxNumNewNode; i++`
+    # (apply.go:183) — the largest candidate ever tried is max_new_nodes-1
     if search == "linear":
         for i in range(1, max_new_nodes):
             result = run(i)
-            if not result.unscheduled_pods:
-                return finish(i, result)
-            msg = diagnose(result)
-            if msg:
-                return PlanResult(False, i, result, msg, probes)
-        return PlanResult(
-            False,
-            max_new_nodes,
-            result,
-            f"we have added {max_new_nodes} nodes but it still failed!!",
-            probes,
-        )
+            ok, _ = feasible(result)
+            if ok:
+                return PlanResult(True, i, result, "Success!", probes)
+            if result.unscheduled_pods:
+                msg = diagnose(result)
+                if msg:
+                    return PlanResult(False, i, result, msg, probes)
+        return PlanResult(False, max_new_nodes, result, fail_msg, probes)
 
     # doubling probe then binary search (feasibility monotone in clone count)
     hi, hi_result = None, None
     probe = 1
     while probe < max_new_nodes:
         result = run(probe)
-        if not result.unscheduled_pods:
+        ok, _ = feasible(result)
+        if ok:
             hi, hi_result = probe, result
             break
-        msg = diagnose(result)
-        if msg:
-            return PlanResult(False, probe, result, msg, probes)
+        if result.unscheduled_pods:
+            msg = diagnose(result)
+            if msg:
+                return PlanResult(False, probe, result, msg, probes)
         probe *= 2
     if hi is None:
-        probe = max_new_nodes
+        probe = max_new_nodes - 1
+        if probe in probes:  # already tried as the last doubling step
+            return PlanResult(False, max_new_nodes, result, fail_msg, probes)
         result = run(probe)
-        if result.unscheduled_pods:
-            return PlanResult(
-                False,
-                max_new_nodes,
-                result,
-                f"we have added {max_new_nodes} nodes but it still failed!!",
-                probes,
-            )
+        ok, _ = feasible(result)
+        if not ok:
+            return PlanResult(False, max_new_nodes, result, fail_msg, probes)
         hi, hi_result = probe, result
     lo = hi // 2  # lowest infeasible known is hi//2 (or 0)
     while hi - lo > 1:
         mid = (lo + hi) // 2
         result = run(mid)
-        if result.unscheduled_pods:
-            lo = mid
-        else:
+        ok, _ = feasible(result)
+        if ok:
             hi, hi_result = mid, result
-    return finish(hi, hi_result)
+        else:
+            lo = mid
+    return PlanResult(True, hi, hi_result, "Success!", probes)
 
 
 @dataclass

@@ -1,0 +1,215 @@
+"""Synthetic cluster/workload generation for benchmarks and scale tests.
+
+The reference ships only small hand-written example clusters
+(`example/cluster/demo_1`, 4 nodes); its implied scaling axis is
+pods × nodes (SURVEY.md §6). This module manufactures arbitrarily large
+clusters and app lists with the full constraint mix — zone labels, taints +
+tolerations, node selectors, preferred node affinity, inter-pod
+anti-affinity, GPU-share nodes, Open-Local storage nodes — so the engine,
+sweep, and bench exercise every kernel at any N.
+
+Deterministic: all choices derive from `seed` via numpy's Generator.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Tuple
+
+import numpy as np
+
+from .core.objects import AppResource, ResourceTypes
+
+
+def make_node(
+    name: str,
+    cpu_milli: int,
+    mem_gib: int,
+    labels=None,
+    taints=None,
+    gpu: Tuple[int, int] = None,  # (count, mem_mib_per_device)
+    storage_gib: Tuple[int, ...] = (),
+) -> dict:
+    alloc = {
+        "cpu": f"{cpu_milli}m",
+        "memory": f"{mem_gib}Gi",
+        "pods": "256",
+    }
+    annotations = {}
+    if gpu:
+        count, mem = gpu
+        alloc["alibabacloud.com/gpu-count"] = str(count)
+        alloc["alibabacloud.com/gpu-mem"] = f"{count * mem}Mi"
+    if storage_gib:
+        annotations["simon/node-local-storage"] = json.dumps(
+            {
+                "vgs": [
+                    {"name": f"vg{j}", "capacity": g * (1 << 30), "requested": 0}
+                    for j, g in enumerate(storage_gib)
+                ],
+                "devices": [],
+            }
+        )
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": dict(labels or {}), "annotations": annotations},
+        "spec": ({"taints": taints} if taints else {}),
+        "status": {"allocatable": dict(alloc), "capacity": dict(alloc)},
+    }
+
+
+def make_deployment(
+    name: str,
+    replicas: int,
+    cpu_milli: int,
+    mem_mib: int,
+    namespace: str = "bench",
+    node_selector=None,
+    tolerations=None,
+    anti_affinity_topo: str = None,
+    gpu_mem_mib: int = 0,
+    lvm_gib: int = 0,
+) -> dict:
+    labels = {"app": name}
+    requests = {"cpu": f"{cpu_milli}m", "memory": f"{mem_mib}Mi"}
+    if gpu_mem_mib:
+        requests["alibabacloud.com/gpu-mem"] = f"{gpu_mem_mib}Mi"
+    spec = {
+        "containers": [
+            {"name": "c", "image": "app", "resources": {"requests": requests}}
+        ]
+    }
+    if node_selector:
+        spec["nodeSelector"] = dict(node_selector)
+    if tolerations:
+        spec["tolerations"] = list(tolerations)
+    if anti_affinity_topo:
+        spec["affinity"] = {
+            "podAntiAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "weight": 100,
+                        "podAffinityTerm": {
+                            "labelSelector": {"matchLabels": labels},
+                            "topologyKey": anti_affinity_topo,
+                        },
+                    }
+                ]
+            }
+        }
+    meta = {"labels": dict(labels)}
+    if lvm_gib:
+        # unnamed-VG LVM volume → binpack across node VGs (common.go:59-107)
+        meta["annotations"] = {
+            "simon/pod-local-storage": json.dumps(
+                {
+                    "volumes": [
+                        {
+                            "kind": "LVM",
+                            "scName": "open-local-lvm",
+                            "size": lvm_gib * (1 << 30),
+                        }
+                    ]
+                }
+            )
+        }
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": labels},
+            "template": {"metadata": meta, "spec": spec},
+        },
+    }
+
+
+def synth_cluster(
+    n_nodes: int,
+    seed: int = 0,
+    zones: int = 8,
+    taint_frac: float = 0.1,
+    gpu_frac: float = 0.0,
+    storage_frac: float = 0.0,
+) -> ResourceTypes:
+    """A cluster of `n_nodes` heterogeneous nodes across `zones` zones."""
+    rng = np.random.default_rng(seed)
+    nodes = []
+    for i in range(n_nodes):
+        zone = f"zone-{i % zones}"
+        labels = {
+            "topology.kubernetes.io/zone": zone,
+            "kubernetes.io/hostname": f"node-{i:06d}",
+        }
+        taints = None
+        if rng.random() < taint_frac:
+            taints = [
+                {"key": "dedicated", "value": "infra", "effect": "NoSchedule"}
+            ]
+        gpu = None
+        if rng.random() < gpu_frac:
+            gpu = (int(rng.integers(2, 9)), 16384)
+        storage = ()
+        if rng.random() < storage_frac:
+            storage = (int(rng.integers(200, 1000)),)
+        cpu = int(rng.choice([16000, 32000, 64000, 96000]))
+        mem = int(rng.choice([64, 128, 256, 384]))
+        nodes.append(
+            make_node(f"node-{i:06d}", cpu, mem, labels, taints, gpu, storage)
+        )
+    res = ResourceTypes()
+    res.nodes = nodes
+    return res
+
+
+def synth_apps(
+    n_pods: int,
+    seed: int = 1,
+    zones: int = 8,
+    pods_per_deployment: int = 50,
+    selector_frac: float = 0.2,
+    toleration_frac: float = 0.1,
+    anti_affinity_frac: float = 0.2,
+    gpu_frac: float = 0.0,
+    storage_frac: float = 0.0,
+) -> List[AppResource]:
+    """App list totalling ~n_pods pods across deployments with mixed
+    constraints (the `complicate` example writ large)."""
+    rng = np.random.default_rng(seed)
+    apps: List[AppResource] = []
+    made = 0
+    d = 0
+    resources = ResourceTypes()
+    while made < n_pods:
+        replicas = min(pods_per_deployment, n_pods - made)
+        kw = {}
+        roll = rng.random()
+        if roll < gpu_frac:
+            kw["gpu_mem_mib"] = int(rng.choice([4096, 8192, 16384]))
+        elif roll < gpu_frac + storage_frac:
+            kw["lvm_gib"] = int(rng.integers(5, 40))
+        if rng.random() < selector_frac:
+            kw["node_selector"] = {
+                "topology.kubernetes.io/zone": f"zone-{int(rng.integers(zones))}"
+            }
+        if rng.random() < toleration_frac:
+            kw["tolerations"] = [
+                {"key": "dedicated", "operator": "Exists", "effect": "NoSchedule"}
+            ]
+        if rng.random() < anti_affinity_frac:
+            kw["anti_affinity_topo"] = "kubernetes.io/hostname"
+        resources.deployments.append(
+            make_deployment(
+                f"dep-{d:05d}",
+                replicas,
+                int(rng.choice([250, 500, 1000, 2000])),
+                int(rng.choice([256, 512, 1024, 4096])),
+                **kw,
+            )
+        )
+        made += replicas
+        d += 1
+    apps.append(AppResource(name="synthetic", resource=resources))
+    return apps

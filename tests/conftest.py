@@ -7,10 +7,16 @@ Must run before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell presets axon/tpu
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# a sitecustomize may prepend an accelerator platform (e.g. "axon,cpu");
+# tests must run on the 8-device virtual CPU topology regardless
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,65 @@
+"""Helm chart renderer tests (`simtpu/chart.py` vs `pkg/chart/chart.go`)."""
+
+import yaml
+
+import pytest
+
+from simtpu.chart import ChartRenderError, process_chart, render_template
+
+YODA = "/root/reference/example/application/charts/yoda"
+
+
+class TestTemplateEngine:
+    def test_field_access_and_root(self):
+        ctx = {"Values": {"a": {"b": "x"}}, "Release": {"Name": "r"}}
+        assert render_template("{{ .Values.a.b }}/{{ $.Release.Name }}", ctx) == "x/r"
+
+    def test_if_else(self):
+        ctx = {"Values": {"on": True, "off": False}}
+        tpl = "{{- if .Values.off }}A{{- else if .Values.on }}B{{- else }}C{{- end }}"
+        assert render_template(tpl, ctx) == "B"
+
+    def test_trim_markers(self):
+        out = render_template("a\n  {{- if true }}\nb\n{{- end }}\nc", {})
+        assert out == "a\nb\nc"
+
+    def test_int_and_pipeline(self):
+        ctx = {"Values": {"port": "32747"}}
+        assert render_template("{{ int .Values.port }}", ctx) == "32747"
+        assert render_template("{{ .Values.port | int }}", ctx) == "32747"
+
+    def test_quote_default(self):
+        assert render_template('{{ "x" | quote }}', {}) == '"x"'
+        assert render_template('{{ .Values.missing | default "d" }}', {"Values": {}}) == "d"
+
+    def test_unsupported_construct_raises(self):
+        with pytest.raises(ChartRenderError):
+            render_template("{{ range .Values.x }}{{ end }}", {}, where="t.yaml")
+
+    def test_missing_value_formats_like_go(self):
+        assert render_template("{{ .Values.nope }}", {"Values": {}}) == "<no value>"
+
+
+class TestProcessChart:
+    def test_yoda_renders_install_ordered(self, example_dir):
+        docs = [yaml.safe_load(d) for d in process_chart("yoda", YODA)]
+        kinds = [d["kind"] for d in docs]
+        assert len(docs) == 14
+        # InstallOrder: all StorageClasses before Service before workloads
+        assert kinds[:5] == ["StorageClass"] * 5
+        assert kinds.index("Service") < kinds.index("DaemonSet")
+        assert kinds[-2:] == ["Job", "CronJob"]
+
+    def test_yoda_values_flow_through(self, example_dir):
+        docs = [yaml.safe_load(d) for d in process_chart("yoda", YODA)]
+        scs = [d for d in docs if d["kind"] == "StorageClass"]
+        names = {d["metadata"]["name"] for d in scs}
+        assert "yoda-lvm-default" in names
+        cron = next(d for d in docs if d["kind"] == "CronJob")
+        assert cron["spec"]["schedule"] == "0 * * * *"
+
+    def test_release_name_is_app_name(self, example_dir):
+        # chart.go:24 overrides the chart name with the configured app name
+        docs_a = process_chart("alpha", YODA)
+        docs_b = process_chart("yoda", YODA)
+        assert len(docs_a) == len(docs_b)
