@@ -28,12 +28,10 @@ import numpy as np
 
 
 def build_problem(n_nodes: int, n_pods: int):
-    import jax.numpy as jnp
-
     from simtpu.core.tensorize import Tensorizer
     from simtpu.core.objects import set_label
     from simtpu import constants as C
-    from simtpu.engine.scan import statics_from
+    from simtpu.engine.scan import build_pod_arrays, statics_from
     from simtpu.engine.state import build_state
     from simtpu.synth import synth_apps, synth_cluster
     from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
@@ -65,29 +63,13 @@ def build_problem(n_nodes: int, n_pods: int):
 
     statics = statics_from(tensors)
     r = tensors.alloc.shape[1]
-    req = batch.req
-    if req.shape[1] < r:
-        req = np.pad(req, ((0, 0), (0, r - req.shape[1])))
+    req, pod_arrays = build_pod_arrays(batch, r)
     state = build_state(
         tensors,
         np.zeros(0, np.int32),
         np.zeros(0, np.int32),
         np.zeros((0, r), np.float32),
         None,
-    )
-    ext = batch.ext
-    pod_arrays = (
-        jnp.asarray(batch.group),
-        jnp.asarray(req, jnp.float32),
-        jnp.asarray(batch.pin, jnp.int32),
-        jnp.asarray(batch.forced),
-        jnp.asarray(ext["lvm_size"]),
-        jnp.asarray(ext["lvm_vg"]),
-        jnp.asarray(ext["dev_size"]),
-        jnp.asarray(ext["dev_media"]),
-        jnp.asarray(ext["gpu_mem"]),
-        jnp.asarray(ext["gpu_count"]),
-        jnp.asarray(ext["gpu_preset"]),
     )
     return tensors, batch, statics, state, pod_arrays, req, gen_s, tensorize_s
 

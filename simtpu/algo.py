@@ -1,0 +1,72 @@
+"""Pod-ordering heuristics — the `pkg/algo` queues as sort keys.
+
+The reference defines three `SchedulingQueueSort` implementations
+(`pkg/algo/algo.go:4-8`): GreedQueue (DRF-style dominant share, descending,
+`greed.go:10-83`), AffinityQueue (nodeSelector pods first, `affinity.go:8-23`)
+and TolerationQueue (tolerations pods first, `toleration.go:7-21`).
+`ScheduleApp` always applies Affinity then Toleration
+(`pkg/simulator/simulator.go:172-176`); GreedQueue exists behind the
+`--use-greed` flag but is never constructed outside tests — we expose it as a
+working sort here.
+
+Sorting is host-side (argsort keys over the pod list), not a device kernel:
+ordering decides the scan's pod axis order before compilation.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from .core.objects import node_allocatable, pod_node_name, pod_requests
+
+
+def share(alloc: float, total: float) -> float:
+    """`algo.Share` (`greed.go:69-83`): alloc/total with 0/0 → 0, x/0 → 1."""
+    if total == 0:
+        return 0.0 if alloc == 0 else 1.0
+    return alloc / total
+
+
+def cluster_total_resources(nodes: Sequence[dict]) -> Dict[str, float]:
+    """Summed allocatable cpu+memory (`greed.go:16-33`)."""
+    total = {"cpu": 0.0, "memory": 0.0}
+    for node in nodes:
+        alloc = node_allocatable(node)
+        total["cpu"] += alloc.get("cpu", 0.0)
+        total["memory"] += alloc.get("memory", 0.0)
+    return total
+
+
+def pod_dominant_share(pod: dict, total: Dict[str, float]) -> float:
+    """`calculatePodShare` (`greed.go:50-67`): max share over cpu/memory."""
+    req = pod_requests(pod)
+    if not req:
+        return 0.0
+    return max(share(req.get(r, 0.0), total[r]) for r in ("cpu", "memory"))
+
+
+def greed_sort(pods: List[dict], nodes: Sequence[dict]) -> List[dict]:
+    """GreedQueue order: pods with a nodeName first, then descending dominant
+    share of cluster-total resources (`greed.go:37-48`). Stable."""
+    total = cluster_total_resources(nodes)
+    return sorted(
+        pods,
+        key=lambda p: (
+            0 if pod_node_name(p) else 1,
+            -pod_dominant_share(p, total),
+        ),
+    )
+
+
+def affinity_sort(pods: List[dict]) -> List[dict]:
+    """AffinityQueue: pods with a nodeSelector first (`affinity.go:21-23`)."""
+    return sorted(
+        pods, key=lambda p: (p.get("spec") or {}).get("nodeSelector") is None
+    )
+
+
+def toleration_sort(pods: List[dict]) -> List[dict]:
+    """TolerationQueue: pods with tolerations first (`toleration.go:19-21`)."""
+    return sorted(
+        pods, key=lambda p: not (p.get("spec") or {}).get("tolerations")
+    )

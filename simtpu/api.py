@@ -16,6 +16,7 @@ from . import constants as C
 from .core.objects import (
     AppResource,
     NodeStatus,
+    PreemptedPod,
     ResourceTypes,
     SimulateResult,
     UnscheduledPod,
@@ -23,37 +24,73 @@ from .core.objects import (
     deep_copy,
     name_of,
     namespace_of,
+    pod_priority,
     set_annotation,
     set_label,
 )
 from .core.quantity import parse_quantity
-from .core.tensorize import Tensorizer
-from .engine.scan import OK, REASON_TEXT, Engine
+from .core.tensorize import Tensorizer, _group_of_pod
+from .engine.scan import (
+    FAIL_GPU,
+    FAIL_INTERPOD,
+    FAIL_PORTS,
+    FAIL_RESOURCES,
+    FAIL_SPREAD,
+    FAIL_STORAGE,
+    OK,
+    REASON_TEXT,
+    Engine,
+)
+
+# Failure classes where evicting lower-priority pods can help — the analog of
+# DefaultPreemption's PostFilter eligibility (static/affinity failures are
+# priority-independent, `plugins/defaultpreemption/default_preemption.go`).
+_PREEMPTIBLE_REASONS = {
+    FAIL_RESOURCES,
+    FAIL_PORTS,
+    FAIL_STORAGE,
+    FAIL_GPU,
+    FAIL_INTERPOD,
+    FAIL_SPREAD,
+}
 from .workloads.expand import (
     get_valid_pods_exclude_daemonset,
     make_valid_pods_by_daemonset,
 )
 
 
-def _sort_app_pods(pods: List[dict]) -> List[dict]:
+def _sort_app_pods(pods: List[dict], nodes: Sequence[dict] = (), use_greed: bool = False) -> List[dict]:
     """Stable emulation of the reference's app-pod ordering: AffinityQueue
     (nodeSelector pods first) then TolerationQueue (tolerations pods first),
-    applied in that order (`pkg/simulator/simulator.go:172-176`;
-    `pkg/algo/affinity.go:21-23`, `toleration.go:19-21`)."""
-    pods = sorted(pods, key=lambda p: (p.get("spec") or {}).get("nodeSelector") is None)
-    return sorted(pods, key=lambda p: (p.get("spec") or {}).get("tolerations") is None)
+    applied in that order (`pkg/simulator/simulator.go:172-176`). With
+    `use_greed`, GreedQueue's DRF dominant-share order is applied first — the
+    working version of the reference's dead `--use-greed` flag
+    (`cmd/apply/apply.go:33`, never constructed outside tests)."""
+    from .algo import affinity_sort, greed_sort, toleration_sort
+
+    if use_greed:
+        pods = greed_sort(pods, nodes)
+    return toleration_sort(affinity_sort(pods))
 
 
 class Simulator:
     """One in-memory cluster simulation."""
 
-    def __init__(self, extra_resources: Sequence[str] = (), engine_factory=None):
+    def __init__(
+        self,
+        extra_resources: Sequence[str] = (),
+        engine_factory=None,
+        use_greed: bool = False,
+    ):
         self._extra_resources = extra_resources
+        self._use_greed = use_greed
         self._engine_factory = engine_factory or Engine
         self._tensorizer: Optional[Tensorizer] = None
         self._engine: Optional[Engine] = None
         self._nodes: List[dict] = []
-        self._scheduled: List[dict] = []  # placed pods, nodeName set
+        self._scheduled: List[dict] = []  # placed pods, nodeName set; parallel
+        self._placed_prio: List[float] = []  # ... to the engine placement log
+        self._preempted: List[PreemptedPod] = []
         self._unscheduled: List[UnscheduledPod] = []
         self._storage_classes: List[dict] = []
 
@@ -65,7 +102,10 @@ class Simulator:
         self._nodes = [deep_copy(n) for n in cluster.nodes]
         self._storage_classes = list(cluster.storage_classes)
         self._tensorizer = Tensorizer(
-            self._nodes, self._extra_resources, storage_classes=self._storage_classes
+            self._nodes,
+            self._extra_resources,
+            storage_classes=self._storage_classes,
+            services=list(cluster.services),
         )
         self._engine = self._engine_factory(self._tensorizer)
         self._schedule_pods(cluster.pods)
@@ -73,13 +113,19 @@ class Simulator:
 
     def schedule_app(self, app: AppResource) -> SimulateResult:
         """Expand one app into pods and schedule them in order
-        (`pkg/simulator/simulator.go:166-184`)."""
+        (`pkg/simulator/simulator.go:166-184`).
+
+        Reference parity: only the app's *pods* enter the simulation — its
+        services/PDBs/etc. are never created in the fake cluster
+        (`GenerateValidPodsFromAppResources` generates pods only), so
+        SelectorSpread intentionally counts against cluster services alone.
+        """
         pods = get_valid_pods_exclude_daemonset(app.resource)
         for ds in app.resource.daemon_sets:
             pods.extend(make_valid_pods_by_daemonset(ds, self._nodes))
         for pod in pods:
             set_label(pod, C.LABEL_APP_NAME, app.name)
-        pods = _sort_app_pods(pods)
+        pods = _sort_app_pods(pods, self._nodes, self._use_greed)
         self._schedule_pods(pods)
         return self._result()
 
@@ -89,39 +135,223 @@ class Simulator:
 
     # -- internals ---------------------------------------------------------
 
+    def _record_placed(self, pod: dict, node_idx: int, gpu_shares) -> None:
+        placed = deep_copy(pod)
+        placed["spec"]["nodeName"] = self._nodes[node_idx]["metadata"]["name"]
+        placed.setdefault("status", {})["phase"] = "Running"
+        # GPU device assignment annotation (GpuSharePlugin.Bind applies
+        # the pod copy with the gpu-index annotation,
+        # open-gpu-share.go:221-241 + utils/pod.go:117-127)
+        already = annotations_of(placed).get(C.ANNO_POD_GPU_INDEX)
+        if gpu_shares.sum() > 0 and not already:
+            ids = []
+            for dev_id, cnt in enumerate(gpu_shares):
+                ids.extend([str(dev_id)] * int(round(float(cnt))))
+            set_annotation(placed, C.ANNO_POD_GPU_INDEX, "-".join(ids))
+        self._scheduled.append(placed)
+        self._placed_prio.append(pod_priority(pod))
+
+    def _record_failed(self, pod: dict, reason: int) -> None:
+        msg = REASON_TEXT.get(int(reason), "unschedulable")
+        self._unscheduled.append(
+            UnscheduledPod(
+                pod=pod,
+                reason=(
+                    f"failed to schedule pod ({namespace_of(pod)}/{name_of(pod)}): "
+                    f"Unschedulable: 0/{len(self._nodes)} nodes are available: {msg}"
+                ),
+            )
+        )
+
     def _schedule_pods(self, pods: Sequence[dict]) -> None:
         if not pods:
             return
         batch = self._tensorizer.add_pods(pods)
         nodes, reasons, extras = self._engine.place(batch)
-        n_total = len(self._nodes)
+        # record every batch outcome FIRST so _scheduled/_placed_prio stay
+        # index-parallel with the engine's placement log (Engine.place logged
+        # the whole batch already); preemption then runs against a consistent
+        # view — the analog of failed pods re-entering via the backoff queue
+        failed = []
         for i, (pod, node_idx, reason) in enumerate(zip(batch.pods, nodes, reasons)):
             if node_idx >= 0:
-                placed = deep_copy(pod)
-                placed["spec"]["nodeName"] = self._nodes[node_idx]["metadata"]["name"]
-                placed.setdefault("status", {})["phase"] = "Running"
-                # GPU device assignment annotation (GpuSharePlugin.Bind applies
-                # the pod copy with the gpu-index annotation,
-                # open-gpu-share.go:221-241 + utils/pod.go:117-127)
-                shares = extras["gpu_shares"][i]
-                already = annotations_of(placed).get(C.ANNO_POD_GPU_INDEX)
-                if shares.sum() > 0 and not already:
-                    ids = []
-                    for dev_id, cnt in enumerate(shares):
-                        ids.extend([str(dev_id)] * int(round(float(cnt))))
-                    set_annotation(placed, C.ANNO_POD_GPU_INDEX, "-".join(ids))
-                self._scheduled.append(placed)
+                self._record_placed(pod, node_idx, extras["gpu_shares"][i])
             else:
-                msg = REASON_TEXT.get(int(reason), "unschedulable")
-                self._unscheduled.append(
-                    UnscheduledPod(
-                        pod=pod,
-                        reason=(
-                            f"failed to schedule pod ({namespace_of(pod)}/{name_of(pod)}): "
-                            f"Unschedulable: 0/{n_total} nodes are available: {msg}"
-                        ),
-                    )
+                failed.append((pod, int(reason)))
+        for pod, reason in failed:
+            if not self._try_preempt(pod, reason):
+                self._record_failed(pod, reason)
+
+    # -- preemption (DefaultPreemption PostFilter analog) -------------------
+
+    def _try_preempt(self, pod: dict, reason: int) -> bool:
+        """Evict lower-priority placed pods to make room, then retry.
+
+        Mirrors the DefaultPreemption flow: find candidate nodes where
+        removing victims (lowest priority first, most recent first on ties)
+        plausibly fits the pod, pick the node minimizing (highest victim
+        priority, summed priorities, victim count) —
+        `defaultpreemption/default_preemption.go` pickOneNodeForPreemption —
+        evict, and re-run the real filter pipeline; the eviction is undone if
+        the retry still fails, so the cheap host-side victim model only needs
+        to *propose* sets, never to be exact. PDB-violation counting is not
+        modeled (the simulation has no live disruption controller). Victims
+        are reported in `SimulateResult.preempted_pods`, not re-queued.
+        """
+        import numpy as np
+
+        if reason not in _PREEMPTIBLE_REASONS or not self._engine.placed_node:
+            return False
+        prio = pod_priority(pod)
+        prios = np.asarray(self._placed_prio)
+        placed_nodes = np.asarray(self._engine.placed_node)
+        if not np.any(prios < prio):
+            return False
+        tz = self._tensorizer
+        g, pin_name = _group_of_pod(pod)
+        gid = tz._group_ids.get(g.signature())
+        if gid is None:
+            return False
+        static = tz._static_mask[gid]
+        alloc = tz.alloc
+        r = alloc.shape[1]
+
+        def padded(row):
+            return np.pad(row, (0, r - row.shape[0])) if row.shape[0] < r else row
+
+        placed_req = np.stack(
+            [padded(q) for q in self._engine.placed_req]
+        ) if self._engine.placed_req else np.zeros((0, r), np.float32)
+        used = np.zeros_like(alloc)
+        np.add.at(used, placed_nodes, placed_req)
+        pod_req = padded(self._pod_req_vector(pod))
+
+        # per-reason victim relevance + plausibility (the retry verifies)
+        ext_log = self._engine.ext_log
+        placed_groups = self._engine.placed_group
+        pod_ports = set(tz._port_rows[gid].keys())
+        anti_terms = {t for t, v in tz._a_anti[gid].items() if v}
+        spread_terms = {t for t, v in tz._spread_hard[gid].items() if v > 0}
+        probe = tz.add_pods([pod])
+        gpu_need = float(probe.ext["gpu_mem"][0]) * max(
+            float(probe.ext["gpu_count"][0]), 1.0
+        )
+        lvm_need = float(np.sum(probe.ext["lvm_size"][0]))
+
+        def victim_helps(i: int) -> bool:
+            vg = placed_groups[i]
+            if reason == FAIL_PORTS:
+                return bool(pod_ports & set(tz._port_rows[vg].keys()))
+            if reason == FAIL_GPU:
+                return ext_log["gpu_mem"][i] > 0
+            if reason == FAIL_STORAGE:
+                return (
+                    float(np.sum(ext_log["vg_alloc"][i])) > 0
+                    or bool(np.any(ext_log["sdev_take"][i]))
                 )
+            if reason == FAIL_INTERPOD:
+                return any(tz._s_match[vg].get(t) for t in anti_terms)
+            if reason == FAIL_SPREAD:
+                return any(tz._s_match[vg].get(t) for t in spread_terms)
+            return True  # FAIL_RESOURCES: any eviction frees resources
+
+        best = None  # (key, node, victim_indices)
+        for n in range(len(self._nodes)):
+            if not static[n]:
+                continue
+            if pin_name is not None and name_of(self._nodes[n]) != pin_name:
+                continue
+            cand = np.flatnonzero((placed_nodes == n) & (prios < prio))
+            cand = [int(i) for i in cand if victim_helps(int(i))]
+            if not cand:
+                continue
+            # lowest priority first, later placements first on ties
+            cand.sort(key=lambda i: (prios[i], -i))
+            on_node = np.flatnonzero(placed_nodes == n)
+            gpu_free = float(np.sum(tz.ext.gpu_dev_total[n])) - sum(
+                float(np.sum(ext_log["gpu_shares"][i])) * ext_log["gpu_mem"][i]
+                for i in on_node
+            )
+            vg_free = float(
+                np.sum(tz.ext.vg_cap[n]) - np.sum(tz.ext.vg_req0[n])
+            ) - sum(float(np.sum(ext_log["vg_alloc"][i])) for i in on_node)
+            free = alloc[n] - used[n]
+            victims: List[int] = []
+
+            def plausible() -> bool:
+                if not np.all(free >= pod_req - 1e-6):
+                    return False
+                if reason == FAIL_PORTS or reason in (FAIL_INTERPOD, FAIL_SPREAD):
+                    # every relevant victim on this node must be gone
+                    return all(i in victims for i in cand)
+                if reason == FAIL_GPU:
+                    return gpu_free >= gpu_need - 1e-6
+                if reason == FAIL_STORAGE:
+                    return vg_free >= lvm_need - 1e-6
+                return True
+
+            for i in cand:
+                if victims and plausible():
+                    break
+                free = free + placed_req[i]
+                gpu_free += float(np.sum(ext_log["gpu_shares"][i])) * ext_log["gpu_mem"][i]
+                vg_free += float(np.sum(ext_log["vg_alloc"][i]))
+                victims.append(i)
+            if not victims or not plausible():
+                continue
+            varr = np.asarray(victims)
+            key = (
+                float(prios[varr].max()),
+                float(prios[varr].sum()),
+                len(victims),
+                n,
+            )
+            if best is None or key < best[0]:
+                best = (key, n, victims)
+        if best is None:
+            return False
+        _, node, victims = best
+
+        saved = self._engine.remove_placements(victims)
+        saved_pods = [(i, self._scheduled[i], self._placed_prio[i]) for i in saved["indices"]]
+        for i in reversed(saved["indices"]):
+            del self._scheduled[i]
+            del self._placed_prio[i]
+
+        nodes, reasons, extras = self._engine.place(probe)
+        if nodes[0] < 0:
+            # the cheap resource model was too optimistic — undo the eviction
+            self._engine.restore_placements(saved)
+            for i, victim, vprio in saved_pods:
+                self._scheduled.insert(i, victim)
+                self._placed_prio.insert(i, vprio)
+            return False
+        who = f"{namespace_of(pod)}/{name_of(pod)}"
+        for _, victim, _ in saved_pods:
+            self._preempted.append(
+                PreemptedPod(
+                    pod=victim,
+                    preempted_by=who,
+                    node=victim["spec"].get("nodeName", ""),
+                )
+            )
+        self._record_placed(pod, nodes[0], extras["gpu_shares"][0])
+        return True
+
+    def _pod_req_vector(self, pod: dict):
+        """The pod's request row in the tensorizer's resource vocabulary."""
+        import numpy as np
+
+        from .core.objects import pod_requests
+        from .core.tensorize import RES_PODS
+
+        req = np.zeros(len(self._tensorizer.resources), np.float32)
+        req[RES_PODS] = 1.0
+        for rname, val in pod_requests(pod).items():
+            ridx = self._tensorizer.resources.get(rname)
+            if ridx >= 0:
+                req[ridx] = val
+        return req
 
     def _result(self) -> SimulateResult:
         by_node = {name_of(n): [] for n in self._nodes}
@@ -131,7 +361,9 @@ class Simulator:
         self._write_extended_annotations(nodes)
         statuses = [NodeStatus(node=n, pods=by_node[name_of(n)]) for n in nodes]
         return SimulateResult(
-            unscheduled_pods=list(self._unscheduled), node_status=statuses
+            unscheduled_pods=list(self._unscheduled),
+            node_status=statuses,
+            preempted_pods=list(self._preempted),
         )
 
     def _write_extended_annotations(self, nodes: List[dict]) -> None:
@@ -206,6 +438,7 @@ def simulate(
     apps: Sequence[AppResource] = (),
     extended_resources: Sequence[str] = (),
     engine_factory=None,
+    use_greed: bool = False,
 ) -> SimulateResult:
     """One-shot simulation (`pkg/simulator/core.go:64-103`): expand cluster
     workloads, run the cluster, then schedule each app in configured order.
@@ -213,7 +446,11 @@ def simulate(
     reflects the final cluster. Pass
     `engine_factory=lambda t: ShardedEngine(t, mesh)` to run the scan with the
     node axis sharded over a device mesh (simtpu/parallel)."""
-    sim = Simulator(extra_resources=extended_resources, engine_factory=engine_factory)
+    sim = Simulator(
+        extra_resources=extended_resources,
+        engine_factory=engine_factory,
+        use_greed=use_greed,
+    )
     cluster = ResourceTypes(**{k: list(v) for k, v in vars(cluster).items()})
     cluster_pods = get_valid_pods_exclude_daemonset(cluster)
     for ds in cluster.daemon_sets:

@@ -21,6 +21,8 @@ ANNO_NODE_GPU_SHARE = "simon/node-gpu-share"
 LABEL_NEW_NODE = "simon/new-node"
 LABEL_APP_NAME = "simon/app-name"
 LABEL_HOSTNAME = "kubernetes.io/hostname"
+LABEL_ZONE = "topology.kubernetes.io/zone"
+LABEL_ZONE_BETA = "failure-domain.beta.kubernetes.io/zone"
 
 ENV_MAX_CPU = "MaxCPU"
 ENV_MAX_MEMORY = "MaxMemory"

@@ -11,8 +11,9 @@ expansion = dict surgery, and leaves the numeric heavy lifting to tensorize.py.
 from __future__ import annotations
 
 import copy
+import json
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List
+from typing import Dict, Iterator, List, Optional
 
 from .quantity import parse_quantity
 
@@ -154,6 +155,43 @@ def pod_host_ports(pod: dict) -> List[tuple]:
     return out
 
 
+def pod_topology_spread_constraints(pod: dict) -> List[dict]:
+    """topologySpreadConstraints, for the PodTopologySpread plugin."""
+    return pod_spec(pod).get("topologySpreadConstraints") or []
+
+
+def pod_owner_kind(pod: dict) -> str:
+    """Kind of the pod's controller owner reference ('' when unowned)."""
+    for ref in (pod.get("metadata") or {}).get("ownerReferences") or []:
+        if ref.get("kind"):
+            return str(ref["kind"])
+    return ""
+
+
+def pod_images(pod: dict) -> List[str]:
+    """Container image names, for the ImageLocality score."""
+    return [c["image"] for c in pod_containers(pod) if c.get("image")]
+
+
+#: Built-in priority classes (`k8s.io/api/scheduling/v1/types.go`); the
+#: reference's ResourceTypes carries no PriorityClass objects
+#: (`pkg/simulator/core.go:29-43`), so only these resolve by name.
+_BUILTIN_PRIORITY_CLASSES = {
+    "system-cluster-critical": 2000000000.0,
+    "system-node-critical": 2000001000.0,
+}
+
+
+def pod_priority(pod: dict) -> float:
+    """Effective scheduling priority: spec.priority, else the built-in
+    priorityClassName value, else 0 (the admission-defaulted globalDefault)."""
+    p = pod_spec(pod).get("priority")
+    if p is not None:
+        return float(p)
+    name = pod_spec(pod).get("priorityClassName") or ""
+    return _BUILTIN_PRIORITY_CLASSES.get(name, 0.0)
+
+
 def pod_tolerations(pod: dict) -> List[dict]:
     return pod_spec(pod).get("tolerations") or []
 
@@ -182,6 +220,33 @@ def node_taints(node: dict) -> List[dict]:
 
 def node_unschedulable(node: dict) -> bool:
     return bool((node.get("spec") or {}).get("unschedulable"))
+
+
+def node_images(node: dict) -> List[dict]:
+    """status.images ({names, sizeBytes} entries), for ImageLocality."""
+    return (node.get("status") or {}).get("images") or []
+
+
+#: scheduler.alpha.kubernetes.io/preferAvoidPods — consumed by the
+#: NodePreferAvoidPods score plugin (weight 10000 in the default provider).
+ANNO_PREFER_AVOID_PODS = "scheduler.alpha.kubernetes.io/preferAvoidPods"
+
+
+def node_prefer_avoid_pods(node: dict) -> bool:
+    """True when the node's preferAvoidPods annotation lists any entry.
+
+    The upstream plugin matches entries against the pod's RC/RS controller
+    signature (`plugins/nodepreferavoidpods/node_prefer_avoid_pods.go`); the
+    simulation has no UIDs, so any entry avoids all RC/RS-owned pods.
+    """
+    raw = annotations_of(node).get(ANNO_PREFER_AVOID_PODS)
+    if not raw:
+        return False
+    try:
+        parsed = json.loads(raw)
+    except (ValueError, TypeError):
+        return False
+    return bool((parsed or {}).get("preferAvoidPods"))
 
 
 # ---------------------------------------------------------------------------
@@ -291,8 +356,25 @@ class NodeStatus:
 
 
 @dataclass
+class PreemptedPod:
+    """A lower-priority pod evicted to make room for a preemptor.
+
+    The reference inherits this behavior from the vendored scheduler's
+    DefaultPreemption PostFilter (`vendor/.../plugins/defaultpreemption/`):
+    victims are deleted from the fake cluster and never re-queued (they were
+    fake-Running, not owned by live controllers), so the simulation surfaces
+    them explicitly instead of silently dropping them.
+    """
+
+    pod: dict
+    preempted_by: str  # "namespace/name" of the preemptor
+    node: str  # node the victim was evicted from
+
+
+@dataclass
 class SimulateResult:
     """Result of one simulation (`pkg/simulator/core.go:56-62`)."""
 
     unscheduled_pods: List[UnscheduledPod]
     node_status: List[NodeStatus]
+    preempted_pods: List[PreemptedPod] = field(default_factory=list)

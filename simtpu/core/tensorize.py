@@ -54,14 +54,19 @@ from .objects import (
     name_of,
     namespace_of,
     node_allocatable,
+    node_images,
+    node_prefer_avoid_pods,
     node_taints,
     node_unschedulable,
     pod_affinity,
     pod_host_ports,
+    pod_images,
     pod_node_name,
     pod_node_selector,
+    pod_owner_kind,
     pod_requests,
     pod_tolerations,
+    pod_topology_spread_constraints,
 )
 from .vocab import Interner
 
@@ -239,6 +244,10 @@ class PodGroup:
     namespace: str
     pod_affinity: dict  # podAffinity sub-dict
     pod_anti_affinity: dict
+    host_ports: Tuple[Tuple[str, int], ...] = ()  # (protocol, hostPort)
+    topology_spread: tuple = ()  # canonicalized topologySpreadConstraints
+    owner_kind: str = ""  # controller ownerReference kind
+    images: Tuple[str, ...] = ()  # container image names
 
     def signature(self) -> str:
         return _canon(
@@ -251,6 +260,10 @@ class PodGroup:
                 self.namespace,
                 self.pod_affinity,
                 self.pod_anti_affinity,
+                list(self.host_ports),
+                list(self.topology_spread),
+                self.owner_kind,
+                sorted(self.images),
             ]
         )
 
@@ -260,6 +273,16 @@ def _group_of_pod(pod: dict) -> Tuple[PodGroup, Optional[str]]:
     node_aff = aff.get("nodeAffinity") or {}
     pin, stripped_required = _extract_pin(
         node_aff.get("requiredDuringSchedulingIgnoredDuringExecution")
+    )
+    # NodePorts semantics collapse hostIP to the (protocol, port) pair: two
+    # hostPorts conflict when IPs overlap and 0.0.0.0 (the default) overlaps
+    # everything (`plugins/nodeports/node_ports.go`); distinct non-wildcard
+    # IPs on the same port are rare enough to treat as conflicting.
+    ports = tuple(
+        sorted({(proto, port) for proto, _ip, port in pod_host_ports(pod)})
+    )
+    spread = tuple(
+        _canon(c) for c in pod_topology_spread_constraints(pod)
     )
     return (
         PodGroup(
@@ -272,6 +295,10 @@ def _group_of_pod(pod: dict) -> Tuple[PodGroup, Optional[str]]:
             namespace=namespace_of(pod),
             pod_affinity=aff.get("podAffinity") or {},
             pod_anti_affinity=aff.get("podAntiAffinity") or {},
+            host_ports=ports,
+            topology_spread=spread,
+            owner_kind=pod_owner_kind(pod),
+            images=tuple(pod_images(pod)),
         ),
         pin,
     )
@@ -339,6 +366,7 @@ class ClusterTensors:
     static_mask: np.ndarray  # [G, N] bool — unschedulable+taints+affinity+selector
     node_pref_score: np.ndarray  # [G, N] f32 — NodeAffinity preferred raw score
     taint_intolerable: np.ndarray  # [G, N] f32 — count of intolerable PreferNoSchedule
+    static_score: np.ndarray  # [G, N] f32 — ImageLocality + NodePreferAvoidPods
 
     # inter-pod term axis
     terms: List[Term]
@@ -348,6 +376,14 @@ class ClusterTensors:
     a_anti_req: np.ndarray  # [G, T] bool
     w_aff_pref: np.ndarray  # [G, T] f32 (summed weights)
     w_anti_pref: np.ndarray  # [G, T] f32
+    spread_hard: np.ndarray  # [G, T] f32 — maxSkew of DoNotSchedule constraints (0 = none)
+    spread_soft: np.ndarray  # [G, T] f32 — count weight of ScheduleAnyway constraints
+    ss_host: np.ndarray  # [G, T] bool — SelectorSpread hostname-key terms
+    ss_zone: np.ndarray  # [G, T] bool — SelectorSpread zone-key terms
+
+    # host-port axis (interned (protocol, hostPort) pairs)
+    ports: np.ndarray = None  # [G, P] bool — group requests port p
+    n_ports: int = 0
 
     # extended resources (Open-Local storage + GPU share)
     ext: ExtendedNodeArrays = field(repr=False, default=None)
@@ -392,6 +428,7 @@ class Tensorizer:
         nodes: Sequence[dict],
         extra_resources: Sequence[str] = (),
         storage_classes: Sequence[dict] = (),
+        services: Sequence[dict] = (),
     ):
         self.nodes = list(nodes)
         self.label_index = NodeLabelIndex(self.nodes)
@@ -399,6 +436,7 @@ class Tensorizer:
         self.vg_names = Interner()
         self.ext = tensorize_node_storage(self.nodes, self.vg_names)
         self.catalog = StorageClassCatalog(storage_classes)
+        self.services = list(services)
 
         # resource vocabulary: base + everything any node allocates
         self.resources = Interner()
@@ -421,6 +459,29 @@ class Tensorizer:
             if node_unschedulable(node):
                 self.taints[i] = self.taints[i] + [_UNSCHEDULABLE_TAINT]
 
+        # NodePreferAvoidPods: static per-node avoid flag (annotation)
+        self.prefer_avoid = np.array(
+            [node_prefer_avoid_pods(nd) for nd in self.nodes], bool
+        )
+        # ImageLocality: image name → (nodes having it [N] bool, sizeBytes)
+        self.image_index: Dict[str, Tuple[np.ndarray, float]] = {}
+        for i, node in enumerate(self.nodes):
+            for img in node_images(node):
+                size = float(img.get("sizeBytes") or 0)
+                for nm in img.get("names") or []:
+                    have, _ = self.image_index.setdefault(
+                        nm, (np.zeros(n, bool), size)
+                    )
+                    have[i] = True
+        # SelectorSpread zone key: modern label if any node carries it, else
+        # the legacy beta key (`selectorspread` zone weighting, k8s 1.20)
+        if self.label_index.has_key(C.LABEL_ZONE).any():
+            self.zone_key = C.LABEL_ZONE
+        elif self.label_index.has_key(C.LABEL_ZONE_BETA).any():
+            self.zone_key = C.LABEL_ZONE_BETA
+        else:
+            self.zone_key = None
+
         # topology keys/domains and the term universe grow lazily
         self.topo_keys = Interner()
         self.domains = Interner()  # (key, value) pairs
@@ -434,12 +495,20 @@ class Tensorizer:
         self._static_mask: List[np.ndarray] = []
         self._node_pref: List[np.ndarray] = []
         self._taint_intol: List[np.ndarray] = []
+        self._static_score: List[np.ndarray] = []
         # group×term incidence, grown row-wise (lists of dict[t]=val)
         self._s_match: List[Dict[int, bool]] = []
         self._a_aff: List[Dict[int, bool]] = []
         self._a_anti: List[Dict[int, bool]] = []
         self._w_aff: List[Dict[int, float]] = []
         self._w_anti: List[Dict[int, float]] = []
+        self._spread_hard: List[Dict[int, float]] = []
+        self._spread_soft: List[Dict[int, float]] = []
+        self._ss_host: List[Dict[int, bool]] = []
+        self._ss_zone: List[Dict[int, bool]] = []
+        # host-port vocabulary ((protocol, port) pairs) and group rows
+        self.ports = Interner()
+        self._port_rows: List[Dict[int, bool]] = []
 
     # -- topology ----------------------------------------------------------
 
@@ -516,6 +585,62 @@ class Tensorizer:
             out[i] = cnt
         return out
 
+    # ImageLocality thresholds (`plugins/imagelocality/image_locality.go`)
+    _IMG_MIN = 23 * 1024 * 1024
+    _IMG_MAX = 1000 * 1024 * 1024
+
+    def _static_score_for(self, g: PodGroup) -> np.ndarray:
+        """Per-node score terms that depend only on (group, node specs):
+        ImageLocality (w=1) + NodePreferAvoidPods (w=10000), both pre-weighted
+        (`registry.go:101-145`; neither plugin has a NormalizeScore)."""
+        n = self.label_index.n
+        # ImageLocality: sum of node-resident image sizes scaled by spread
+        sum_scores = np.zeros(n, np.float64)
+        if n:
+            for img in set(g.images):
+                entry = self.image_index.get(img)
+                if entry is None:
+                    continue
+                have, size = entry
+                spread = have.sum() / n
+                sum_scores += np.where(have, size * spread, 0.0)
+        img_score = np.clip(
+            (sum_scores - self._IMG_MIN) * 100.0 / (self._IMG_MAX - self._IMG_MIN),
+            0.0,
+            100.0,
+        )
+        img_score[sum_scores < self._IMG_MIN] = 0.0
+        score = img_score.astype(np.float32)
+        # NodePreferAvoidPods for RC/RS-owned pods: upstream adds
+        # weight·score = 10000·100 on non-avoid nodes and 0 on avoid nodes.
+        # Adding ~1e6 uniformly would erase sub-0.0625 deltas from the other
+        # plugins in float32, so keep the argmax-equivalent penalty form:
+        # 0 baseline, -1e6 only on avoid-annotated nodes.
+        if g.owner_kind in (C.KIND_RC, C.KIND_RS):
+            score -= 10000.0 * 100.0 * self.prefer_avoid.astype(np.float32)
+        return score
+
+    def _spread_selectors_for(self, g: PodGroup) -> List[dict]:
+        """LabelSelectors the SelectorSpread score counts against: services
+        selecting the group's pods, plus the controller's selector for
+        RC/RS/STS-owned pods (`plugins/selectorspread/selector_spread.go`).
+        Expanded pods inherit their owner's template labels verbatim
+        (`workloads/expand.py`), so the full label set stands in for the
+        owner's selector."""
+        sels: List[dict] = []
+        if g.owner_kind in (C.KIND_RC, C.KIND_RS, C.KIND_STS):
+            if g.labels:
+                sels.append({"matchLabels": dict(g.labels)})
+        for svc in self.services:
+            if namespace_of(svc) != g.namespace:
+                continue
+            raw = ((svc.get("spec") or {}).get("selector")) or {}
+            if not raw:
+                continue
+            if all(g.labels.get(k) == str(v) for k, v in raw.items()):
+                sels.append({"matchLabels": {k: str(v) for k, v in raw.items()}})
+        return sels
+
     def _intern_group(self, g: PodGroup) -> int:
         sig = g.signature()
         gid = self._group_ids.get(sig)
@@ -527,6 +652,52 @@ class Tensorizer:
         self._static_mask.append(self._static_mask_for(g))
         self._node_pref.append(self._node_pref_for(g))
         self._taint_intol.append(self._taint_intol_for(g))
+        self._static_score.append(self._static_score_for(g))
+
+        # NodePorts: intern the group's (protocol, port) pairs
+        prow: Dict[int, bool] = {}
+        for pair in g.host_ports:
+            prow[self.ports.intern(pair)] = True
+        self._port_rows.append(prow)
+
+        # PodTopologySpread: one term per constraint; stricter maxSkew wins
+        # on (key, selector) collisions
+        sp_hard: Dict[int, float] = {}
+        sp_soft: Dict[int, float] = {}
+        for raw in g.topology_spread:
+            c = json.loads(raw)
+            term = Term(
+                topology_key=c.get("topologyKey", ""),
+                namespaces=(g.namespace,),
+                selector_json=_canon(c.get("labelSelector")),
+            )
+            t = self._intern_term(term)
+            if c.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule":
+                skew = float(c.get("maxSkew", 1))
+                sp_hard[t] = min(sp_hard.get(t, np.inf), skew)
+            else:
+                sp_soft[t] = sp_soft.get(t, 0.0) + 1.0
+        self._spread_hard.append(sp_hard)
+        self._spread_soft.append(sp_soft)
+
+        # SelectorSpread: hostname + zone counting terms per spread selector
+        ssh: Dict[int, bool] = {}
+        ssz: Dict[int, bool] = {}
+        for sel in self._spread_selectors_for(g):
+            sel_json = _canon(sel)
+            ssh[
+                self._intern_term(
+                    Term(C.LABEL_HOSTNAME, (g.namespace,), sel_json)
+                )
+            ] = True
+            if self.zone_key is not None:
+                ssz[
+                    self._intern_term(
+                        Term(self.zone_key, (g.namespace,), sel_json)
+                    )
+                ] = True
+        self._ss_host.append(ssh)
+        self._ss_zone.append(ssz)
 
         s_match: Dict[int, bool] = {}
         a_aff: Dict[int, bool] = {}
@@ -634,6 +805,11 @@ class Tensorizer:
         node_dom = (
             np.stack(self._node_dom_rows) if self._node_dom_rows else np.zeros((0, n), np.int32)
         )
+        p_n = len(self.ports)
+        ports = np.zeros((g_n, p_n), bool)
+        for gi, row in enumerate(self._port_rows):
+            for p, v in row.items():
+                ports[gi, p] = v
         return ClusterTensors(
             node_names=list(self.label_index.names),
             resource_names=[str(r) for r in self.resources.items()],
@@ -651,6 +827,9 @@ class Tensorizer:
             taint_intolerable=(
                 np.stack(self._taint_intol) if g_n else np.zeros((0, n), np.float32)
             ),
+            static_score=(
+                np.stack(self._static_score) if g_n else np.zeros((0, n), np.float32)
+            ),
             terms=list(self.terms),
             term_topo_key=np.asarray(self._term_topo, np.int32),
             s_match=dense(self._s_match, bool),
@@ -658,6 +837,12 @@ class Tensorizer:
             a_anti_req=dense(self._a_anti, bool),
             w_aff_pref=dense(self._w_aff, np.float32),
             w_anti_pref=dense(self._w_anti, np.float32),
+            spread_hard=dense(self._spread_hard, np.float32),
+            spread_soft=dense(self._spread_soft, np.float32),
+            ss_host=dense(self._ss_host, bool),
+            ss_zone=dense(self._ss_zone, bool),
+            ports=ports,
+            n_ports=p_n,
             ext=self.ext,
             label_index=self.label_index,
         )

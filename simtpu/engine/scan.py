@@ -24,7 +24,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensorize import ClusterTensors, PodBatch
-from ..kernels.filters import interpod_filter, resources_fit
+from ..kernels.filters import (
+    interpod_filter,
+    ports_conflict_free,
+    resources_fit,
+    topology_spread_filter,
+)
 from ..kernels.gpushare import gpu_plan
 from ..kernels.scores import (
     balanced_allocation,
@@ -32,8 +37,10 @@ from ..kernels.scores import (
     least_allocated,
     maxabs_normalize,
     minmax_normalize,
+    selector_spread_score,
     simon_share,
     taint_toleration_score,
+    topology_spread_score,
 )
 from ..kernels.storage import device_plan, lvm_plan, open_local_score
 from .state import SchedState, build_state
@@ -47,6 +54,8 @@ FAIL_INTERPOD = 3  # inter-pod (anti-)affinity rules
 FAIL_NO_NODE = 4  # forced pod names an unknown node
 FAIL_STORAGE = 5  # Open-Local LVM/device storage
 FAIL_GPU = 6  # GPU-share memory/devices
+FAIL_PORTS = 7  # requested host port already in use everywhere feasible
+FAIL_SPREAD = 8  # topology spread maxSkew would be violated everywhere
 
 REASON_TEXT = {
     FAIL_STATIC: "node(s) didn't match node selector/affinity or had untolerated taints",
@@ -55,6 +64,8 @@ REASON_TEXT = {
     FAIL_NO_NODE: "pod references a node that does not exist",
     FAIL_STORAGE: "insufficient open-local storage (LVM volume groups / exclusive devices)",
     FAIL_GPU: "insufficient GPU memory on every feasible node's devices",
+    FAIL_PORTS: "node(s) didn't have free ports for the requested pod ports",
+    FAIL_SPREAD: "node(s) didn't match pod topology spread constraints",
 }
 
 
@@ -65,6 +76,7 @@ class StaticArrays(NamedTuple):
     static_mask: jnp.ndarray  # [G, N]
     node_pref: jnp.ndarray  # [G, N]
     taint_intol: jnp.ndarray  # [G, N]
+    static_score: jnp.ndarray  # [G, N] ImageLocality + NodePreferAvoidPods (pre-weighted)
     node_dom: jnp.ndarray  # [K, N]
     term_topo: jnp.ndarray  # [T]
     s_match: jnp.ndarray  # [G, T]
@@ -72,6 +84,11 @@ class StaticArrays(NamedTuple):
     a_anti_req: jnp.ndarray  # [G, T]
     w_aff_pref: jnp.ndarray  # [G, T]
     w_anti_pref: jnp.ndarray  # [G, T]
+    spread_hard: jnp.ndarray  # [G, T] maxSkew (0 = inactive)
+    spread_soft: jnp.ndarray  # [G, T] ScheduleAnyway multiplicity
+    ss_host: jnp.ndarray  # [G, T] SelectorSpread hostname terms
+    ss_zone: jnp.ndarray  # [G, T] SelectorSpread zone terms
+    ports_req: jnp.ndarray  # [G, P] host-port request incidence
     # extended resources
     has_storage: jnp.ndarray  # [N]
     vg_cap: jnp.ndarray  # [N, V]
@@ -121,6 +138,7 @@ def statics_from(tensors: ClusterTensors) -> StaticArrays:
         static_mask=jnp.asarray(tensors.static_mask),
         node_pref=jnp.asarray(tensors.node_pref_score),
         taint_intol=jnp.asarray(tensors.taint_intolerable),
+        static_score=jnp.asarray(tensors.static_score, jnp.float32),
         node_dom=jnp.asarray(tensors.node_dom, jnp.int32),
         term_topo=jnp.asarray(tensors.term_topo_key, jnp.int32),
         s_match=jnp.asarray(tensors.s_match),
@@ -128,6 +146,11 @@ def statics_from(tensors: ClusterTensors) -> StaticArrays:
         a_anti_req=jnp.asarray(tensors.a_anti_req),
         w_aff_pref=jnp.asarray(tensors.w_aff_pref),
         w_anti_pref=jnp.asarray(tensors.w_anti_pref),
+        spread_hard=jnp.asarray(tensors.spread_hard, jnp.float32),
+        spread_soft=jnp.asarray(tensors.spread_soft, jnp.float32),
+        ss_host=jnp.asarray(tensors.ss_host),
+        ss_zone=jnp.asarray(tensors.ss_zone),
+        ports_req=jnp.asarray(tensors.ports),
         has_storage=jnp.asarray(ext.has_storage),
         vg_cap=jnp.asarray(ext.vg_cap, jnp.float32),
         vg_name_id=jnp.asarray(ext.vg_name_id, jnp.int32),
@@ -163,7 +186,9 @@ def schedule_step(
     # pin: -1 = unpinned, -2 = pinned to a nonexistent node (matches nothing)
     pin_m = jnp.where(pin >= 0, node_ids == pin, pin > -2)
     m_static = static_m & pin_m & statics.node_valid
-    m_res = m_static & resources_fit(state.free, req)
+    # NodePorts precedes NodeResourcesFit in the registry filter order
+    m_ports = m_static & ports_conflict_free(state.ports_used, statics.ports_req[g])
+    m_res = m_ports & resources_fit(state.free, req)
 
     # Open-Local storage (plugin Filter, open-local.go:50-91): pods that need
     # storage only fit nodes carrying the storage annotation
@@ -186,7 +211,17 @@ def schedule_step(
     )
     m_gpu = m_storage & gpu_ok
 
-    m_all = m_gpu & interpod_filter(
+    # PodTopologySpread hard constraints (filtering.go); eligible-domain
+    # minimum taken over nodes passing the pod's static filters
+    m_spread = m_gpu & topology_spread_filter(
+        state.cnt_match,
+        statics.node_dom,
+        statics.term_topo,
+        statics.spread_hard[g],
+        m_static,
+    )
+
+    m_all = m_spread & interpod_filter(
         state.cnt_match,
         state.cnt_own_anti,
         statics.node_dom,
@@ -215,6 +250,25 @@ def schedule_step(
         statics.w_anti_pref[g],
     )
     score += maxabs_normalize(raw_ipa, m_all)
+    # PodTopologySpread soft constraints, registry weight 2
+    score += 2.0 * topology_spread_score(
+        state.cnt_match,
+        statics.node_dom,
+        statics.term_topo,
+        statics.spread_soft[g],
+        m_all,
+    )
+    # SelectorSpread (default workload/service spreading, weight 1)
+    score += selector_spread_score(
+        state.cnt_match,
+        statics.node_dom,
+        statics.term_topo,
+        statics.ss_host[g],
+        statics.ss_zone[g],
+        m_all,
+    )
+    # ImageLocality + NodePreferAvoidPods (static, pre-weighted)
+    score += statics.static_score[g]
     # Open-Local score (binpack; plugin weight 1) + GPU-share score — the
     # latter is the same dominant-share formula as Simon's
     # (open-gpu-share.go:84-110), so its normalized term repeats
@@ -232,7 +286,13 @@ def schedule_step(
     score = jnp.where(m_all, score, -jnp.inf)
 
     chosen = jnp.where(forced, pin, jnp.argmax(score).astype(jnp.int32))
-    placed = jnp.where(forced, pin >= 0, feasible)
+    # forced pods must still land on a node of THIS candidate cluster: the
+    # batched sweep expands DaemonSet pods for every clone node, and a clone
+    # outside the candidate must not absorb state updates (topology counts,
+    # free resources) that would corrupt smaller candidates
+    placed = jnp.where(
+        forced, (pin >= 0) & statics.node_valid[jnp.clip(pin, 0)], feasible
+    )
     reason = jnp.where(
         placed,
         OK,
@@ -243,12 +303,22 @@ def schedule_step(
                 ~jnp.any(m_static),
                 FAIL_STATIC,
                 jnp.where(
-                    ~jnp.any(m_res),
-                    FAIL_RESOURCES,
+                    ~jnp.any(m_ports),
+                    FAIL_PORTS,
                     jnp.where(
-                        ~jnp.any(m_storage),
-                        FAIL_STORAGE,
-                        jnp.where(~jnp.any(m_gpu), FAIL_GPU, FAIL_INTERPOD),
+                        ~jnp.any(m_res),
+                        FAIL_RESOURCES,
+                        jnp.where(
+                            ~jnp.any(m_storage),
+                            FAIL_STORAGE,
+                            jnp.where(
+                                ~jnp.any(m_gpu),
+                                FAIL_GPU,
+                                jnp.where(
+                                    ~jnp.any(m_spread), FAIL_SPREAD, FAIL_INTERPOD
+                                ),
+                            ),
+                        ),
                     ),
                 ),
             ),
@@ -259,6 +329,7 @@ def schedule_step(
     safe = jnp.clip(chosen, 0)
     w = jnp.where(placed, 1.0, 0.0)
     free = state.free.at[safe].add(-req * w)
+    ports_used = state.ports_used.at[safe].add(statics.ports_req[g] * w)
     vg_free = state.vg_free.at[safe].add(-lvm_alloc[safe] * w)
     sdev_free = state.sdev_free.at[safe].set(
         state.sdev_free[safe] & ~(dev_take[safe] & placed)
@@ -289,10 +360,15 @@ def schedule_step(
             vg_free=vg_free,
             sdev_free=sdev_free,
             gpu_free=gpu_free,
+            ports_used=ports_used,
         )
     else:
         new_state = state._replace(
-            free=free, vg_free=vg_free, sdev_free=sdev_free, gpu_free=gpu_free
+            free=free,
+            vg_free=vg_free,
+            sdev_free=sdev_free,
+            gpu_free=gpu_free,
+            ports_used=ports_used,
         )
 
     out_node = jnp.where(placed, chosen, -1)
@@ -378,3 +454,50 @@ class Engine:
             "dev_take": dev_take,
             "gpu_shares": gpu_shares,
         }
+
+    # -- preemption support -------------------------------------------------
+    # The placement log is the functional analog of the scheduler cache;
+    # evicting a victim = deleting its log entry (build_state recounts all
+    # derived state from the log on the next batch).
+
+    def remove_placements(self, indices: List[int]) -> dict:
+        """Delete log entries at `indices`; returns an undo token."""
+        idx = sorted(set(indices))
+        ext = self.ext_log
+        saved = {
+            "indices": idx,
+            "entries": [
+                (
+                    self.placed_group[i],
+                    self.placed_node[i],
+                    self.placed_req[i],
+                    ext["node"][i],
+                    ext["vg_alloc"][i],
+                    ext["sdev_take"][i],
+                    ext["gpu_shares"][i],
+                    ext["gpu_mem"][i],
+                )
+                for i in idx
+            ],
+        }
+        for i in reversed(idx):
+            del self.placed_group[i]
+            del self.placed_node[i]
+            del self.placed_req[i]
+            for key in ("node", "vg_alloc", "sdev_take", "gpu_shares", "gpu_mem"):
+                del ext[key][i]
+        return saved
+
+    def restore_placements(self, saved: dict) -> None:
+        """Undo a remove_placements (entries return to their positions)."""
+        ext = self.ext_log
+        for i, entry in zip(saved["indices"], saved["entries"]):
+            g, node, req, enode, vg, sdev, gpu_sh, gpu_mem = entry
+            self.placed_group.insert(i, g)
+            self.placed_node.insert(i, node)
+            self.placed_req.insert(i, req)
+            ext["node"].insert(i, enode)
+            ext["vg_alloc"].insert(i, vg)
+            ext["sdev_take"].insert(i, sdev)
+            ext["gpu_shares"].insert(i, gpu_sh)
+            ext["gpu_mem"].insert(i, gpu_mem)

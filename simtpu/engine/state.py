@@ -27,6 +27,7 @@ class SchedState(NamedTuple):
     vg_free:         [N, V] free LVM volume-group space (Open-Local)
     sdev_free:       [N, SD] exclusive storage devices still unallocated
     gpu_free:        [N, GD] free GPU memory per device (GPU-share)
+    ports_used:      [N, P] in-use (protocol, hostPort) pairs (NodePorts)
     """
 
     free: jnp.ndarray
@@ -38,6 +39,7 @@ class SchedState(NamedTuple):
     vg_free: jnp.ndarray
     sdev_free: jnp.ndarray
     gpu_free: jnp.ndarray
+    ports_used: jnp.ndarray
 
 
 def build_state(
@@ -72,6 +74,13 @@ def build_state(
             pn,
             -np.asarray(placed_ext["gpu_shares"], np.float32)
             * np.asarray(placed_ext["gpu_mem"], np.float32)[:, None],
+        )
+    ports_used = np.zeros((n, tensors.n_ports), np.float32)
+    if len(placed_group) and tensors.n_ports:
+        np.add.at(
+            ports_used,
+            placed_node,
+            tensors.ports[placed_group].astype(np.float32),
         )
     cnt = np.zeros((5, max(t, 0), d), np.float32)
     if len(placed_group):
@@ -109,4 +118,5 @@ def build_state(
         vg_free=jnp.asarray(vg_free),
         sdev_free=jnp.asarray(sdev_free),
         gpu_free=jnp.asarray(gpu_free),
+        ports_used=jnp.asarray(ports_used),
     )

@@ -31,6 +31,60 @@ def resources_fit(free: jnp.ndarray, req: jnp.ndarray) -> jnp.ndarray:
     return jnp.all(free + slack >= req, axis=-1)
 
 
+def ports_conflict_free(ports_used: jnp.ndarray, want: jnp.ndarray) -> jnp.ndarray:
+    """NodePorts: no requested (protocol, hostPort) pair already in use on the
+    node (`plugins/nodeports/node_ports.go` Filter).
+
+    ports_used: [N, P] in-use counts, want: [P] bool → mask [N].
+    """
+    return ~jnp.any(want[None, :] & (ports_used > 0), axis=-1)
+
+
+def topology_spread_filter(
+    cnt_match: jnp.ndarray,  # [T, D] placed pods matching term selector, per domain
+    node_dom: jnp.ndarray,  # [K, N] global domain id per topo key (-1 absent)
+    term_topo: jnp.ndarray,  # [T]
+    max_skew: jnp.ndarray,  # [T] maxSkew of the pod's DoNotSchedule constraints (0 = inactive)
+    elig_nodes: jnp.ndarray,  # [N] nodes eligible for the pod (static mask ∩ valid)
+) -> jnp.ndarray:
+    """PodTopologySpread hard filter (`plugins/podtopologyspread/filtering.go`):
+    placing on node n must keep `count(domain of n) + 1 - min count over
+    eligible domains <= maxSkew` for every DoNotSchedule constraint; nodes
+    missing the topology key are infeasible for that constraint.
+
+    The eligible-domain minimum is taken over domains containing ≥1 node that
+    passes the pod's static filters (upstream restricts to nodes passing
+    nodeSelector/nodeAffinity; our static mask folds taints in as well — a
+    strictly tighter, usually identical set). Counts are cluster-wide per
+    domain rather than restricted to eligible nodes.
+    """
+    t_count, d_count = cnt_match.shape
+    n = node_dom.shape[-1] if node_dom.ndim else elig_nodes.shape[0]
+    active = max_skew > 0
+    if t_count == 0:
+        return jnp.ones(n, bool)
+    if d_count == 0:
+        # term universe exists but no node carries any topology key: every
+        # active constraint is unsatisfiable (upstream filters nodes missing
+        # the key), so feasibility is simply "pod has no hard constraint"
+        return jnp.broadcast_to(~jnp.any(active), (n,))
+    dom_tn = node_dom[term_topo]  # [T, N]
+    valid = dom_tn >= 0
+    safe = jnp.where(valid, dom_tn, 0)
+    t_idx = jnp.arange(t_count)[:, None]
+    cnt_at = jnp.where(valid, cnt_match[t_idx, safe], 0.0)  # [T, N]
+    # eligible-domain incidence and per-term minimum count
+    contrib = (valid & elig_nodes[None, :]).astype(jnp.int32)
+    elig_td = jnp.zeros((t_count, d_count), jnp.int32).at[t_idx, safe].max(contrib)
+    inf = jnp.float32(3.4e38)
+    min_cnt = jnp.min(jnp.where(elig_td > 0, cnt_match, inf), axis=1)  # [T]
+    min_cnt = jnp.where(min_cnt >= inf, 0.0, min_cnt)
+    ok_tn = (~active[:, None]) | (
+        valid & (cnt_at + 1.0 - min_cnt[:, None] <= max_skew[:, None])
+    )
+    return jnp.all(ok_tn, axis=0)
+
+
 def interpod_filter(
     cnt_match: jnp.ndarray,  # [T, D] placed pods matching term selector+ns
     cnt_own_anti: jnp.ndarray,  # [T, D] placed pods owning required anti term

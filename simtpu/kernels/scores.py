@@ -92,6 +92,77 @@ def taint_toleration_score(intolerable_cnt: jnp.ndarray, mask: jnp.ndarray) -> j
     )
 
 
+def _counts_at_nodes(
+    cnt_match: jnp.ndarray,  # [T, D]
+    node_dom: jnp.ndarray,  # [K, N]
+    term_topo: jnp.ndarray,  # [T]
+    term_w: jnp.ndarray,  # [T] per-term weight (0 = term not counted)
+) -> jnp.ndarray:
+    """Weighted sum over terms of each node's domain count → [N]."""
+    t_count = cnt_match.shape[0]
+    if t_count == 0:
+        return jnp.zeros(node_dom.shape[-1] if node_dom.ndim else 0, jnp.float32)
+    dom_tn = node_dom[term_topo]
+    valid = dom_tn >= 0
+    safe = jnp.where(valid, dom_tn, 0)
+    t_idx = jnp.arange(t_count)[:, None]
+    cnt_at = jnp.where(valid, cnt_match[t_idx, safe], 0.0)
+    return jnp.sum(term_w[:, None] * cnt_at, axis=0)
+
+
+def topology_spread_score(
+    cnt_match: jnp.ndarray,  # [T, D]
+    node_dom: jnp.ndarray,  # [K, N]
+    term_topo: jnp.ndarray,  # [T]
+    soft_w: jnp.ndarray,  # [T] ScheduleAnyway constraint multiplicity
+    mask: jnp.ndarray,  # [N] feasible nodes
+) -> jnp.ndarray:
+    """PodTopologySpread score (`plugins/podtopologyspread/scoring.go`,
+    registry weight 2 applied by the caller): lower matching count in the
+    node's domains → higher score, inverse-min-max to [0, 100]; nodes missing
+    a topology key count 0 for that constraint."""
+    raw = _counts_at_nodes(cnt_match, node_dom, term_topo, soft_w)
+    big = jnp.float32(3.4e38)
+    lo = jnp.min(jnp.where(mask, raw, big))
+    hi = jnp.max(jnp.where(mask, raw, -big))
+    rng = hi - lo
+    return jnp.where(
+        rng > 0, MAX_NODE_SCORE * (hi - raw) / jnp.maximum(rng, 1e-30), MAX_NODE_SCORE
+    )
+
+
+def selector_spread_score(
+    cnt_match: jnp.ndarray,  # [T, D]
+    node_dom: jnp.ndarray,  # [K, N]
+    term_topo: jnp.ndarray,  # [T]
+    ss_host: jnp.ndarray,  # [T] hostname-key counting terms of the pod
+    ss_zone: jnp.ndarray,  # [T] zone-key counting terms
+    mask: jnp.ndarray,  # [N]
+) -> jnp.ndarray:
+    """SelectorSpread score (`plugins/selectorspread/selector_spread.go`):
+    spread pods of the same service/controller across nodes, then zones with
+    zoneWeighting=2/3 when zones exist."""
+    cnt_host = _counts_at_nodes(cnt_match, node_dom, term_topo, ss_host.astype(jnp.float32))
+    cnt_zone = _counts_at_nodes(cnt_match, node_dom, term_topo, ss_zone.astype(jnp.float32))
+    max_host = jnp.max(jnp.where(mask, cnt_host, 0.0))
+    max_zone = jnp.max(jnp.where(mask, cnt_zone, 0.0))
+    node_score = jnp.where(
+        max_host > 0,
+        MAX_NODE_SCORE * (max_host - cnt_host) / jnp.maximum(max_host, 1e-30),
+        MAX_NODE_SCORE,
+    )
+    zone_score = jnp.where(
+        max_zone > 0,
+        MAX_NODE_SCORE * (max_zone - cnt_zone) / jnp.maximum(max_zone, 1e-30),
+        MAX_NODE_SCORE,
+    )
+    have_zones = jnp.any(ss_zone) & (max_zone > 0)
+    zw = jnp.float32(2.0 / 3.0)
+    return jnp.where(
+        have_zones, (1.0 - zw) * node_score + zw * zone_score, node_score
+    )
+
+
 def interpod_score(
     cnt_match: jnp.ndarray,  # [T, D]
     own_aff_req: jnp.ndarray,  # [T, D] placed owners of required affinity terms

@@ -48,6 +48,7 @@ def pad_statics(statics: StaticArrays, multiple: int) -> Tuple[StaticArrays, int
             static_mask=_pad_axis(statics.static_mask, 1, pad, False),
             node_pref=_pad_axis(statics.node_pref, 1, pad, 0.0),
             taint_intol=_pad_axis(statics.taint_intol, 1, pad, 0.0),
+            static_score=_pad_axis(statics.static_score, 1, pad, 0.0),
             node_dom=_pad_axis(statics.node_dom, 1, pad, -1),
             has_storage=_pad_axis(statics.has_storage, 0, pad, False),
             vg_cap=_pad_axis(statics.vg_cap, 0, pad, 0.0),
@@ -70,6 +71,7 @@ def pad_state(state: SchedState, pad: int) -> SchedState:
         vg_free=_pad_axis(state.vg_free, 0, pad, 0.0),
         sdev_free=_pad_axis(state.sdev_free, 0, pad, False),
         gpu_free=_pad_axis(state.gpu_free, 0, pad, 0.0),
+        ports_used=_pad_axis(state.ports_used, 0, pad, 0.0),
     )
 
 
@@ -84,6 +86,7 @@ def statics_sharding(mesh: Mesh) -> StaticArrays:
         static_mask=trail,
         node_pref=trail,
         taint_intol=trail,
+        static_score=trail,
         node_dom=trail,
         term_topo=rep,
         s_match=rep,
@@ -91,6 +94,11 @@ def statics_sharding(mesh: Mesh) -> StaticArrays:
         a_anti_req=rep,
         w_aff_pref=rep,
         w_anti_pref=rep,
+        spread_hard=rep,
+        spread_soft=rep,
+        ss_host=rep,
+        ss_zone=rep,
+        ports_req=rep,
         has_storage=lead,
         vg_cap=lead2,
         vg_name_id=lead2,
@@ -115,6 +123,7 @@ def state_sharding(mesh: Mesh) -> SchedState:
         vg_free=lead2,
         sdev_free=lead2,
         gpu_free=lead2,
+        ports_used=lead2,
     )
 
 

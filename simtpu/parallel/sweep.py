@@ -98,7 +98,10 @@ def sweep_feasibility(
         ordered.extend(_sort_app_pods(pods))
 
     tensorizer = Tensorizer(
-        all_nodes, extended_resources, storage_classes=list(cluster.storage_classes)
+        all_nodes,
+        extended_resources,
+        storage_classes=list(cluster.storage_classes),
+        services=list(cluster.services),
     )
     batch = tensorizer.add_pods(ordered)
     tensors = tensorizer.freeze()
@@ -118,6 +121,7 @@ def sweep_feasibility(
     clone_idx = np.arange(n_total) - n_base
     valid_s = (clone_idx[None, :] < candidates[:, None]) | (clone_idx[None, :] < 0)
 
+    n_cand = len(candidates)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -126,6 +130,13 @@ def sweep_feasibility(
         state = pad_state(state, pad)
         if pad:
             valid_s = np.pad(valid_s, ((0, 0), (0, pad)))
+        # the candidate axis must also divide its mesh axis: replicate the
+        # last candidate row as padding and drop those rows from the output
+        s_pad = (-n_cand) % mesh.shape[SWEEP_AXIS]
+        if s_pad:
+            valid_s = np.concatenate(
+                [valid_s, np.repeat(valid_s[-1:], s_pad, axis=0)]
+            )
         statics = jax.device_put(statics, statics_sharding(mesh))
         state = jax.device_put(state, state_sharding(mesh))
         valid_arr = jax.device_put(
@@ -136,7 +147,7 @@ def sweep_feasibility(
         valid_arr = jnp.asarray(valid_s)
 
     _, outs = _sweep_scan(statics, valid_arr, state, pods_arrays)
-    nodes_sp = np.asarray(outs[0])  # [S, P] chosen node (-1 = failed)
+    nodes_sp = np.asarray(outs[0])[:n_cand]  # [S, P] chosen node (-1 = failed)
 
     # per-candidate failure count, ignoring pods that only exist on clones
     # beyond the candidate's size (pins into invalid clone rows)
@@ -169,7 +180,10 @@ def plan_capacity_batched(
     from ..api import simulate
 
     say = progress or (lambda s: None)
-    candidates = list(range(max_new_nodes + 1))
+    # parity with the serial planner: the largest candidate ever simulated is
+    # max_new_nodes-1 (the reference's `for i := 0; i < MaxNumNewNode` walk,
+    # apply.go:183; see plan_capacity)
+    candidates = list(range(max_new_nodes))
     say(f"sweeping {len(candidates)} candidate sizes in one batch")
     failures, _, _ = sweep_feasibility(
         cluster, apps, new_node, candidates, extended_resources, mesh

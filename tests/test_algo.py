@@ -1,0 +1,67 @@
+"""Tests for the pod-ordering queues (`pkg/algo` port: simtpu/algo.py)."""
+
+from __future__ import annotations
+
+from simtpu.algo import (
+    affinity_sort,
+    cluster_total_resources,
+    greed_sort,
+    pod_dominant_share,
+    share,
+    toleration_sort,
+)
+
+from .fixtures import (
+    make_fake_node,
+    make_fake_pod,
+    with_pod_node_selector,
+    with_pod_tolerations,
+)
+
+
+def test_share_edge_cases():
+    # greed.go:69-83
+    assert share(0, 0) == 0.0
+    assert share(5, 0) == 1.0
+    assert share(2, 8) == 0.25
+
+
+def test_cluster_totals_and_dominant_share():
+    nodes = [make_fake_node(f"n{i}", "10", "100Gi") for i in range(2)]
+    total = cluster_total_resources(nodes)
+    assert total["cpu"] == 20.0
+    pod = make_fake_pod("p", "default", "5", "10Gi")
+    # cpu share 5/20 = 0.25 dominates memory 10/200 = 0.05
+    assert abs(pod_dominant_share(pod, total) - 0.25) < 1e-9
+
+
+def test_greed_sort_descending_share_nodename_first():
+    nodes = [make_fake_node("n0", "10", "100Gi")]
+    small = make_fake_pod("small", "default", "1", "1Gi")
+    big = make_fake_pod("big", "default", "8", "1Gi")
+    pinned = make_fake_pod("pinned", "default", "1", "1Gi")
+    pinned["spec"]["nodeName"] = "n0"
+    order = [p["metadata"]["name"] for p in greed_sort([small, big, pinned], nodes)]
+    assert order == ["pinned", "big", "small"]
+
+
+def test_affinity_and_toleration_sorts():
+    plain = make_fake_pod("plain", "default", "1", "1Gi")
+    sel = make_fake_pod(
+        "sel", "default", "1", "1Gi", with_pod_node_selector({"disk": "ssd"})
+    )
+    tol = make_fake_pod(
+        "tol",
+        "default",
+        "1",
+        "1Gi",
+        with_pod_tolerations([{"key": "k", "operator": "Exists"}]),
+    )
+    assert [p["metadata"]["name"] for p in affinity_sort([plain, sel])] == [
+        "sel",
+        "plain",
+    ]
+    assert [p["metadata"]["name"] for p in toleration_sort([plain, tol])] == [
+        "tol",
+        "plain",
+    ]

@@ -1,0 +1,289 @@
+"""Tests for the second wave of in-tree plugin kernels: NodePorts,
+PodTopologySpread (filter + score), SelectorSpread, ImageLocality and
+NodePreferAvoidPods — the remaining rows of the SURVEY.md §2.2 plugin
+checklist (`vendor/.../algorithmprovider/registry.go:75-145`).
+"""
+
+from __future__ import annotations
+
+import json
+
+from simtpu.api import simulate
+from simtpu.core.objects import ResourceTypes
+
+from .fixtures import (
+    make_fake_node,
+    make_fake_pod,
+    make_fake_replica_set,
+    with_node_labels,
+    with_pod_labels,
+)
+
+
+def _cluster(nodes, **kw):
+    return ResourceTypes(nodes=nodes, **kw)
+
+
+def _placements(result):
+    out = {}
+    for status in result.node_status:
+        for pod in status.pods:
+            out[pod["metadata"]["name"]] = status.node["metadata"]["name"]
+    return out
+
+
+def with_host_port(port, protocol="TCP"):
+    def opt(pod):
+        c = pod["spec"]["containers"][0]
+        c.setdefault("ports", []).append(
+            {"containerPort": port, "hostPort": port, "protocol": protocol}
+        )
+
+    return opt
+
+
+def with_spread_constraint(max_skew, key, when, match_labels):
+    def opt(pod):
+        pod["spec"].setdefault("topologySpreadConstraints", []).append(
+            {
+                "maxSkew": max_skew,
+                "topologyKey": key,
+                "whenUnsatisfiable": when,
+                "labelSelector": {"matchLabels": match_labels},
+            }
+        )
+
+    return opt
+
+
+class TestNodePorts:
+    def test_conflicting_host_ports_spread_then_fail(self):
+        nodes = [make_fake_node(f"n{i}", "32", "64Gi") for i in range(2)]
+        pods = [
+            make_fake_pod(f"p{i}", "default", "1", "1Gi", with_host_port(8080))
+            for i in range(3)
+        ]
+        result = simulate(_cluster(nodes, pods=pods))
+        # two pods land on distinct nodes, the third has no port-free node
+        placed = _placements(result)
+        assert len(placed) == 2
+        assert len(set(placed.values())) == 2
+        assert len(result.unscheduled_pods) == 1
+        assert "ports" in result.unscheduled_pods[0].reason
+
+    def test_different_ports_coexist(self):
+        nodes = [make_fake_node("n0", "32", "64Gi")]
+        pods = [
+            make_fake_pod("p0", "default", "1", "1Gi", with_host_port(8080)),
+            make_fake_pod("p1", "default", "1", "1Gi", with_host_port(8081)),
+            # same port number but UDP does not conflict with TCP
+            make_fake_pod("p2", "default", "1", "1Gi", with_host_port(8080, "UDP")),
+        ]
+        result = simulate(_cluster(nodes, pods=pods))
+        assert not result.unscheduled_pods
+
+    def test_no_host_port_unaffected(self):
+        nodes = [make_fake_node("n0", "32", "64Gi")]
+        pods = [make_fake_pod(f"p{i}", "default", "1", "1Gi") for i in range(5)]
+        result = simulate(_cluster(nodes, pods=pods))
+        assert not result.unscheduled_pods
+
+
+class TestPodTopologySpread:
+    ZONE = "topology.kubernetes.io/zone"
+
+    def _zoned_nodes(self, per_zone=2, zones=("a", "b")):
+        nodes = []
+        for z in zones:
+            for i in range(per_zone):
+                nodes.append(
+                    make_fake_node(
+                        f"n-{z}{i}",
+                        "32",
+                        "64Gi",
+                        with_node_labels({self.ZONE: z, "kubernetes.io/hostname": f"n-{z}{i}"}),
+                    )
+                )
+        return nodes
+
+    def test_hard_constraint_balances_zones(self):
+        nodes = self._zoned_nodes()
+        pods = [
+            make_fake_pod(
+                f"p{i}",
+                "default",
+                "1",
+                "1Gi",
+                with_pod_labels({"app": "web"}),
+                with_spread_constraint(1, self.ZONE, "DoNotSchedule", {"app": "web"}),
+            )
+            for i in range(4)
+        ]
+        result = simulate(_cluster(nodes, pods=pods))
+        assert not result.unscheduled_pods
+        zone_counts = {"a": 0, "b": 0}
+        for status in result.node_status:
+            z = status.node["metadata"]["labels"][self.ZONE]
+            zone_counts[z] += len(status.pods)
+        assert abs(zone_counts["a"] - zone_counts["b"]) <= 1
+
+    def test_hard_constraint_fails_when_skew_unavoidable(self):
+        # one zone has capacity for pods, the other zone's node is full
+        nodes = self._zoned_nodes(per_zone=1)
+        full = make_fake_pod("filler", "default", "31.5", "1Gi")
+        full["spec"]["nodeName"] = "n-b0"
+        spread = [
+            make_fake_pod(
+                f"p{i}",
+                "default",
+                "1",
+                "1Gi",
+                with_pod_labels({"app": "api"}),
+                with_spread_constraint(1, self.ZONE, "DoNotSchedule", {"app": "api"}),
+            )
+            for i in range(3)
+        ]
+        result = simulate(_cluster(nodes, pods=[full] + spread))
+        # p0 → zone a; p1 must go to zone b (skew) but b is full → fails;
+        # p2 likewise: only one spread pod can ever place
+        placed = [
+            p
+            for s in result.node_status
+            for p in s.pods
+            if p["metadata"]["name"].startswith("p")
+        ]
+        assert len(placed) == 1
+        assert any(
+            "topology spread" in u.reason for u in result.unscheduled_pods
+        )
+
+    def test_soft_constraint_spreads_without_blocking(self):
+        nodes = self._zoned_nodes(per_zone=1)
+        pods = [
+            make_fake_pod(
+                f"p{i}",
+                "default",
+                "1",
+                "1Gi",
+                with_pod_labels({"app": "soft"}),
+                with_spread_constraint(1, self.ZONE, "ScheduleAnyway", {"app": "soft"}),
+            )
+            for i in range(4)
+        ]
+        result = simulate(_cluster(nodes, pods=pods))
+        assert not result.unscheduled_pods
+        counts = [len(s.pods) for s in result.node_status]
+        assert max(counts) - min(counts) <= 1  # alternated a/b/a/b
+
+
+class TestSelectorSpread:
+    def test_rs_pods_spread_across_nodes(self):
+        # identical nodes, no anti-affinity: SelectorSpread alone must spread
+        # the replica set's pods instead of stacking them on one node
+        nodes = [
+            make_fake_node(
+                f"n{i}",
+                "32",
+                "64Gi",
+                with_node_labels({"kubernetes.io/hostname": f"n{i}"}),
+            )
+            for i in range(3)
+        ]
+        rs = make_fake_replica_set("web", "default", 3, "1", "1Gi")
+        rs["spec"]["template"]["metadata"] = {"labels": {"app": "web"}}
+        rs["spec"]["selector"] = {"matchLabels": {"app": "web"}}
+        result = simulate(_cluster(nodes, replica_sets=[rs]))
+        assert not result.unscheduled_pods
+        counts = sorted(len(s.pods) for s in result.node_status)
+        assert counts == [1, 1, 1]
+
+    def test_service_pods_spread(self):
+        nodes = [
+            make_fake_node(
+                f"n{i}",
+                "32",
+                "64Gi",
+                with_node_labels({"kubernetes.io/hostname": f"n{i}"}),
+            )
+            for i in range(2)
+        ]
+        svc = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": "svc", "namespace": "default"},
+            "spec": {"selector": {"app": "svc-app"}},
+        }
+        pods = [
+            make_fake_pod(
+                f"p{i}", "default", "1", "1Gi", with_pod_labels({"app": "svc-app"})
+            )
+            for i in range(2)
+        ]
+        result = simulate(_cluster(nodes, pods=pods, services=[svc]))
+        assert not result.unscheduled_pods
+        assert sorted(len(s.pods) for s in result.node_status) == [1, 1]
+
+
+class TestImageLocality:
+    def test_prefers_node_with_image(self):
+        n0 = make_fake_node("n0", "32", "64Gi")
+        n1 = make_fake_node("n1", "32", "64Gi")
+        n1["status"]["images"] = [
+            {"names": ["bigimage:v1"], "sizeBytes": 800 * 1024 * 1024}
+        ]
+        pod = make_fake_pod("p0", "default", "1", "1Gi")
+        pod["spec"]["containers"][0]["image"] = "bigimage:v1"
+        result = simulate(_cluster([n0, n1], pods=[pod]))
+        assert _placements(result)["p0"] == "n1"
+
+    def test_small_image_below_threshold_ignored(self):
+        n0 = make_fake_node("n0", "32", "64Gi")
+        n1 = make_fake_node("n1", "32", "64Gi")
+        n1["status"]["images"] = [
+            {"names": ["tiny:v1"], "sizeBytes": 1 * 1024 * 1024}
+        ]
+        pod = make_fake_pod("p0", "default", "1", "1Gi")
+        pod["spec"]["containers"][0]["image"] = "tiny:v1"
+        result = simulate(_cluster([n0, n1], pods=[pod]))
+        # 0.5 MiB of spread-scaled size is under the 23 MiB threshold:
+        # ImageLocality contributes nothing, first node wins the tie
+        assert _placements(result)["p0"] == "n0"
+
+
+class TestNodePreferAvoidPods:
+    ANNO = "scheduler.alpha.kubernetes.io/preferAvoidPods"
+
+    def _avoid_node(self, name):
+        node = make_fake_node(name, "32", "64Gi")
+        node["metadata"]["annotations"][self.ANNO] = json.dumps(
+            {
+                "preferAvoidPods": [
+                    {
+                        "podSignature": {
+                            "podController": {"kind": "ReplicationController"}
+                        },
+                        "reason": "some reason",
+                    }
+                ]
+            }
+        )
+        return node
+
+    def test_rs_pod_avoids_annotated_node(self):
+        avoid = self._avoid_node("n0")
+        normal = make_fake_node("n1", "32", "64Gi")
+        rs = make_fake_replica_set("web", "default", 1, "1", "1Gi")
+        rs["spec"]["template"]["metadata"] = {"labels": {"app": "web"}}
+        rs["spec"]["selector"] = {"matchLabels": {"app": "web"}}
+        result = simulate(_cluster([avoid, normal], replica_sets=[rs]))
+        placed = _placements(result)
+        assert len(placed) == 1
+        assert set(placed.values()) == {"n1"}
+
+    def test_bare_pod_not_affected(self):
+        avoid = self._avoid_node("n0")
+        normal = make_fake_node("n1", "32", "64Gi")
+        pod = make_fake_pod("p0", "default", "1", "1Gi")
+        result = simulate(_cluster([avoid, normal], pods=[pod]))
+        # plugin only applies to RC/RS-owned pods; bare pod ties → first node
+        assert _placements(result)["p0"] == "n0"

@@ -1,0 +1,130 @@
+"""Tests for the DefaultPreemption analog (`simtpu/api.py _try_preempt`,
+mirroring `vendor/.../plugins/defaultpreemption/default_preemption.go`).
+"""
+
+from __future__ import annotations
+
+from simtpu.api import simulate
+from simtpu.core.objects import ResourceTypes
+
+from .fixtures import make_fake_node, make_fake_pod
+
+
+def _prio(pod, p):
+    pod["spec"]["priority"] = p
+    return pod
+
+
+def _placements(result):
+    out = {}
+    for status in result.node_status:
+        for pod in status.pods:
+            out[pod["metadata"]["name"]] = status.node["metadata"]["name"]
+    return out
+
+
+def test_high_priority_pod_preempts_lower():
+    node = make_fake_node("n0", "10", "16Gi")
+    fillers = [
+        _prio(make_fake_pod(f"low{i}", "default", "4", "1Gi"), 0) for i in range(2)
+    ]
+    vip = _prio(make_fake_pod("vip", "default", "6", "1Gi"), 1000)
+    result = simulate(ResourceTypes(nodes=[node], pods=fillers + [vip]))
+    placed = _placements(result)
+    assert "vip" in placed
+    assert len(result.preempted_pods) == 1
+    assert result.preempted_pods[0].pod["metadata"]["name"].startswith("low")
+    assert result.preempted_pods[0].preempted_by == "default/vip"
+    assert result.preempted_pods[0].node == "n0"
+    # one low pod survives: 4 + 6 = 10 cpu
+    assert sum(1 for name in placed if name.startswith("low")) == 1
+    assert not result.unscheduled_pods
+
+
+def test_equal_priority_does_not_preempt():
+    node = make_fake_node("n0", "10", "16Gi")
+    fillers = [
+        _prio(make_fake_pod(f"low{i}", "default", "4", "1Gi"), 10) for i in range(2)
+    ]
+    pod = _prio(make_fake_pod("late", "default", "6", "1Gi"), 10)
+    result = simulate(ResourceTypes(nodes=[node], pods=fillers + [pod]))
+    assert not result.preempted_pods
+    assert len(result.unscheduled_pods) == 1
+    assert result.unscheduled_pods[0].pod["metadata"]["name"] == "late"
+
+
+def test_picks_node_with_lowest_victim_priority():
+    # n0 carries a prio-50 pod, n1 a prio-5 pod; preemptor (prio 100) must
+    # evict from n1 (lowest max victim priority)
+    n0 = make_fake_node("n0", "4", "16Gi")
+    n1 = make_fake_node("n1", "4", "16Gi")
+    p0 = _prio(make_fake_pod("mid", "default", "4", "1Gi"), 50)
+    p0["spec"]["nodeName"] = "n0"
+    p1 = _prio(make_fake_pod("small", "default", "4", "1Gi"), 5)
+    p1["spec"]["nodeName"] = "n1"
+    vip = _prio(make_fake_pod("vip", "default", "3", "1Gi"), 100)
+    result = simulate(ResourceTypes(nodes=[n0, n1], pods=[p0, p1, vip]))
+    placed = _placements(result)
+    assert placed.get("vip") == "n1"
+    assert [p.pod["metadata"]["name"] for p in result.preempted_pods] == ["small"]
+
+
+def test_minimal_victim_set():
+    # evicting ONE 2-cpu victim suffices for the 2-cpu preemptor; both lows
+    # must not be evicted
+    node = make_fake_node("n0", "8", "16Gi")
+    fillers = [
+        _prio(make_fake_pod(f"low{i}", "default", "2", "1Gi"), 0) for i in range(4)
+    ]
+    vip = _prio(make_fake_pod("vip", "default", "2", "1Gi"), 9)
+    result = simulate(ResourceTypes(nodes=[node], pods=fillers + [vip]))
+    assert len(result.preempted_pods) == 1
+    assert not result.unscheduled_pods
+
+
+def test_mid_batch_failure_keeps_bookkeeping_aligned():
+    # the failing pod is NOT last in its batch: a pod placed after it in the
+    # same batch must not skew the engine-log ↔ simulator bookkeeping
+    node = make_fake_node("n0", "10", "16Gi")
+    pods = [
+        _prio(make_fake_pod("low0", "default", "4", "1Gi"), 0),
+        _prio(make_fake_pod("low1", "default", "4", "1Gi"), 0),
+        _prio(make_fake_pod("vip", "default", "6", "1Gi"), 1000),
+        _prio(make_fake_pod("tiny", "default", "1", "1Gi"), 0),
+    ]
+    result = simulate(ResourceTypes(nodes=[node], pods=pods))
+    placed = _placements(result)
+    # low0+low1+tiny place first (9 cpu); vip preempts the minimal victim
+    # set {tiny, low1} (latest lowest-priority placements) and lands
+    assert "vip" in placed
+    assert not result.unscheduled_pods
+    names = {p.pod["metadata"]["name"] for p in result.preempted_pods}
+    assert names == {"tiny", "low1"}
+    assert set(placed) == {"low0", "vip"}
+
+
+def test_preempts_port_holder():
+    import copy
+
+    node = make_fake_node("n0", "32", "64Gi")
+    low = _prio(make_fake_pod("low", "default", "1", "1Gi"), 0)
+    low["spec"]["containers"][0]["ports"] = [
+        {"containerPort": 80, "hostPort": 80, "protocol": "TCP"}
+    ]
+    vip = _prio(copy.deepcopy(low), 100)
+    vip["metadata"]["name"] = "vip"
+    result = simulate(ResourceTypes(nodes=[node], pods=[low, vip]))
+    placed = _placements(result)
+    assert "vip" in placed
+    assert [p.pod["metadata"]["name"] for p in result.preempted_pods] == ["low"]
+    assert not result.unscheduled_pods
+
+
+def test_static_failures_never_preempt():
+    node = make_fake_node("n0", "10", "16Gi")
+    filler = _prio(make_fake_pod("low", "default", "9", "1Gi"), 0)
+    vip = _prio(make_fake_pod("vip", "default", "1", "1Gi"), 1000)
+    vip["spec"]["nodeSelector"] = {"nonexistent": "label"}
+    result = simulate(ResourceTypes(nodes=[node], pods=[filler, vip]))
+    assert not result.preempted_pods
+    assert len(result.unscheduled_pods) == 1
