@@ -31,12 +31,14 @@ from .core.objects import (
 from .core.quantity import parse_quantity
 from .core.tensorize import Tensorizer, _group_of_pod
 from .engine.scan import (
+    FAIL_ATTACH,
     FAIL_GPU,
     FAIL_INTERPOD,
     FAIL_PORTS,
     FAIL_RESOURCES,
     FAIL_SPREAD,
     FAIL_STORAGE,
+    FAIL_VOLUME,
     OK,
     REASON_TEXT,
     Engine,
@@ -52,6 +54,8 @@ _PREEMPTIBLE_REASONS = {
     FAIL_GPU,
     FAIL_INTERPOD,
     FAIL_SPREAD,
+    FAIL_VOLUME,
+    FAIL_ATTACH,
 }
 from .workloads.expand import (
     get_valid_pods_exclude_daemonset,
@@ -106,6 +110,8 @@ class Simulator:
             self._extra_resources,
             storage_classes=self._storage_classes,
             services=list(cluster.services),
+            pvcs=list(cluster.persistent_volume_claims),
+            pvs=list(cluster.persistent_volumes),
         )
         self._engine = self._engine_factory(self._tensorizer)
         self._schedule_pods(cluster.pods)
@@ -232,6 +238,10 @@ class Simulator:
         pod_ports = set(tz._port_rows[gid].keys())
         anti_terms = {t for t, v in tz._a_anti[gid].items() if v}
         spread_terms = {t for t, v in tz._spread_hard[gid].items() if v > 0}
+        pod_conflict_keys = set(tz._vol_rw_rows[gid]) | set(tz._vol_ro_rows[gid])
+        pod_att_classes = {
+            tz._vol_class[w] for w in tz._vol_att_rows[gid] if w in tz._vol_class
+        }
         probe = tz.add_pods([pod])
         gpu_need = float(probe.ext["gpu_mem"][0]) * max(
             float(probe.ext["gpu_count"][0]), 1.0
@@ -253,6 +263,20 @@ class Simulator:
                 return any(tz._s_match[vg].get(t) for t in anti_terms)
             if reason == FAIL_SPREAD:
                 return any(tz._s_match[vg].get(t) for t in spread_terms)
+            if reason == FAIL_VOLUME:
+                # the victim must hold one of the conflicting volume
+                # identities via a rw/ro mount — attach-only usage (resolved
+                # PVC attachables) cannot cause a VolumeRestrictions conflict
+                victim_keys = set(tz._vol_rw_rows[vg]) | set(tz._vol_ro_rows[vg])
+                return bool(pod_conflict_keys & victim_keys)
+            if reason == FAIL_ATTACH:
+                # evicting any holder of a same-class attachable frees a slot
+                victim_classes = {
+                    tz._vol_class[w]
+                    for w in set(tz._vol_att_rows[vg]) | set(tz._vol_rw_rows[vg])
+                    if w in tz._vol_class
+                }
+                return bool(pod_att_classes & victim_classes)
             return True  # FAIL_RESOURCES: any eviction frees resources
 
         best = None  # (key, node, victim_indices)
@@ -281,8 +305,10 @@ class Simulator:
             def plausible() -> bool:
                 if not np.all(free >= pod_req - 1e-6):
                     return False
-                if reason == FAIL_PORTS or reason in (FAIL_INTERPOD, FAIL_SPREAD):
-                    # every relevant victim on this node must be gone
+                if reason in (FAIL_PORTS, FAIL_INTERPOD, FAIL_SPREAD, FAIL_VOLUME, FAIL_ATTACH):
+                    # every relevant victim on this node must be gone (a
+                    # single eviction may leave another conflicting holder or
+                    # an attach-limit class still saturated)
                     return all(i in victims for i in cand)
                 if reason == FAIL_GPU:
                     return gpu_free >= gpu_need - 1e-6

@@ -70,6 +70,9 @@ class StorageClassCatalog:
         for sc in storage_classes:
             self._params[name_of(sc)] = sc.get("parameters") or {}
 
+    def __contains__(self, sc_name: str) -> bool:
+        return sc_name in self._params
+
     def vg_name(self, sc_name: str) -> str:
         return self._params.get(sc_name, {}).get("vgName", "")
 

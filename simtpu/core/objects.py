@@ -31,6 +31,7 @@ from ..constants import (  # noqa: F401
 
 KIND_SERVICE = "Service"
 KIND_PVC = "PersistentVolumeClaim"
+KIND_PV = "PersistentVolume"
 KIND_PDB = "PodDisruptionBudget"
 KIND_STORAGE_CLASS = "StorageClass"
 KIND_NODE = "Node"
@@ -160,6 +161,132 @@ def pod_topology_spread_constraints(pod: dict) -> List[dict]:
     return pod_spec(pod).get("topologySpreadConstraints") or []
 
 
+def pod_volumes(pod: dict) -> List[dict]:
+    return pod_spec(pod).get("volumes") or []
+
+
+def pod_pvc_names(pod: dict) -> List[str]:
+    """Claim names referenced by the pod's volumes (VolumeBinding/VolumeZone
+    inputs, `plugins/volumebinding/volume_binding.go` podHasPVCs)."""
+    out = []
+    for v in pod_volumes(pod):
+        pvc = v.get("persistentVolumeClaim")
+        if pvc and pvc.get("claimName"):
+            out.append(pvc["claimName"])
+    return out
+
+
+# Volume-identity key builders — shared by pod_volume_conflicts
+# (VolumeRestrictions) and _attachable_source (NodeVolumeLimits) so one
+# interned identity serves both and per-node presence counts each volume once.
+
+
+def _ebs_key(src: dict) -> str:
+    return f"aws:{src['volumeID']}"
+
+
+def _gce_key(src: dict) -> str:
+    return f"gce:{src['pdName']}"
+
+
+def _azure_key(src: dict) -> str:
+    return f"azure:{src['diskName']}"
+
+
+def _iscsi_key(src: dict) -> str:
+    # upstream conflicts on same IQN *and* same LUN (volume_restrictions.go
+    # isVolumeConflict): both participate in the identity
+    return f"iscsi:{src.get('iqn', '')}:lun{src.get('lun', 0)}"
+
+
+def _rbd_key(src: dict) -> str:
+    # upstream compares CephMonitors overlap + pool + image; monitor-set
+    # equality stands in for overlap (distinct-but-overlapping monitor lists
+    # are vanishingly rare in manifests)
+    mons = ",".join(sorted(src.get("monitors") or []))
+    pool = src.get("pool") or "rbd"
+    return f"rbd:{mons}:{pool}/{src.get('image', '')}"
+
+
+def pod_volume_conflicts(pod: dict) -> tuple:
+    """(read_write_keys, read_only_keys) of exclusive volume identities.
+
+    VolumeRestrictions semantics (`plugins/volumerestrictions/
+    volume_restrictions.go` isVolumeConflict): two pods on one node may not
+    share
+    - an AWS EBS volume at all,
+    - a GCE PD / ISCSI (IQN+LUN) / RBD (monitors+pool+image) unless both
+      mount it read-only.
+    A volume in the read_write list excludes any other user of the same key;
+    one in the read_only list excludes only read-write users.
+    """
+    rw, ro = [], []
+    for v in pod_volumes(pod):
+        src = v.get("awsElasticBlockStore")
+        if src and src.get("volumeID"):
+            rw.append(_ebs_key(src))  # always-exclusive
+            continue
+        src = v.get("gcePersistentDisk")
+        if src and src.get("pdName"):
+            (ro if src.get("readOnly") else rw).append(_gce_key(src))
+            continue
+        src = v.get("iscsi")
+        if src and src.get("iqn"):
+            (ro if src.get("readOnly") else rw).append(_iscsi_key(src))
+            continue
+        src = v.get("rbd")
+        if src and src.get("image"):
+            (ro if src.get("readOnly") else rw).append(_rbd_key(src))
+    return tuple(sorted(set(rw))), tuple(sorted(set(ro) - set(rw)))
+
+
+#: NodeVolumeLimits classes, in the order of the engine's attach-limit
+#: columns: (allocatable resource name, default limit when unpublished).
+#: Defaults mirror the in-tree values (`plugins/nodevolumelimits/non_csi.go`
+#: DefaultMaxEBSVolumes / DefaultMaxGCEPDVolumes / DefaultMaxAzureDiskVolumes).
+ATTACH_CLASSES = (
+    ("attachable-volumes-aws-ebs", 39.0),
+    ("attachable-volumes-gce-pd", 16.0),
+    ("attachable-volumes-azure-disk", 16.0),
+)
+
+
+def _attachable_source(src_holder: dict) -> tuple:
+    """(volume-key, class-index) of an inline EBS/GCE/Azure source, else None.
+
+    Keys are shared with `pod_volume_conflicts` so one interned volume
+    identity serves both VolumeRestrictions and NodeVolumeLimits.
+    """
+    src = src_holder.get("awsElasticBlockStore")
+    if src and src.get("volumeID"):
+        return _ebs_key(src), 0
+    src = src_holder.get("gcePersistentDisk")
+    if src and src.get("pdName"):
+        return _gce_key(src), 1
+    src = src_holder.get("azureDisk")
+    if src and src.get("diskName"):
+        return _azure_key(src), 2
+    return None
+
+
+def pod_attachable_volumes(pod: dict) -> List[tuple]:
+    """Inline attachable volumes as unique (key, class-index) pairs
+    (NodeVolumeLimits, `plugins/nodevolumelimits/non_csi.go`). PVC-backed
+    volumes are resolved by the Tensorizer, which holds the PVC/PV maps."""
+    out = []
+    for v in pod_volumes(pod):
+        pair = _attachable_source(v)
+        if pair is not None:
+            out.append(pair)
+    return sorted(set(out))
+
+
+def pv_attachable_source(pv: dict) -> tuple:
+    """The PV's attachable (key, class-index), or None (non_csi.go
+    filterAttachableVolumes resolves PVC → PV → volume source)."""
+    return _attachable_source((pv.get("spec") or {}))
+
+
 def pod_owner_kind(pod: dict) -> str:
     """Kind of the pod's controller owner reference ('' when unowned)."""
     for ref in (pod.get("metadata") or {}).get("ownerReferences") or []:
@@ -264,6 +391,7 @@ _KIND_TO_FIELD = {
     KIND_CRON_JOB: "cron_jobs",
     KIND_SERVICE: "services",
     KIND_PVC: "persistent_volume_claims",
+    KIND_PV: "persistent_volumes",
     KIND_PDB: "pod_disruption_budgets",
     KIND_STORAGE_CLASS: "storage_classes",
     KIND_NODE: "nodes",
@@ -288,6 +416,7 @@ class ResourceTypes:
     cron_jobs: List[dict] = field(default_factory=list)
     services: List[dict] = field(default_factory=list)
     persistent_volume_claims: List[dict] = field(default_factory=list)
+    persistent_volumes: List[dict] = field(default_factory=list)
     pod_disruption_budgets: List[dict] = field(default_factory=list)
     storage_classes: List[dict] = field(default_factory=list)
 

@@ -50,6 +50,7 @@ from .match import (
     toleration_tolerates_taint,
 )
 from .objects import (
+    ATTACH_CLASSES,
     labels_of,
     name_of,
     namespace_of,
@@ -59,15 +60,20 @@ from .objects import (
     node_taints,
     node_unschedulable,
     pod_affinity,
+    pod_attachable_volumes,
     pod_host_ports,
     pod_images,
     pod_node_name,
     pod_node_selector,
     pod_owner_kind,
+    pod_pvc_names,
     pod_requests,
     pod_tolerations,
     pod_topology_spread_constraints,
+    pod_volume_conflicts,
+    pv_attachable_source,
 )
+from .quantity import parse_quantity
 from .vocab import Interner
 
 # Canonical resource order; extended resources appended dynamically.
@@ -248,6 +254,10 @@ class PodGroup:
     topology_spread: tuple = ()  # canonicalized topologySpreadConstraints
     owner_kind: str = ""  # controller ownerReference kind
     images: Tuple[str, ...] = ()  # container image names
+    vol_rw: Tuple[str, ...] = ()  # exclusive volume keys (VolumeRestrictions)
+    vol_ro: Tuple[str, ...] = ()  # read-only-shareable volume keys
+    vol_att: tuple = ()  # inline attachable (key, class) pairs (NodeVolumeLimits)
+    pvc_refs: Tuple[str, ...] = ()  # referenced claim names (VolumeBinding/Zone)
 
     def signature(self) -> str:
         return _canon(
@@ -264,6 +274,10 @@ class PodGroup:
                 list(self.topology_spread),
                 self.owner_kind,
                 sorted(self.images),
+                list(self.vol_rw),
+                list(self.vol_ro),
+                [list(p) for p in self.vol_att],
+                list(self.pvc_refs),
             ]
         )
 
@@ -284,6 +298,7 @@ def _group_of_pod(pod: dict) -> Tuple[PodGroup, Optional[str]]:
     spread = tuple(
         _canon(c) for c in pod_topology_spread_constraints(pod)
     )
+    vol_rw, vol_ro = pod_volume_conflicts(pod)
     return (
         PodGroup(
             node_selector=pod_node_selector(pod),
@@ -299,6 +314,10 @@ def _group_of_pod(pod: dict) -> Tuple[PodGroup, Optional[str]]:
             topology_spread=spread,
             owner_kind=pod_owner_kind(pod),
             images=tuple(pod_images(pod)),
+            vol_rw=vol_rw,
+            vol_ro=vol_ro,
+            vol_att=tuple(pod_attachable_volumes(pod)),
+            pvc_refs=tuple(sorted(set(pod_pvc_names(pod)))),
         ),
         pin,
     )
@@ -385,6 +404,15 @@ class ClusterTensors:
     ports: np.ndarray = None  # [G, P] bool — group requests port p
     n_ports: int = 0
 
+    # shared volume-identity axis (VolumeRestrictions + NodeVolumeLimits)
+    vol_mask: np.ndarray = None  # [G, N] bool — VolumeBinding+VolumeZone feasibility
+    vol_rw: np.ndarray = None  # [G, W] bool — group uses volume w read-write
+    vol_ro: np.ndarray = None  # [G, W] bool — group uses volume w read-only
+    vol_att: np.ndarray = None  # [G, W] bool — group attaches volume w
+    vol_class_mask: np.ndarray = None  # [C, W] bool — volume w is attach class c
+    attach_limits: np.ndarray = None  # [N, C] f32 per-node attach limits
+    n_vols: int = 0
+
     # extended resources (Open-Local storage + GPU share)
     ext: ExtendedNodeArrays = field(repr=False, default=None)
 
@@ -429,6 +457,8 @@ class Tensorizer:
         extra_resources: Sequence[str] = (),
         storage_classes: Sequence[dict] = (),
         services: Sequence[dict] = (),
+        pvcs: Sequence[dict] = (),
+        pvs: Sequence[dict] = (),
     ):
         self.nodes = list(nodes)
         self.label_index = NodeLabelIndex(self.nodes)
@@ -437,6 +467,11 @@ class Tensorizer:
         self.ext = tensorize_node_storage(self.nodes, self.vg_names)
         self.catalog = StorageClassCatalog(storage_classes)
         self.services = list(services)
+        # VolumeBinding/VolumeZone context: claims by (namespace, name), PVs
+        # by name (`plugins/volumebinding`, `plugins/volumezone`)
+        self.claim_map = {(namespace_of(c), name_of(c)): c for c in pvcs}
+        self.pv_map = {name_of(pv): pv for pv in pvs}
+        self._pv_mask_cache: Dict[str, np.ndarray] = {}  # PVs are immutable
 
         # resource vocabulary: base + everything any node allocates
         self.resources = Interner()
@@ -493,6 +528,7 @@ class Tensorizer:
         self.groups: List[PodGroup] = []
         self._group_ids: Dict[str, int] = {}
         self._static_mask: List[np.ndarray] = []
+        self._vol_mask: List[np.ndarray] = []
         self._node_pref: List[np.ndarray] = []
         self._taint_intol: List[np.ndarray] = []
         self._static_score: List[np.ndarray] = []
@@ -509,6 +545,14 @@ class Tensorizer:
         # host-port vocabulary ((protocol, port) pairs) and group rows
         self.ports = Interner()
         self._port_rows: List[Dict[int, bool]] = []
+        # shared volume-identity vocabulary: VolumeRestrictions conflict keys
+        # and NodeVolumeLimits attachable volumes intern into the same axis so
+        # per-node presence (`vols_any`) counts each volume once
+        self.vols = Interner()
+        self._vol_rw_rows: List[Dict[int, bool]] = []
+        self._vol_ro_rows: List[Dict[int, bool]] = []
+        self._vol_att_rows: List[Dict[int, bool]] = []
+        self._vol_class: Dict[int, int] = {}  # vol index → attach class
 
     # -- topology ----------------------------------------------------------
 
@@ -559,6 +603,105 @@ class Tensorizer:
             for term in terms:
                 any_term |= li.match_term(term)
             mask &= any_term
+        return mask
+
+    # Zone/region label keys VolumeZone checks on bound PVs
+    # (`plugins/volumezone/volume_zone.go` volumeZoneLabels); values are
+    # "__"-joined sets (volumehelpers.LabelZonesToSet).
+    _PV_TOPO_KEYS = (
+        C.LABEL_ZONE_BETA,
+        "failure-domain.beta.kubernetes.io/region",
+        C.LABEL_ZONE,
+        "topology.kubernetes.io/region",
+    )
+
+    def _pv_node_mask(self, pv: dict) -> np.ndarray:
+        """Nodes a PV is reachable from: its nodeAffinity.required
+        (volume_binding.go Filter → PVAssumeCache) AND its zone/region
+        topology labels (volume_zone.go Filter). Cached per PV name."""
+        cached = self._pv_mask_cache.get(name_of(pv))
+        if cached is not None:
+            return cached
+        li = self.label_index
+        mask = np.ones(li.n, bool)
+        node_aff = ((pv.get("spec") or {}).get("nodeAffinity") or {}).get("required")
+        if node_aff:
+            any_term = np.zeros(li.n, bool)
+            for term in node_aff.get("nodeSelectorTerms") or []:
+                any_term |= li.match_term(term)
+            mask &= any_term
+        for key, raw in (labels_of(pv) or {}).items():
+            if key not in self._PV_TOPO_KEYS:
+                continue
+            allowed = set(str(raw).split("__"))
+            ok = np.zeros(li.n, bool)
+            for zone in allowed:
+                ok |= li.has_kv(key, zone)
+            mask &= ok
+        self._pv_mask_cache[name_of(pv)] = mask
+        return mask
+
+    def _volume_mask_for(self, g: PodGroup) -> np.ndarray:
+        """VolumeBinding + VolumeZone feasibility over nodes.
+
+        Mirrors `plugins/volumebinding/volume_binding.go` PreFilter/Filter and
+        `plugins/volumezone/volume_zone.go`:
+        - a referenced PVC that does not exist → unschedulable everywhere;
+        - a bound PVC restricts nodes to the PV's nodeAffinity and zone/region
+          topology labels;
+        - an unbound PVC with a StorageClass needs the class to exist
+          (dynamic provisioning is then assumed feasible on any node, both
+          binding modes);
+        - an unbound PVC without a StorageClass is statically provisioned:
+          some unclaimed PV of sufficient capacity must exist, and the pod is
+          restricted to nodes reachable by at least one such PV (the
+          FindPodVolumes static-binding pass, approximated without
+          access-mode matching);
+        - claims of the Open-Local / yoda storage classes are excluded — they
+          are scheduled by the storage kernels (`kernels/storage.py`) from the
+          pod's local-storage annotation instead.
+        """
+        li = self.label_index
+        mask = np.ones(li.n, bool)
+        open_local = set(C.SC_LVM) | set(C.SC_DEVICE_SSD) | set(C.SC_DEVICE_HDD)
+        for claim in g.pvc_refs:
+            pvc = self.claim_map.get((g.namespace, claim))
+            if pvc is None:
+                return np.zeros(li.n, bool)
+            spec = pvc.get("spec") or {}
+            sc_name = spec.get("storageClassName") or ""
+            if sc_name in open_local:
+                continue
+            pv_name = spec.get("volumeName") or ""
+            if pv_name:
+                pv = self.pv_map.get(pv_name)
+                if pv is None:
+                    continue  # bound to a PV we weren't given: no constraint
+                mask &= self._pv_node_mask(pv)
+            elif sc_name:
+                if sc_name not in self.catalog:
+                    # unbound, named class doesn't exist →
+                    # UnschedulableAndUnresolvable
+                    return np.zeros(li.n, bool)
+            else:
+                # static provisioning: any unclaimed PV with enough capacity
+                want = parse_quantity(
+                    ((spec.get("resources") or {}).get("requests") or {}).get(
+                        "storage", 0
+                    )
+                )
+                candidates = np.zeros(li.n, bool)
+                for pv in self.pv_map.values():
+                    pv_spec = pv.get("spec") or {}
+                    # class equality: a classless claim binds classless PVs only
+                    if pv_spec.get("claimRef") or pv_spec.get("storageClassName"):
+                        continue
+                    cap = parse_quantity(
+                        (pv_spec.get("capacity") or {}).get("storage", 0)
+                    )
+                    if cap >= want:
+                        candidates |= self._pv_node_mask(pv)
+                mask &= candidates
         return mask
 
     def _node_pref_for(self, g: PodGroup) -> np.ndarray:
@@ -650,6 +793,7 @@ class Tensorizer:
         self._group_ids[sig] = gid
         self.groups.append(g)
         self._static_mask.append(self._static_mask_for(g))
+        self._vol_mask.append(self._volume_mask_for(g))
         self._node_pref.append(self._node_pref_for(g))
         self._taint_intol.append(self._taint_intol_for(g))
         self._static_score.append(self._static_score_for(g))
@@ -659,6 +803,38 @@ class Tensorizer:
         for pair in g.host_ports:
             prow[self.ports.intern(pair)] = True
         self._port_rows.append(prow)
+
+        # VolumeRestrictions: intern the group's exclusive volume keys
+        vrw: Dict[int, bool] = {}
+        vro: Dict[int, bool] = {}
+        for key in g.vol_rw:
+            vrw[self.vols.intern(key)] = True
+        for key in g.vol_ro:
+            vro[self.vols.intern(key)] = True
+        self._vol_rw_rows.append(vrw)
+        self._vol_ro_rows.append(vro)
+
+        # NodeVolumeLimits: attachable volumes, inline + resolved through
+        # bound PVCs (`plugins/nodevolumelimits/non_csi.go`
+        # filterAttachableVolumes); presence-per-node makes the count unique
+        # per node like upstream, not per pod
+        vatt: Dict[int, bool] = {}
+        att_pairs = list(g.vol_att)
+        for claim in g.pvc_refs:
+            pvc = self.claim_map.get((g.namespace, claim))
+            if pvc is None:
+                continue
+            pv = self.pv_map.get((pvc.get("spec") or {}).get("volumeName") or "")
+            if pv is None:
+                continue
+            pair = pv_attachable_source(pv)
+            if pair is not None:
+                att_pairs.append(pair)
+        for key, cls in set(att_pairs):
+            w = self.vols.intern(key)
+            vatt[w] = True
+            self._vol_class[w] = cls
+        self._vol_att_rows.append(vatt)
 
         # PodTopologySpread: one term per constraint; stricter maxSkew wins
         # on (key, selector) collisions
@@ -729,6 +905,17 @@ class Tensorizer:
         self._w_aff.append(w_aff)
         self._w_anti.append(w_anti)
         return gid
+
+    def _attach_limits(self) -> np.ndarray:
+        """[N, C] per-node attach limits: the published `attachable-volumes-*`
+        allocatable, or the in-tree default when the key is absent (a
+        published 0 stays 0 — upstream only falls back when unset)."""
+        out = np.zeros((len(self.nodes), len(ATTACH_CLASSES)), np.float32)
+        for i, node in enumerate(self.nodes):
+            allocatable = node_allocatable(node)
+            for c, (res, default) in enumerate(ATTACH_CLASSES):
+                out[i, c] = allocatable.get(res, default)
+        return out
 
     def _refresh_s_match(self) -> None:
         """(Re)evaluate group-labels × term-selector incidence.
@@ -810,6 +997,22 @@ class Tensorizer:
         for gi, row in enumerate(self._port_rows):
             for p, v in row.items():
                 ports[gi, p] = v
+        w_n = len(self.vols)
+        vol_rw = np.zeros((g_n, w_n), bool)
+        vol_ro = np.zeros((g_n, w_n), bool)
+        vol_att = np.zeros((g_n, w_n), bool)
+        for gi, row in enumerate(self._vol_rw_rows):
+            for w, v in row.items():
+                vol_rw[gi, w] = v
+        for gi, row in enumerate(self._vol_ro_rows):
+            for w, v in row.items():
+                vol_ro[gi, w] = v
+        for gi, row in enumerate(self._vol_att_rows):
+            for w, v in row.items():
+                vol_att[gi, w] = v
+        vol_class_mask = np.zeros((len(ATTACH_CLASSES), w_n), bool)
+        for w, cls in self._vol_class.items():
+            vol_class_mask[cls, w] = True
         return ClusterTensors(
             node_names=list(self.label_index.names),
             resource_names=[str(r) for r in self.resources.items()],
@@ -843,6 +1046,15 @@ class Tensorizer:
             ss_zone=dense(self._ss_zone, bool),
             ports=ports,
             n_ports=p_n,
+            vol_mask=(
+                np.stack(self._vol_mask) if g_n else np.zeros((0, n), bool)
+            ),
+            vol_rw=vol_rw,
+            vol_ro=vol_ro,
+            vol_att=vol_att,
+            vol_class_mask=vol_class_mask,
+            attach_limits=self._attach_limits(),
+            n_vols=w_n,
             ext=self.ext,
             label_index=self.label_index,
         )

@@ -25,10 +25,12 @@ import numpy as np
 
 from ..core.tensorize import ClusterTensors, PodBatch
 from ..kernels.filters import (
+    attach_limits_ok,
     interpod_filter,
     ports_conflict_free,
     resources_fit,
     topology_spread_filter,
+    volume_conflict_free,
 )
 from ..kernels.gpushare import gpu_plan
 from ..kernels.scores import (
@@ -56,6 +58,9 @@ FAIL_STORAGE = 5  # Open-Local LVM/device storage
 FAIL_GPU = 6  # GPU-share memory/devices
 FAIL_PORTS = 7  # requested host port already in use everywhere feasible
 FAIL_SPREAD = 8  # topology spread maxSkew would be violated everywhere
+FAIL_VOLUME = 9  # exclusive volume (EBS/GCE-PD/ISCSI/RBD) conflict everywhere
+FAIL_ATTACH = 10  # node volume attach limits exceeded everywhere
+FAIL_VOLUME_BIND = 11  # PVC missing / not bindable / PV zone mismatch
 
 REASON_TEXT = {
     FAIL_STATIC: "node(s) didn't match node selector/affinity or had untolerated taints",
@@ -66,6 +71,12 @@ REASON_TEXT = {
     FAIL_GPU: "insufficient GPU memory on every feasible node's devices",
     FAIL_PORTS: "node(s) didn't have free ports for the requested pod ports",
     FAIL_SPREAD: "node(s) didn't match pod topology spread constraints",
+    FAIL_VOLUME: "node(s) had a volume attach conflict for the requested volumes",
+    FAIL_ATTACH: "node(s) exceeded max volume count for the requested volumes",
+    FAIL_VOLUME_BIND: (
+        "persistentvolumeclaim not found, not bindable, or bound to a volume "
+        "unreachable from the node's zone"
+    ),
 }
 
 
@@ -74,6 +85,7 @@ class StaticArrays(NamedTuple):
 
     alloc: jnp.ndarray  # [N, R]
     static_mask: jnp.ndarray  # [G, N]
+    vol_mask: jnp.ndarray  # [G, N] VolumeBinding+VolumeZone feasibility
     node_pref: jnp.ndarray  # [G, N]
     taint_intol: jnp.ndarray  # [G, N]
     static_score: jnp.ndarray  # [G, N] ImageLocality + NodePreferAvoidPods (pre-weighted)
@@ -89,6 +101,11 @@ class StaticArrays(NamedTuple):
     ss_host: jnp.ndarray  # [G, T] SelectorSpread hostname terms
     ss_zone: jnp.ndarray  # [G, T] SelectorSpread zone terms
     ports_req: jnp.ndarray  # [G, P] host-port request incidence
+    vol_rw_req: jnp.ndarray  # [G, W] exclusive volume read-write incidence
+    vol_ro_req: jnp.ndarray  # [G, W] exclusive volume read-only incidence
+    vol_att_req: jnp.ndarray  # [G, W] attachable volume incidence
+    vol_class_mask: jnp.ndarray  # [C, W] attach class of each volume
+    attach_limits: jnp.ndarray  # [N, C] per-node attach limits
     # extended resources
     has_storage: jnp.ndarray  # [N]
     vg_cap: jnp.ndarray  # [N, V]
@@ -136,6 +153,7 @@ def statics_from(tensors: ClusterTensors) -> StaticArrays:
     return StaticArrays(
         alloc=jnp.asarray(tensors.alloc, jnp.float32),
         static_mask=jnp.asarray(tensors.static_mask),
+        vol_mask=jnp.asarray(tensors.vol_mask),
         node_pref=jnp.asarray(tensors.node_pref_score),
         taint_intol=jnp.asarray(tensors.taint_intolerable),
         static_score=jnp.asarray(tensors.static_score, jnp.float32),
@@ -151,6 +169,11 @@ def statics_from(tensors: ClusterTensors) -> StaticArrays:
         ss_host=jnp.asarray(tensors.ss_host),
         ss_zone=jnp.asarray(tensors.ss_zone),
         ports_req=jnp.asarray(tensors.ports),
+        vol_rw_req=jnp.asarray(tensors.vol_rw),
+        vol_ro_req=jnp.asarray(tensors.vol_ro),
+        vol_att_req=jnp.asarray(tensors.vol_att),
+        vol_class_mask=jnp.asarray(tensors.vol_class_mask),
+        attach_limits=jnp.asarray(tensors.attach_limits),
         has_storage=jnp.asarray(ext.has_storage),
         vg_cap=jnp.asarray(ext.vg_cap, jnp.float32),
         vg_name_id=jnp.asarray(ext.vg_name_id, jnp.int32),
@@ -190,6 +213,22 @@ def schedule_step(
     m_ports = m_static & ports_conflict_free(state.ports_used, statics.ports_req[g])
     m_res = m_ports & resources_fit(state.free, req)
 
+    # VolumeRestrictions then NodeVolumeLimits follow NodeResourcesFit in the
+    # registry filter order
+    m_vol = m_res & volume_conflict_free(
+        state.vols_any, state.vols_rw, statics.vol_rw_req[g], statics.vol_ro_req[g]
+    )
+    m_att = m_vol & attach_limits_ok(
+        state.vols_any,
+        statics.vol_att_req[g],
+        statics.vol_class_mask,
+        statics.attach_limits,
+    )
+
+    # VolumeBinding + VolumeZone (precomputed per group; PVC/PV/SC objects
+    # never change during a simulation)
+    m_bind = m_att & statics.vol_mask[g]
+
     # Open-Local storage (plugin Filter, open-local.go:50-91): pods that need
     # storage only fit nodes carrying the storage annotation
     needs_storage = jnp.any(lvm_size > 0) | jnp.any(dev_size > 0)
@@ -198,7 +237,7 @@ def schedule_step(
         state.sdev_free, statics.sdev_cap, statics.sdev_media, dev_size, dev_media
     )
     storage_ok = jnp.where(needs_storage, statics.has_storage & lvm_ok & dev_ok, True)
-    m_storage = m_res & storage_ok
+    m_storage = m_bind & storage_ok
 
     # GPU share (plugin Filter, open-gpu-share.go:51-81)
     gpu_ok, gpu_shares = gpu_plan(
@@ -293,36 +332,24 @@ def schedule_step(
     placed = jnp.where(
         forced, (pin >= 0) & statics.node_valid[jnp.clip(pin, 0)], feasible
     )
+    # first mask stage that emptied the candidate set names the failure (the
+    # scheduler's "0/N nodes are available: <first failing filter>" status)
+    cascade = (
+        (m_static, FAIL_STATIC),
+        (m_ports, FAIL_PORTS),
+        (m_res, FAIL_RESOURCES),
+        (m_vol, FAIL_VOLUME),
+        (m_att, FAIL_ATTACH),
+        (m_bind, FAIL_VOLUME_BIND),
+        (m_storage, FAIL_STORAGE),
+        (m_gpu, FAIL_GPU),
+        (m_spread, FAIL_SPREAD),
+    )
+    fail = jnp.int32(FAIL_INTERPOD)
+    for mask, code in reversed(cascade):
+        fail = jnp.where(jnp.any(mask), fail, code)
     reason = jnp.where(
-        placed,
-        OK,
-        jnp.where(
-            forced,
-            FAIL_NO_NODE,
-            jnp.where(
-                ~jnp.any(m_static),
-                FAIL_STATIC,
-                jnp.where(
-                    ~jnp.any(m_ports),
-                    FAIL_PORTS,
-                    jnp.where(
-                        ~jnp.any(m_res),
-                        FAIL_RESOURCES,
-                        jnp.where(
-                            ~jnp.any(m_storage),
-                            FAIL_STORAGE,
-                            jnp.where(
-                                ~jnp.any(m_gpu),
-                                FAIL_GPU,
-                                jnp.where(
-                                    ~jnp.any(m_spread), FAIL_SPREAD, FAIL_INTERPOD
-                                ),
-                            ),
-                        ),
-                    ),
-                ),
-            ),
-        ),
+        placed, OK, jnp.where(forced, FAIL_NO_NODE, fail)
     ).astype(jnp.int32)
 
     # -- state update (no-op when not placed) -----------------------------
@@ -330,6 +357,10 @@ def schedule_step(
     w = jnp.where(placed, 1.0, 0.0)
     free = state.free.at[safe].add(-req * w)
     ports_used = state.ports_used.at[safe].add(statics.ports_req[g] * w)
+    v_rw = statics.vol_rw_req[g]
+    v_present = v_rw | statics.vol_ro_req[g] | statics.vol_att_req[g]
+    vols_any = state.vols_any.at[safe].add(v_present * w)
+    vols_rw = state.vols_rw.at[safe].add(v_rw * w)
     vg_free = state.vg_free.at[safe].add(-lvm_alloc[safe] * w)
     sdev_free = state.sdev_free.at[safe].set(
         state.sdev_free[safe] & ~(dev_take[safe] & placed)
@@ -361,6 +392,8 @@ def schedule_step(
             sdev_free=sdev_free,
             gpu_free=gpu_free,
             ports_used=ports_used,
+            vols_any=vols_any,
+            vols_rw=vols_rw,
         )
     else:
         new_state = state._replace(
@@ -369,6 +402,8 @@ def schedule_step(
             sdev_free=sdev_free,
             gpu_free=gpu_free,
             ports_used=ports_used,
+            vols_any=vols_any,
+            vols_rw=vols_rw,
         )
 
     out_node = jnp.where(placed, chosen, -1)

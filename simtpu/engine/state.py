@@ -28,6 +28,8 @@ class SchedState(NamedTuple):
     sdev_free:       [N, SD] exclusive storage devices still unallocated
     gpu_free:        [N, GD] free GPU memory per device (GPU-share)
     ports_used:      [N, P] in-use (protocol, hostPort) pairs (NodePorts)
+    vols_any:        [N, W] users of exclusive volume w (VolumeRestrictions)
+    vols_rw:         [N, W] read-write users of exclusive volume w
     """
 
     free: jnp.ndarray
@@ -40,6 +42,8 @@ class SchedState(NamedTuple):
     sdev_free: jnp.ndarray
     gpu_free: jnp.ndarray
     ports_used: jnp.ndarray
+    vols_any: jnp.ndarray
+    vols_rw: jnp.ndarray
 
 
 def build_state(
@@ -82,6 +86,13 @@ def build_state(
             placed_node,
             tensors.ports[placed_group].astype(np.float32),
         )
+    vols_any = np.zeros((n, tensors.n_vols), np.float32)
+    vols_rw = np.zeros((n, tensors.n_vols), np.float32)
+    if len(placed_group) and tensors.n_vols:
+        rw = tensors.vol_rw[placed_group]
+        present = rw | tensors.vol_ro[placed_group] | tensors.vol_att[placed_group]
+        np.add.at(vols_any, placed_node, present.astype(np.float32))
+        np.add.at(vols_rw, placed_node, rw.astype(np.float32))
     cnt = np.zeros((5, max(t, 0), d), np.float32)
     if len(placed_group):
         req = placed_req
@@ -119,4 +130,6 @@ def build_state(
         sdev_free=jnp.asarray(sdev_free),
         gpu_free=jnp.asarray(gpu_free),
         ports_used=jnp.asarray(ports_used),
+        vols_any=jnp.asarray(vols_any),
+        vols_rw=jnp.asarray(vols_rw),
     )

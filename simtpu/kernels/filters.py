@@ -40,6 +40,41 @@ def ports_conflict_free(ports_used: jnp.ndarray, want: jnp.ndarray) -> jnp.ndarr
     return ~jnp.any(want[None, :] & (ports_used > 0), axis=-1)
 
 
+def volume_conflict_free(
+    vols_any: jnp.ndarray,  # [N, W] users (rw or ro) of exclusive volume w
+    vols_rw: jnp.ndarray,  # [N, W] read-write users of volume w
+    want_rw: jnp.ndarray,  # [W] bool — pod mounts volume w read-write
+    want_ro: jnp.ndarray,  # [W] bool — pod mounts volume w read-only
+) -> jnp.ndarray:
+    """VolumeRestrictions (`plugins/volumerestrictions/volume_restrictions.go`):
+    a read-write mount conflicts with any existing user of the same volume; a
+    read-only mount conflicts with an existing read-write user. Returns [N].
+    """
+    rw_conflict = jnp.any(want_rw[None, :] & (vols_any > 0), axis=-1)
+    ro_conflict = jnp.any(want_ro[None, :] & (vols_rw > 0), axis=-1)
+    return ~(rw_conflict | ro_conflict)
+
+
+def attach_limits_ok(
+    vols_any: jnp.ndarray,  # [N, W] users of volume w on node n
+    want_att: jnp.ndarray,  # [W] bool — pod attaches volume w
+    class_mask: jnp.ndarray,  # [C, W] bool — volume w belongs to attach class c
+    limits: jnp.ndarray,  # [N, C] per-node attach limits
+) -> jnp.ndarray:
+    """NodeVolumeLimits (`plugins/nodevolumelimits/non_csi.go`): per class,
+    unique volumes already attached to the node plus the pod's volumes not yet
+    on the node must stay within the node's limit. A class the pod adds
+    nothing to never filters — upstream returns early on zero new volumes, so
+    an already-over-limit node (e.g. from forced `spec.nodeName` placements)
+    still accepts volume-less pods. Returns [N].
+    """
+    present = (vols_any > 0).astype(jnp.float32)  # [N, W]
+    cm = class_mask.astype(jnp.float32)  # [C, W]
+    used = present @ cm.T  # [N, C] unique volumes on node per class
+    new = ((1.0 - present) * want_att.astype(jnp.float32)[None, :]) @ cm.T
+    return jnp.all((new == 0) | (used + new <= limits), axis=-1)
+
+
 def topology_spread_filter(
     cnt_match: jnp.ndarray,  # [T, D] placed pods matching term selector, per domain
     node_dom: jnp.ndarray,  # [K, N] global domain id per topo key (-1 absent)

@@ -1,0 +1,138 @@
+"""Pallas TPU kernel: fused resource filter + resource scores in one HBM pass.
+
+The per-pod hot loop reads `free[N, R]` and `alloc[N, R]` several times under
+XLA — once for the NodeResourcesFit mask (`filters.resources_fit`), once each
+for the NodeResourcesLeastAllocated / NodeResourcesBalancedAllocation scores
+(`scores.least_allocated` / `scores.balanced_allocation`) and once for the
+Simon dominant-share score (`scores.simon_share`, `pkg/simulator/plugin/
+simon.go:44-67`). XLA usually fuses these into one loop already (SURVEY.md §7
+flags Pallas as the escape hatch for when it doesn't); this kernel *guarantees*
+the single pass: one tile-walk over the node axis computes all four outputs
+from one VMEM residency of the inputs.
+
+Layout is TPU-native: arrays come in **transposed** `[R, N]` form so the node
+axis lies on the 128-wide vector lanes and the (small, padded-to-8) resource
+axis on sublanes; all reductions are cheap sublane reductions. Use
+`to_kernel_layout` to prepare inputs once per simulation.
+
+On non-TPU backends the same kernel runs under `interpret=True`, so CPU tests
+exercise the identical code path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .scores import MAX_NODE_SCORE
+
+# float32 sublane granule; the resource axis is padded up to a multiple
+_SUBLANE = 8
+# default node-axis tile: 2048 f32 lanes ≈ 8 KiB per row-block in VMEM
+_TILE_N = 2048
+_EPS = 1e-5  # matches filters._RES_EPS
+
+
+def _pad_to(x: jnp.ndarray, axis: int, multiple: int, value=0.0) -> jnp.ndarray:
+    pad = (-x.shape[axis]) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def to_kernel_layout(free: jnp.ndarray, alloc: jnp.ndarray, tile_n: int = _TILE_N):
+    """[N, R] host layout → padded [R8, Np] transposed kernel layout."""
+    free_t = _pad_to(_pad_to(free.T, 0, _SUBLANE), 1, tile_n)
+    alloc_t = _pad_to(_pad_to(alloc.T, 0, _SUBLANE), 1, tile_n)
+    return free_t, alloc_t
+
+
+def _kernel(req_ref, free_ref, alloc_ref, fit_ref, lb_ref, dom_ref, *, n_res):
+    # All intermediates stay float32: Mosaic lowers bool vectors to i8 masks
+    # and rejects the i8→i1 truncations that jnp.all / bool-valued selects
+    # would emit, so predicates only ever appear as jnp.where conditions.
+    free = free_ref[...]  # [R8, T]
+    alloc = alloc_ref[...]
+    req = req_ref[...]  # [R8, 1] broadcasts over lanes
+    rows = jax.lax.broadcasted_iota(jnp.int32, free.shape, 0)
+    act = jnp.where(rows < n_res, 1.0, 0.0)
+
+    # NodeResourcesFit (filters.resources_fit): min over active rows of the
+    # 0/1 fit indicator; pad rows forced to 1
+    slack = _EPS * jnp.maximum(jnp.abs(free), 1.0)
+    okf = jnp.where(free + slack >= req, 1.0, 0.0)
+    fit = jnp.min(jnp.maximum(okf, 1.0 - act), axis=0)
+
+    # NodeResourcesLeastAllocated over cpu+memory (rows 0, 1)
+    cpumem = jnp.where(rows < 2, 1.0, 0.0)
+    fa = jnp.clip(free - req, 0.0, None)
+    lfrac = jnp.where(alloc > 0, fa / jnp.maximum(alloc, 1e-30), 0.0)
+    least = jnp.sum(lfrac * cpumem, axis=0) * (MAX_NODE_SCORE / 2.0)
+
+    # NodeResourcesBalancedAllocation (two-resource form)
+    used_after = alloc - free + req
+    ufrac = jnp.where(alloc > 0, used_after / jnp.maximum(alloc, 1e-30), 1.0)
+    balanced = (1.0 - jnp.abs(ufrac[0, :] - ufrac[1, :])) * MAX_NODE_SCORE
+
+    # Simon dominant share against static allocatable (scores.simon_share)
+    denom = alloc - req
+    share = jnp.where(
+        denom == 0, jnp.where(req == 0, 0.0, 1.0), req / jnp.where(denom == 0, 1.0, denom)
+    )
+    share = jnp.where(alloc > 0, share * act, 0.0)
+    dom = jnp.clip(jnp.max(share, axis=0), 0.0) * MAX_NODE_SCORE
+
+    fit_ref[0, :] = fit
+    lb_ref[0, :] = least + balanced
+    dom_ref[0, :] = dom
+
+
+@functools.partial(jax.jit, static_argnames=("n_res", "tile_n", "interpret"))
+def fused_fit_score(
+    free_t: jnp.ndarray,  # [R8, Np] transposed free (to_kernel_layout)
+    alloc_t: jnp.ndarray,  # [R8, Np] transposed allocatable
+    req: jnp.ndarray,  # [R] pod request
+    n_res: int,
+    tile_n: int = _TILE_N,
+    interpret: bool = False,
+):
+    """One fused pass: (fit mask [Np], least+balanced score [Np], simon raw
+    dominant-share score [Np]). Trailing pad columns report fit=False-safe
+    values (alloc=0 ⇒ fit=True, scores 0/100) — callers slice [:N] or rely on
+    the engine's static mask to exclude them.
+    """
+    r8, n = free_t.shape
+    grid = (n // tile_n,)
+    out = pl.pallas_call(
+        functools.partial(_kernel, n_res=n_res),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((r8, 1), lambda i: (0, 0)),  # req, replicated
+            pl.BlockSpec((r8, tile_n), lambda i: (0, i)),
+            pl.BlockSpec((r8, tile_n), lambda i: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tile_n), lambda i: (0, i)),
+            pl.BlockSpec((1, tile_n), lambda i: (0, i)),
+            pl.BlockSpec((1, tile_n), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(_pad_to(req[:, None], 0, _SUBLANE), free_t, alloc_t)
+    fit, lb, dom = out
+    return fit[0] > 0.5, lb[0], dom[0]
+
+
+def fused_fit_score_auto(free_t, alloc_t, req, n_res, tile_n: int = _TILE_N):
+    """Backend-dispatching wrapper: compiled on TPU, interpreted elsewhere."""
+    interpret = jax.default_backend() != "tpu"
+    return fused_fit_score(free_t, alloc_t, req, n_res, tile_n, interpret)

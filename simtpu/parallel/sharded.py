@@ -46,6 +46,7 @@ def pad_statics(statics: StaticArrays, multiple: int) -> Tuple[StaticArrays, int
         statics._replace(
             alloc=_pad_axis(statics.alloc, 0, pad, 0.0),
             static_mask=_pad_axis(statics.static_mask, 1, pad, False),
+            vol_mask=_pad_axis(statics.vol_mask, 1, pad, False),
             node_pref=_pad_axis(statics.node_pref, 1, pad, 0.0),
             taint_intol=_pad_axis(statics.taint_intol, 1, pad, 0.0),
             static_score=_pad_axis(statics.static_score, 1, pad, 0.0),
@@ -57,6 +58,7 @@ def pad_statics(statics: StaticArrays, multiple: int) -> Tuple[StaticArrays, int
             sdev_media=_pad_axis(statics.sdev_media, 0, pad, -1),
             gpu_dev_exists=_pad_axis(statics.gpu_dev_exists, 0, pad, False),
             gpu_total=_pad_axis(statics.gpu_total, 0, pad, 0.0),
+            attach_limits=_pad_axis(statics.attach_limits, 0, pad, 0.0),
             node_valid=_pad_axis(statics.node_valid, 0, pad, False),
         ),
         pad,
@@ -72,6 +74,8 @@ def pad_state(state: SchedState, pad: int) -> SchedState:
         sdev_free=_pad_axis(state.sdev_free, 0, pad, False),
         gpu_free=_pad_axis(state.gpu_free, 0, pad, 0.0),
         ports_used=_pad_axis(state.ports_used, 0, pad, 0.0),
+        vols_any=_pad_axis(state.vols_any, 0, pad, 0.0),
+        vols_rw=_pad_axis(state.vols_rw, 0, pad, 0.0),
     )
 
 
@@ -84,6 +88,7 @@ def statics_sharding(mesh: Mesh) -> StaticArrays:
     return StaticArrays(
         alloc=lead2,
         static_mask=trail,
+        vol_mask=trail,
         node_pref=trail,
         taint_intol=trail,
         static_score=trail,
@@ -99,6 +104,11 @@ def statics_sharding(mesh: Mesh) -> StaticArrays:
         ss_host=rep,
         ss_zone=rep,
         ports_req=rep,
+        vol_rw_req=rep,
+        vol_ro_req=rep,
+        vol_att_req=rep,
+        vol_class_mask=rep,
+        attach_limits=lead2,
         has_storage=lead,
         vg_cap=lead2,
         vg_name_id=lead2,
@@ -124,6 +134,8 @@ def state_sharding(mesh: Mesh) -> SchedState:
         sdev_free=lead2,
         gpu_free=lead2,
         ports_used=lead2,
+        vols_any=lead2,
+        vols_rw=lead2,
     )
 
 

@@ -102,6 +102,8 @@ def sweep_feasibility(
         extended_resources,
         storage_classes=list(cluster.storage_classes),
         services=list(cluster.services),
+        pvcs=list(cluster.persistent_volume_claims),
+        pvs=list(cluster.persistent_volumes),
     )
     batch = tensorizer.add_pods(ordered)
     tensors = tensorizer.freeze()

@@ -1,0 +1,413 @@
+"""Tests for the volume plugin family — the last filter rows of the
+SURVEY.md §2.2 in-tree checklist (`vendor/.../algorithmprovider/
+registry.go:75-145`): VolumeRestrictions, NodeVolumeLimits, VolumeBinding and
+VolumeZone.
+
+Note the reference's pod normalization converts PVC volumes to hostPath
+(`pkg/utils/utils.go` MakeValidPod, mirrored in workloads/expand.py), so the
+PVC-driven plugins only act on pods fed to the engine without normalization —
+exactly as in the reference, where they are registered but inert for
+normalized pods. Inline volume sources (EBS/GCE-PD/ISCSI/RBD/AzureDisk)
+survive normalization and exercise VolumeRestrictions + NodeVolumeLimits
+through the full `simulate()` path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from simtpu.api import simulate
+from simtpu.core.objects import ResourceTypes
+from simtpu.core.tensorize import Tensorizer
+
+from .fixtures import make_fake_node, make_fake_pod, with_node_labels
+
+
+def _placements(result):
+    out = {}
+    for status in result.node_status:
+        for pod in status.pods:
+            out[pod["metadata"]["name"]] = status.node["metadata"]["name"]
+    return out
+
+
+def with_volume(vol):
+    def opt(pod):
+        pod["spec"].setdefault("volumes", []).append(vol)
+
+    return opt
+
+
+def with_allocatable(res, value):
+    def opt(node):
+        node["status"]["allocatable"][res] = value
+        node["status"]["capacity"][res] = value
+
+    return opt
+
+
+class TestVolumeRestrictions:
+    def test_rw_gce_pd_excludes_second_user(self):
+        nodes = [make_fake_node(f"n{i}", "8", "16Gi") for i in range(2)]
+        pods = [
+            make_fake_pod(
+                f"p{i}",
+                "default",
+                "1",
+                "1Gi",
+                with_volume({"name": "d", "gcePersistentDisk": {"pdName": "disk-a"}}),
+            )
+            for i in range(3)
+        ]
+        result = simulate(ResourceTypes(nodes=nodes, pods=pods), [])
+        placed = _placements(result)
+        # only two nodes → the third rw user of disk-a cannot schedule
+        assert len(placed) == 2
+        assert len(set(placed.values())) == 2
+        assert len(result.unscheduled_pods) == 1
+        assert "volume" in result.unscheduled_pods[0].reason
+
+    def test_ro_gce_pd_shares_a_node(self):
+        nodes = [make_fake_node("n0", "8", "16Gi")]
+        pods = [
+            make_fake_pod(
+                f"p{i}",
+                "default",
+                "1",
+                "1Gi",
+                with_volume(
+                    {
+                        "name": "d",
+                        "gcePersistentDisk": {"pdName": "disk-a", "readOnly": True},
+                    }
+                ),
+            )
+            for i in range(3)
+        ]
+        result = simulate(ResourceTypes(nodes=nodes, pods=pods), [])
+        assert len(_placements(result)) == 3
+        assert not result.unscheduled_pods
+
+    def test_ro_blocked_by_rw_user(self):
+        nodes = [make_fake_node("n0", "8", "16Gi")]
+        rw = make_fake_pod(
+            "rw",
+            "default",
+            "1",
+            "1Gi",
+            with_volume({"name": "d", "gcePersistentDisk": {"pdName": "disk-a"}}),
+        )
+        ro = make_fake_pod(
+            "ro",
+            "default",
+            "1",
+            "1Gi",
+            with_volume(
+                {
+                    "name": "d",
+                    "gcePersistentDisk": {"pdName": "disk-a", "readOnly": True},
+                }
+            ),
+        )
+        result = simulate(ResourceTypes(nodes=nodes, pods=[rw, ro]), [])
+        assert len(_placements(result)) == 1
+        assert len(result.unscheduled_pods) == 1
+
+    def test_aws_ebs_always_exclusive(self):
+        nodes = [make_fake_node("n0", "8", "16Gi")]
+        pods = [
+            make_fake_pod(
+                f"p{i}",
+                "default",
+                "1",
+                "1Gi",
+                with_volume(
+                    {
+                        "name": "d",
+                        "awsElasticBlockStore": {
+                            "volumeID": "vol-1",
+                            "readOnly": True,  # readOnly does NOT share EBS
+                        },
+                    }
+                ),
+            )
+            for i in range(2)
+        ]
+        result = simulate(ResourceTypes(nodes=nodes, pods=pods), [])
+        assert len(_placements(result)) == 1
+        assert len(result.unscheduled_pods) == 1
+
+    def test_distinct_disks_no_conflict(self):
+        nodes = [make_fake_node("n0", "8", "16Gi")]
+        pods = [
+            make_fake_pod(
+                f"p{i}",
+                "default",
+                "1",
+                "1Gi",
+                with_volume(
+                    {"name": "d", "gcePersistentDisk": {"pdName": f"disk-{i}"}}
+                ),
+            )
+            for i in range(2)
+        ]
+        result = simulate(ResourceTypes(nodes=nodes, pods=pods), [])
+        assert len(_placements(result)) == 2
+
+
+class TestNodeVolumeLimits:
+    def test_published_limit_enforced(self):
+        node = make_fake_node(
+            "n0", "32", "64Gi", with_allocatable("attachable-volumes-aws-ebs", "2")
+        )
+        pods = [
+            make_fake_pod(
+                f"p{i}",
+                "default",
+                "1",
+                "1Gi",
+                with_volume(
+                    {"name": "d", "awsElasticBlockStore": {"volumeID": f"vol-{i}"}}
+                ),
+            )
+            for i in range(3)
+        ]
+        result = simulate(ResourceTypes(nodes=[node], pods=pods), [])
+        assert len(_placements(result)) == 2
+        assert len(result.unscheduled_pods) == 1
+        assert "max volume count" in result.unscheduled_pods[0].reason
+
+    def test_shared_volume_counted_once_per_node(self):
+        # upstream counts *unique* volumes per node: two read-only users of
+        # one GCE PD consume a single attach slot
+        node = make_fake_node(
+            "n0", "32", "64Gi", with_allocatable("attachable-volumes-gce-pd", "1")
+        )
+        pods = [
+            make_fake_pod(
+                f"p{i}",
+                "default",
+                "1",
+                "1Gi",
+                with_volume(
+                    {
+                        "name": "d",
+                        "gcePersistentDisk": {"pdName": "disk-a", "readOnly": True},
+                    }
+                ),
+            )
+            for i in range(2)
+        ]
+        result = simulate(ResourceTypes(nodes=[node], pods=pods), [])
+        assert len(_placements(result)) == 2
+        assert not result.unscheduled_pods
+
+    def test_published_zero_limit_respected(self):
+        # a node explicitly publishing 0 permits no attachments — the in-tree
+        # default must not override it
+        node = make_fake_node(
+            "n0", "32", "64Gi", with_allocatable("attachable-volumes-aws-ebs", "0")
+        )
+        pod = make_fake_pod(
+            "p0",
+            "default",
+            "1",
+            "1Gi",
+            with_volume({"name": "d", "awsElasticBlockStore": {"volumeID": "vol-1"}}),
+        )
+        result = simulate(ResourceTypes(nodes=[node], pods=[pod]), [])
+        assert not _placements(result)
+        assert len(result.unscheduled_pods) == 1
+
+    def test_pvc_backed_ebs_counts_against_limit(self):
+        # NodeVolumeLimits resolves PVC → PV → source (non_csi.go); feed the
+        # tensorizer unnormalized pods with EBS-backed PVs
+        node = make_fake_node(
+            "n0", "32", "64Gi", with_allocatable("attachable-volumes-aws-ebs", "1")
+        )
+        pvs = [
+            {
+                "kind": "PersistentVolume",
+                "metadata": {"name": f"pv-{i}"},
+                "spec": {"awsElasticBlockStore": {"volumeID": f"vol-{i}"}},
+            }
+            for i in range(2)
+        ]
+        pvcs = [_pvc(f"claim-{i}", volume_name=f"pv-{i}") for i in range(2)]
+        pod = make_fake_pod("p0", "default", "1", "1Gi")
+        pod["spec"]["volumes"] = [
+            {"name": f"v{i}", "persistentVolumeClaim": {"claimName": f"claim-{i}"}}
+            for i in range(2)
+        ]
+        tz = Tensorizer([node], pvcs=pvcs, pvs=pvs)
+        batch = tz.add_pods([pod])
+        tensors = tz.freeze()
+        g = batch.group[0]
+        assert tensors.vol_att[g].sum() == 2
+        assert tensors.attach_limits[0, 0] == 1.0
+
+    def test_default_limit_when_unpublished(self):
+        # GCE default limit is 16: a pod carrying 2 distinct PDs still fits a
+        # node that publishes no attach limit at all
+        node = make_fake_node("n0", "32", "64Gi")
+        pod = make_fake_pod(
+            "p0",
+            "default",
+            "1",
+            "1Gi",
+            with_volume({"name": "a", "gcePersistentDisk": {"pdName": "d-a"}}),
+            with_volume({"name": "b", "gcePersistentDisk": {"pdName": "d-b"}}),
+        )
+        result = simulate(ResourceTypes(nodes=[node], pods=[pod]), [])
+        assert len(_placements(result)) == 1
+        assert not result.unscheduled_pods
+
+
+def _raw_pod_with_pvc(name, claim):
+    """A pod dict fed straight to the Tensorizer (no normalization)."""
+    pod = make_fake_pod(name, "default", "1", "1Gi")
+    pod["spec"]["volumes"] = [
+        {"name": "data", "persistentVolumeClaim": {"claimName": claim}}
+    ]
+    return pod
+
+
+def _pvc(name, sc=None, volume_name=None):
+    spec = {}
+    if sc is not None:
+        spec["storageClassName"] = sc
+    if volume_name is not None:
+        spec["volumeName"] = volume_name
+    return {
+        "kind": "PersistentVolumeClaim",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    }
+
+
+class TestVolumeBindingAndZone:
+    def _mask(self, nodes, pod, pvcs=(), pvs=(), scs=()):
+        tz = Tensorizer(nodes, storage_classes=scs, pvcs=pvcs, pvs=pvs)
+        batch = tz.add_pods([pod])
+        tensors = tz.freeze()
+        return tensors.vol_mask[batch.group[0]]
+
+    def test_missing_pvc_unschedulable(self):
+        nodes = [make_fake_node("n0", "8", "16Gi")]
+        mask = self._mask(nodes, _raw_pod_with_pvc("p0", "nope"))
+        assert not mask.any()
+
+    def test_unbound_pvc_needs_storage_class(self):
+        nodes = [make_fake_node("n0", "8", "16Gi")]
+        sc = {"kind": "StorageClass", "metadata": {"name": "standard"}}
+        ok = self._mask(
+            nodes,
+            _raw_pod_with_pvc("p0", "claim"),
+            pvcs=[_pvc("claim", sc="standard")],
+            scs=[sc],
+        )
+        missing = self._mask(
+            nodes,
+            _raw_pod_with_pvc("p1", "claim"),
+            pvcs=[_pvc("claim", sc="standard")],
+        )
+        assert ok.all()
+        assert not missing.any()
+
+    def test_bound_pv_zone_restricts_nodes(self):
+        nodes = [
+            make_fake_node(
+                "n0", "8", "16Gi", with_node_labels({"topology.kubernetes.io/zone": "z1"})
+            ),
+            make_fake_node(
+                "n1", "8", "16Gi", with_node_labels({"topology.kubernetes.io/zone": "z2"})
+            ),
+        ]
+        pv = {
+            "kind": "PersistentVolume",
+            "metadata": {
+                "name": "pv-a",
+                "labels": {"topology.kubernetes.io/zone": "z2"},
+            },
+            "spec": {},
+        }
+        mask = self._mask(
+            nodes,
+            _raw_pod_with_pvc("p0", "claim"),
+            pvcs=[_pvc("claim", volume_name="pv-a")],
+            pvs=[pv],
+        )
+        assert list(mask) == [False, True]
+
+    def test_bound_pv_node_affinity(self):
+        nodes = [
+            make_fake_node("n0", "8", "16Gi", with_node_labels({"disk": "ssd"})),
+            make_fake_node("n1", "8", "16Gi"),
+        ]
+        pv = {
+            "kind": "PersistentVolume",
+            "metadata": {"name": "pv-a"},
+            "spec": {
+                "nodeAffinity": {
+                    "required": {
+                        "nodeSelectorTerms": [
+                            {
+                                "matchExpressions": [
+                                    {"key": "disk", "operator": "In", "values": ["ssd"]}
+                                ]
+                            }
+                        ]
+                    }
+                }
+            },
+        }
+        mask = self._mask(
+            nodes,
+            _raw_pod_with_pvc("p0", "claim"),
+            pvcs=[_pvc("claim", volume_name="pv-a")],
+            pvs=[pv],
+        )
+        assert list(mask) == [True, False]
+
+    def test_static_provisioning_binds_to_available_pv(self):
+        # PVC with no storageClassName: an unclaimed PV of sufficient capacity
+        # makes the pod schedulable, restricted to that PV's reachable nodes
+        nodes = [
+            make_fake_node(
+                "n0", "8", "16Gi", with_node_labels({"topology.kubernetes.io/zone": "z1"})
+            ),
+            make_fake_node(
+                "n1", "8", "16Gi", with_node_labels({"topology.kubernetes.io/zone": "z2"})
+            ),
+        ]
+        pvc = _pvc("claim")
+        pvc["spec"]["resources"] = {"requests": {"storage": "10Gi"}}
+        pv = {
+            "kind": "PersistentVolume",
+            "metadata": {
+                "name": "pv-a",
+                "labels": {"topology.kubernetes.io/zone": "z1"},
+            },
+            "spec": {"capacity": {"storage": "20Gi"}},
+        }
+        mask = self._mask(
+            nodes, _raw_pod_with_pvc("p0", "claim"), pvcs=[pvc], pvs=[pv]
+        )
+        assert list(mask) == [True, False]
+        # a too-small PV leaves the claim unbindable
+        pv_small = dict(pv, spec={"capacity": {"storage": "1Gi"}})
+        mask = self._mask(
+            nodes, _raw_pod_with_pvc("p1", "claim"), pvcs=[pvc], pvs=[pv_small]
+        )
+        assert not mask.any()
+
+    def test_open_local_claims_skip_volume_binding(self):
+        # open-local SCs are scheduled by the storage kernels; the static
+        # volume mask must not reject them even without PV objects
+        nodes = [make_fake_node("n0", "8", "16Gi")]
+        mask = self._mask(
+            nodes,
+            _raw_pod_with_pvc("p0", "claim"),
+            pvcs=[_pvc("claim", sc="open-local-lvm")],
+        )
+        assert mask.all()
