@@ -75,7 +75,13 @@ def build_problem(n_nodes: int, n_pods: int):
 
 
 def time_engine(statics, state, pod_arrays) -> float:
-    """Seconds for one full placement scan (compiled, post-warmup)."""
+    """Seconds for one full placement scan (compiled, post-warmup).
+
+    Timing runs to full host materialization of the placement vector:
+    `block_until_ready` alone under-reports on tunneled TPU backends (it can
+    return before the executable finishes), so the device→host copy is the
+    only trustworthy completion barrier.
+    """
     import jax
     from functools import partial
     from simtpu.engine.scan import schedule_step
@@ -85,11 +91,11 @@ def time_engine(statics, state, pod_arrays) -> float:
         return jax.lax.scan(partial(schedule_step, statics), state, pods)
 
     out = run(statics, state, pod_arrays)  # compile + warm
-    jax.block_until_ready(out)
+    np.asarray(out[1][0])
     t0 = time.perf_counter()
     out = run(statics, state, pod_arrays)
-    jax.block_until_ready(out)
-    return time.perf_counter() - t0, np.asarray(out[1][0])
+    placed_nodes = np.asarray(out[1][0])
+    return time.perf_counter() - t0, placed_nodes
 
 
 def time_serial_baseline(tensors, batch, req, limit: int) -> float:

@@ -33,6 +33,12 @@ _SUFFIX = {
 }
 
 
+# quantity strings repeat massively across pods/nodes (every replica shares
+# its template's "100m"/"64Gi"); memoize with a bounded cache
+_CACHE: dict = {}
+_CACHE_MAX = 1 << 16
+
+
 def parse_quantity(value) -> float:
     """Parse a k8s quantity ("1500m", "16Gi", 2, "32560Mi") to a float scalar."""
     if value is None:
@@ -42,6 +48,9 @@ def parse_quantity(value) -> float:
     s = str(value).strip()
     if not s:
         return 0.0
+    hit = _CACHE.get(s)
+    if hit is not None:
+        return hit
     # exponent form like "1e3" is legal in the k8s grammar
     i = len(s)
     while i > 0 and not (s[i - 1].isdigit() or s[i - 1] == "."):
@@ -50,12 +59,17 @@ def parse_quantity(value) -> float:
     if suffix not in _SUFFIX:
         # maybe scientific notation ("12e6"): float() handles it, no suffix
         try:
-            return float(s)
+            out = float(s)
         except ValueError as exc:
             raise ValueError(f"unparseable quantity {value!r}") from exc
-    if not num:
+    elif not num:
         raise ValueError(f"unparseable quantity {value!r}")
-    return float(num) * _SUFFIX[suffix]
+    else:
+        out = float(num) * _SUFFIX[suffix]
+    if len(_CACHE) >= _CACHE_MAX:
+        _CACHE.clear()
+    _CACHE[s] = out
+    return out
 
 
 def format_quantity(value: float, unit: str = "") -> str:

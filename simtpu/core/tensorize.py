@@ -101,12 +101,23 @@ class NodeLabelIndex:
         self._kv: Dict[Tuple[str, str], np.ndarray] = {}
         self._key: Dict[str, np.ndarray] = {}
         self._val: Dict[str, np.ndarray] = {}  # raw values per key (for Gt/Lt)
+        # plain-dict hits before allocating: dict.setdefault would build a
+        # fresh N-element array per *occurrence*, turning this O(N·labels)
+        # loop into the tensorization bottleneck on 10k+-node clusters
+        kv, key, val = self._kv, self._key, self._val
         for i, node in enumerate(nodes):
             for k, v in labels_of(node).items():
                 v = "" if v is None else str(v)
-                self._kv.setdefault((k, v), np.zeros(self.n, bool))[i] = True
-                self._key.setdefault(k, np.zeros(self.n, bool))[i] = True
-                self._val.setdefault(k, np.full(self.n, "", object))[i] = v
+                arr = kv.get((k, v))
+                if arr is None:
+                    arr = kv[(k, v)] = np.zeros(self.n, bool)
+                arr[i] = True
+                arr = key.get(k)
+                if arr is None:
+                    arr = key[k] = np.zeros(self.n, bool)
+                    val[k] = np.full(self.n, "", object)
+                arr[i] = True
+                val[k][i] = v
 
     def has_kv(self, key: str, value: str) -> np.ndarray:
         arr = self._kv.get((key, value))

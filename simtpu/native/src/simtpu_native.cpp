@@ -1,0 +1,162 @@
+// Native host-path accelerators for simtpu.
+//
+// The TPU engine's compute path is JAX/XLA/Pallas; this library speeds the
+// *host* runtime around it — the role the reference fills with compiled Go
+// (`go.mod:1-3`, static CGO_ENABLED=0 build): ingesting manifests and
+// maintaining the placement-log bookkeeping that rebuilds scan state
+// (`simtpu/engine/state.py`, the analog of the scheduler cache
+// `vendor/k8s.io/kubernetes/pkg/scheduler/internal/cache/cache.go:57`).
+//
+// Exposed via a plain C ABI consumed with ctypes (no pybind11 dependency).
+//
+// Build: g++ -O3 -shared -fPIC -o simtpu_native.so simtpu_native.cpp
+
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+// k8s resource-quantity suffix multipliers (apimachinery resource.Quantity
+// grammar, mirrored from simtpu/core/quantity.py — the two tables must stay
+// in sync; tests/test_native.py cross-checks them on a corpus).
+double suffix_mult(const char* s, bool* ok) {
+  *ok = true;
+  if (s[0] == '\0') return 1.0;
+  if (s[1] == '\0') {
+    switch (s[0]) {
+      case 'n': return 1e-9;
+      case 'u': return 1e-6;
+      case 'm': return 1e-3;
+      case 'k': return 1e3;
+      case 'M': return 1e6;
+      case 'G': return 1e9;
+      case 'T': return 1e12;
+      case 'P': return 1e15;
+      case 'E': return 1e18;
+    }
+  } else if (s[1] == 'i' && s[2] == '\0') {
+    switch (s[0]) {
+      case 'K': return 1024.0;
+      case 'M': return 1048576.0;
+      case 'G': return 1073741824.0;
+      case 'T': return 1099511627776.0;
+      case 'P': return 1125899906842624.0;
+      case 'E': return 1152921504606846976.0;
+    }
+  }
+  *ok = false;
+  return 0.0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse n quantity strings into out[n]. Unparseable entries become NaN and
+// count toward the return value (the Python wrapper raises on nonzero).
+// NULL entries parse to 0.0 (Python-side None).
+long long simtpu_parse_quantities(const char* const* strs, long long n,
+                                  double* out) {
+  long long bad = 0;
+  for (long long i = 0; i < n; ++i) {
+    const char* raw = strs[i];
+    if (raw == nullptr) {
+      out[i] = 0.0;
+      continue;
+    }
+    // strip ascii whitespace
+    while (*raw != '\0' && std::isspace(static_cast<unsigned char>(*raw))) ++raw;
+    size_t len = std::strlen(raw);
+    while (len > 0 && std::isspace(static_cast<unsigned char>(raw[len - 1]))) --len;
+    if (len == 0) {
+      out[i] = 0.0;
+      continue;
+    }
+    // split at the last digit/dot (quantity.py's suffix scan)
+    size_t cut = len;
+    while (cut > 0) {
+      char c = raw[cut - 1];
+      if (std::isdigit(static_cast<unsigned char>(c)) || c == '.') break;
+      --cut;
+    }
+    char suffix[8] = {0};
+    size_t suffix_len = len - cut;
+    bool suffix_ok = suffix_len < sizeof(suffix);
+    double mult = 1.0;
+    if (suffix_ok) {
+      std::memcpy(suffix, raw + cut, suffix_len);
+      mult = suffix_mult(suffix, &suffix_ok);
+    }
+    char* end = nullptr;
+    if (suffix_ok && cut > 0) {
+      // number part (may itself be scientific like "1.5e3" — but the suffix
+      // scan stops at the trailing digit, so "12e6" lands here with suffix "")
+      char numbuf[64];
+      if (cut >= sizeof(numbuf)) {
+        out[i] = NAN;
+        ++bad;
+        continue;
+      }
+      std::memcpy(numbuf, raw, cut);
+      numbuf[cut] = '\0';
+      double v = std::strtod(numbuf, &end);
+      if (end == numbuf || *end != '\0') {
+        out[i] = NAN;
+        ++bad;
+      } else {
+        out[i] = v * mult;
+      }
+    } else {
+      // unknown suffix: accept only if the whole string is a valid float
+      // (scientific notation), mirroring quantity.py's fallback
+      char allbuf[64];
+      if (len >= sizeof(allbuf)) {
+        out[i] = NAN;
+        ++bad;
+        continue;
+      }
+      std::memcpy(allbuf, raw, len);
+      allbuf[len] = '\0';
+      double v = std::strtod(allbuf, &end);
+      if (end == allbuf || *end != '\0') {
+        out[i] = NAN;
+        ++bad;
+      } else {
+        out[i] = v;
+      }
+    }
+  }
+  return bad;
+}
+
+// dst[idx[i], :] += src[i, :]  — the unbuffered row-scatter `np.add.at`
+// performs ~50x slower; used to rebuild free/ports/volume state from the
+// placement log (engine/state.py build_state).
+void simtpu_scatter_add_rows(float* dst, long long n_rows, long long n_cols,
+                             const int32_t* idx, const float* src,
+                             long long n_src) {
+  for (long long i = 0; i < n_src; ++i) {
+    long long r = idx[i];
+    if (r < 0 || r >= n_rows) continue;
+    float* drow = dst + r * n_cols;
+    const float* srow = src + i * n_cols;
+    for (long long c = 0; c < n_cols; ++c) drow[c] += srow[c];
+  }
+}
+
+// dst[idx[i]] += vals[i] over a flattened target — the generic form used for
+// the [T, D] topology-count rebuilds (indices pre-flattened host-side).
+void simtpu_scatter_add_flat(float* dst, long long dst_len,
+                             const int64_t* idx, const float* vals,
+                             long long n) {
+  for (long long i = 0; i < n; ++i) {
+    int64_t j = idx[i];
+    if (j < 0 || j >= dst_len) continue;
+    dst[j] += vals[i];
+  }
+}
+
+}  // extern "C"
