@@ -74,7 +74,7 @@ def build_problem(n_nodes: int, n_pods: int):
     return tensors, batch, statics, state, pod_arrays, req, gen_s, tensorize_s
 
 
-def time_engine(statics, state, pod_arrays) -> float:
+def time_engine(statics, state, pod_arrays, flags=None) -> float:
     """Seconds for one full placement scan (compiled, post-warmup).
 
     Timing runs to full host materialization of the placement vector:
@@ -84,11 +84,15 @@ def time_engine(statics, state, pod_arrays) -> float:
     """
     import jax
     from functools import partial
-    from simtpu.engine.scan import schedule_step
+    from simtpu.engine.scan import StepFlags, schedule_step
+
+    step_flags = flags if flags is not None else StepFlags()
 
     @jax.jit
     def run(statics, state, pods):
-        return jax.lax.scan(partial(schedule_step, statics), state, pods)
+        return jax.lax.scan(
+            partial(schedule_step, statics, flags=step_flags), state, pods
+        )
 
     out = run(statics, state, pod_arrays)  # compile + warm
     np.asarray(out[1][0])
@@ -143,7 +147,11 @@ def main() -> int:
         tensorize_s,
     ) = build_problem(n_nodes, n_pods)
 
-    engine_s, placed_nodes = time_engine(statics, state, pod_arrays)
+    from simtpu.engine.scan import flags_from
+
+    engine_s, placed_nodes = time_engine(
+        statics, state, pod_arrays, flags_from(tensors, batch.ext)
+    )
     placed = int((placed_nodes >= 0).sum())
     pods_per_sec = len(batch.group) / engine_s
 

@@ -89,8 +89,7 @@ class StaticArrays(NamedTuple):
     node_pref: jnp.ndarray  # [G, N]
     taint_intol: jnp.ndarray  # [G, N]
     static_score: jnp.ndarray  # [G, N] ImageLocality + NodePreferAvoidPods (pre-weighted)
-    node_dom: jnp.ndarray  # [K, N]
-    term_topo: jnp.ndarray  # [T]
+    dom_tn: jnp.ndarray  # [T, N] node n's domain for term t's topo key (-1 absent)
     s_match: jnp.ndarray  # [G, T]
     a_aff_req: jnp.ndarray  # [G, T]
     a_anti_req: jnp.ndarray  # [G, T]
@@ -157,8 +156,9 @@ def statics_from(tensors: ClusterTensors) -> StaticArrays:
         node_pref=jnp.asarray(tensors.node_pref_score),
         taint_intol=jnp.asarray(tensors.taint_intolerable),
         static_score=jnp.asarray(tensors.static_score, jnp.float32),
-        node_dom=jnp.asarray(tensors.node_dom, jnp.int32),
-        term_topo=jnp.asarray(tensors.term_topo_key, jnp.int32),
+        # the per-term domain gather node_dom[term_topo] is hoisted out of the
+        # scan body: it is the single most-reused index structure of the step
+        dom_tn=jnp.asarray(tensors.dom_tn(), jnp.int32),
         s_match=jnp.asarray(tensors.s_match),
         a_aff_req=jnp.asarray(tensors.a_aff_req),
         a_anti_req=jnp.asarray(tensors.a_anti_req),
@@ -185,8 +185,67 @@ def statics_from(tensors: ClusterTensors) -> StaticArrays:
     )
 
 
+class StepFlags(NamedTuple):
+    """Statically-known problem features, used to compile reduced scan steps.
+
+    Each False flag removes the corresponding kernels from the traced step
+    entirely — the scan is launch-count-bound on small node counts, so pruning
+    unused constraint families is the main single-pod throughput lever. All
+    flags True (the default) compiles the fully general step.
+    """
+
+    ports: bool = True  # any group requests host ports
+    vols: bool = True  # any exclusive-volume conflicts possible
+    attach: bool = True  # any attachable volumes present
+    spread_hard: bool = True  # any DoNotSchedule topology constraint
+    spread_soft: bool = True  # any ScheduleAnyway constraint
+    selector_spread: bool = True  # any SelectorSpread counting term
+    interpod_req: bool = True  # any required (anti-)affinity term
+    interpod_pref: bool = True  # any preferred (anti-)affinity weight
+    storage: bool = True  # any Open-Local node storage or pod demand
+    gpu: bool = True  # any GPU-share capacity or pod demand
+    node_pref: bool = True  # any preferred node affinity weight
+    taint_pref: bool = True  # any intolerable PreferNoSchedule taint
+    static_score: bool = True  # any ImageLocality / preferAvoidPods signal
+
+
+def flags_from(tensors: ClusterTensors, batch_ext: dict) -> StepFlags:
+    """Derive the reduced-step flags from concrete host-side arrays.
+
+    `batch_ext` (PodBatch.ext) is required: the storage/gpu flags must see
+    the batch's demands, or a storage-demanding pod on a storage-less
+    cluster would compile a step that skips the Open-Local filter entirely.
+    """
+    ext = tensors.ext
+    storage = bool(ext.has_storage.any())
+    gpu = bool(ext.gpu_total.any())
+    storage = storage or bool(
+        np.asarray(batch_ext["lvm_size"]).size
+        and np.asarray(batch_ext["lvm_size"]).max() > 0
+    ) or bool(
+        np.asarray(batch_ext["dev_size"]).size
+        and np.asarray(batch_ext["dev_size"]).max() > 0
+    )
+    gpu = gpu or bool(np.asarray(batch_ext["gpu_mem"]).max(initial=0) > 0)
+    return StepFlags(
+        ports=tensors.n_ports > 0,
+        vols=bool(tensors.vol_rw.any() or tensors.vol_ro.any()),
+        attach=bool(tensors.vol_att.any()),
+        spread_hard=bool(tensors.spread_hard.any()),
+        spread_soft=bool(tensors.spread_soft.any()),
+        selector_spread=bool(tensors.ss_host.any() or tensors.ss_zone.any()),
+        interpod_req=bool(tensors.a_aff_req.any() or tensors.a_anti_req.any()),
+        interpod_pref=bool(tensors.w_aff_pref.any() or tensors.w_anti_pref.any()),
+        storage=storage,
+        gpu=gpu,
+        node_pref=bool(tensors.node_pref_score.any()),
+        taint_pref=bool(tensors.taint_intolerable.any()),
+        static_score=bool(tensors.static_score.any()),
+    )
+
+
 def schedule_step(
-    statics: StaticArrays, state: SchedState, pod
+    statics: StaticArrays, state: SchedState, pod, flags: StepFlags = StepFlags()
 ) -> Tuple[SchedState, Tuple[jnp.ndarray, jnp.ndarray]]:
     """One scheduling cycle for one pod against every node."""
     (
@@ -204,26 +263,41 @@ def schedule_step(
     ) = pod
     n = statics.alloc.shape[0]
     node_ids = jnp.arange(n)
+    t_count = statics.dom_tn.shape[0]
+    f = flags
+
+    # state.cnt_* are already per-node ([T, N], SchedState) — the topology
+    # kernels read them directly; only the key-presence mask is derived here
+    if t_count:
+        valid_tn = statics.dom_tn >= 0
 
     static_m = statics.static_mask[g]
     # pin: -1 = unpinned, -2 = pinned to a nonexistent node (matches nothing)
     pin_m = jnp.where(pin >= 0, node_ids == pin, pin > -2)
     m_static = static_m & pin_m & statics.node_valid
     # NodePorts precedes NodeResourcesFit in the registry filter order
-    m_ports = m_static & ports_conflict_free(state.ports_used, statics.ports_req[g])
+    m_ports = m_static
+    if f.ports:
+        m_ports = m_static & ports_conflict_free(
+            state.ports_used, statics.ports_req[g]
+        )
     m_res = m_ports & resources_fit(state.free, req)
 
     # VolumeRestrictions then NodeVolumeLimits follow NodeResourcesFit in the
     # registry filter order
-    m_vol = m_res & volume_conflict_free(
-        state.vols_any, state.vols_rw, statics.vol_rw_req[g], statics.vol_ro_req[g]
-    )
-    m_att = m_vol & attach_limits_ok(
-        state.vols_any,
-        statics.vol_att_req[g],
-        statics.vol_class_mask,
-        statics.attach_limits,
-    )
+    m_vol = m_res
+    if f.vols:
+        m_vol = m_res & volume_conflict_free(
+            state.vols_any, state.vols_rw, statics.vol_rw_req[g], statics.vol_ro_req[g]
+        )
+    m_att = m_vol
+    if f.attach:
+        m_att = m_vol & attach_limits_ok(
+            state.vols_any,
+            statics.vol_att_req[g],
+            statics.vol_class_mask,
+            statics.attach_limits,
+        )
 
     # VolumeBinding + VolumeZone (precomputed per group; PVC/PV/SC objects
     # never change during a simulation)
@@ -231,97 +305,108 @@ def schedule_step(
 
     # Open-Local storage (plugin Filter, open-local.go:50-91): pods that need
     # storage only fit nodes carrying the storage annotation
-    needs_storage = jnp.any(lvm_size > 0) | jnp.any(dev_size > 0)
-    lvm_ok, lvm_alloc = lvm_plan(state.vg_free, statics.vg_name_id, lvm_size, lvm_vg)
-    dev_ok, dev_take, dev_tight = device_plan(
-        state.sdev_free, statics.sdev_cap, statics.sdev_media, dev_size, dev_media
-    )
-    storage_ok = jnp.where(needs_storage, statics.has_storage & lvm_ok & dev_ok, True)
-    m_storage = m_bind & storage_ok
+    m_storage = m_bind
+    if f.storage:
+        needs_storage = jnp.any(lvm_size > 0) | jnp.any(dev_size > 0)
+        lvm_ok, lvm_alloc = lvm_plan(
+            state.vg_free, statics.vg_name_id, lvm_size, lvm_vg
+        )
+        dev_ok, dev_take, dev_tight = device_plan(
+            state.sdev_free, statics.sdev_cap, statics.sdev_media, dev_size, dev_media
+        )
+        storage_ok = jnp.where(
+            needs_storage, statics.has_storage & lvm_ok & dev_ok, True
+        )
+        m_storage = m_bind & storage_ok
+    else:
+        lvm_alloc = jnp.zeros_like(statics.vg_cap)
+        dev_take = jnp.zeros(statics.sdev_cap.shape, bool)
 
     # GPU share (plugin Filter, open-gpu-share.go:51-81)
-    gpu_ok, gpu_shares = gpu_plan(
-        state.gpu_free,
-        statics.gpu_dev_exists,
-        statics.gpu_total,
-        gpu_mem,
-        gpu_count,
-        gpu_preset,
-    )
-    m_gpu = m_storage & gpu_ok
+    m_gpu = m_storage
+    if f.gpu:
+        gpu_ok, gpu_shares = gpu_plan(
+            state.gpu_free,
+            statics.gpu_dev_exists,
+            statics.gpu_total,
+            gpu_mem,
+            gpu_count,
+            gpu_preset,
+        )
+        m_gpu = m_storage & gpu_ok
+    else:
+        gpu_shares = jnp.zeros_like(state.gpu_free)
 
     # PodTopologySpread hard constraints (filtering.go); eligible-domain
     # minimum taken over nodes passing the pod's static filters
-    m_spread = m_gpu & topology_spread_filter(
-        state.cnt_match,
-        statics.node_dom,
-        statics.term_topo,
-        statics.spread_hard[g],
-        m_static,
-    )
+    m_spread = m_gpu
+    if f.spread_hard and t_count:
+        m_spread = m_gpu & topology_spread_filter(
+            state.cnt_match, valid_tn, statics.spread_hard[g], m_static
+        )
 
-    m_all = m_spread & interpod_filter(
-        state.cnt_match,
-        state.cnt_own_anti,
-        statics.node_dom,
-        statics.term_topo,
-        statics.s_match[g],
-        statics.a_aff_req[g],
-        statics.a_anti_req[g],
-    )
+    m_all = m_spread
+    if f.interpod_req and t_count:
+        m_all = m_spread & interpod_filter(
+            state.cnt_match,
+            state.cnt_own_anti,
+            valid_tn,
+            state.cnt_total,
+            statics.s_match[g],
+            statics.a_aff_req[g],
+            statics.a_anti_req[g],
+        )
     feasible = jnp.any(m_all)
 
     # -- scores (weights: registry.go:101-145 + Simon extension) ----------
+    # Every skipped term is constant across nodes for problems where its flag
+    # is False (normalizers map all-zero raw scores to a constant), so
+    # pruning preserves the argmax exactly.
     score = least_allocated(state.free, statics.alloc, req)
     score += balanced_allocation(state.free, statics.alloc, req)
-    score += minmax_normalize(simon_share(statics.alloc, req), m_all)
-    score += minmax_normalize(statics.node_pref[g], m_all)
-    score += taint_toleration_score(statics.taint_intol[g], m_all)
-    raw_ipa = interpod_score(
-        state.cnt_match,
-        state.cnt_own_aff,
-        state.w_own_aff_pref,
-        state.w_own_anti_pref,
-        statics.node_dom,
-        statics.term_topo,
-        statics.s_match[g],
-        statics.w_aff_pref[g],
-        statics.w_anti_pref[g],
-    )
-    score += maxabs_normalize(raw_ipa, m_all)
+    # Simon score + the GPU-share score, which is the same dominant-share
+    # formula (open-gpu-share.go:84-110): computed once, counted twice
+    score += 2.0 * minmax_normalize(simon_share(statics.alloc, req), m_all)
+    if f.node_pref:
+        score += minmax_normalize(statics.node_pref[g], m_all)
+    if f.taint_pref:
+        score += taint_toleration_score(statics.taint_intol[g], m_all)
+    if (f.interpod_pref or f.interpod_req) and t_count:
+        raw_ipa = interpod_score(
+            state.cnt_match,
+            state.cnt_own_aff,
+            state.w_own_aff_pref,
+            state.w_own_anti_pref,
+            statics.s_match[g],
+            statics.w_aff_pref[g],
+            statics.w_anti_pref[g],
+        )
+        score += maxabs_normalize(raw_ipa, m_all)
     # PodTopologySpread soft constraints, registry weight 2
-    score += 2.0 * topology_spread_score(
-        state.cnt_match,
-        statics.node_dom,
-        statics.term_topo,
-        statics.spread_soft[g],
-        m_all,
-    )
+    if f.spread_soft and t_count:
+        score += 2.0 * topology_spread_score(
+            state.cnt_match, statics.spread_soft[g], m_all
+        )
     # SelectorSpread (default workload/service spreading, weight 1)
-    score += selector_spread_score(
-        state.cnt_match,
-        statics.node_dom,
-        statics.term_topo,
-        statics.ss_host[g],
-        statics.ss_zone[g],
-        m_all,
-    )
+    if f.selector_spread and t_count:
+        score += selector_spread_score(
+            state.cnt_match, statics.ss_host[g], statics.ss_zone[g], m_all
+        )
     # ImageLocality + NodePreferAvoidPods (static, pre-weighted)
-    score += statics.static_score[g]
-    # Open-Local score (binpack; plugin weight 1) + GPU-share score — the
-    # latter is the same dominant-share formula as Simon's
-    # (open-gpu-share.go:84-110), so its normalized term repeats
-    score += minmax_normalize(
-        open_local_score(
-            lvm_alloc,
-            statics.vg_cap,
-            dev_tight,
-            jnp.sum(lvm_size > 0),
-            jnp.sum(dev_size > 0),
-        ),
-        m_all,
-    )
-    score += minmax_normalize(simon_share(statics.alloc, req), m_all)
+    if f.static_score:
+        score += statics.static_score[g]
+    # Open-Local score (binpack; plugin weight 1)
+    if f.storage:
+        score += minmax_normalize(
+            open_local_score(
+                lvm_alloc,
+                statics.vg_cap,
+                dev_tight,
+                jnp.sum(lvm_size > 0),
+                jnp.sum(dev_size > 0),
+            ),
+            m_all,
+        )
     score = jnp.where(m_all, score, -jnp.inf)
 
     chosen = jnp.where(forced, pin, jnp.argmax(score).astype(jnp.int32))
@@ -355,64 +440,69 @@ def schedule_step(
     # -- state update (no-op when not placed) -----------------------------
     safe = jnp.clip(chosen, 0)
     w = jnp.where(placed, 1.0, 0.0)
-    free = state.free.at[safe].add(-req * w)
-    ports_used = state.ports_used.at[safe].add(statics.ports_req[g] * w)
-    v_rw = statics.vol_rw_req[g]
-    v_present = v_rw | statics.vol_ro_req[g] | statics.vol_att_req[g]
-    vols_any = state.vols_any.at[safe].add(v_present * w)
-    vols_rw = state.vols_rw.at[safe].add(v_rw * w)
-    vg_free = state.vg_free.at[safe].add(-lvm_alloc[safe] * w)
-    sdev_free = state.sdev_free.at[safe].set(
-        state.sdev_free[safe] & ~(dev_take[safe] & placed)
-    )
-    gpu_free = state.gpu_free.at[safe].add(-gpu_shares[safe] * gpu_mem * w)
+    updates = {"free": state.free.at[safe].add(-req * w)}
+    if f.ports:
+        updates["ports_used"] = state.ports_used.at[safe].add(
+            statics.ports_req[g] * w
+        )
+    if f.vols or f.attach:
+        v_rw = statics.vol_rw_req[g]
+        v_present = v_rw | statics.vol_ro_req[g] | statics.vol_att_req[g]
+        updates["vols_any"] = state.vols_any.at[safe].add(v_present * w)
+        if f.vols:
+            updates["vols_rw"] = state.vols_rw.at[safe].add(v_rw * w)
+    if f.storage:
+        updates["vg_free"] = state.vg_free.at[safe].add(-lvm_alloc[safe] * w)
+        updates["sdev_free"] = state.sdev_free.at[safe].set(
+            state.sdev_free[safe] & ~(dev_take[safe] & placed)
+        )
+    if f.gpu:
+        updates["gpu_free"] = state.gpu_free.at[safe].add(
+            -gpu_shares[safe] * gpu_mem * w
+        )
     pod_lvm_alloc = lvm_alloc[safe] * w
     pod_dev_take = dev_take[safe] & placed
     pod_gpu_shares = gpu_shares[safe] * w
 
-    t_count = statics.term_topo.shape[0]
     if t_count:
-        dom_t = statics.node_dom[statics.term_topo, safe]  # [T]
-        valid = (dom_t >= 0) & placed
-        dsafe = jnp.where(dom_t >= 0, dom_t, 0)
-        t_idx = jnp.arange(t_count)
-        vw = jnp.where(valid, 1.0, 0.0)
+        # same-domain increment: every node sharing the chosen node's domain
+        # for term t gains the pod's incidence — a streaming [T, N] compare,
+        # no scatter (see SchedState)
+        dom_chosen = statics.dom_tn[:, safe]  # [T]
+        valid_chosen = (dom_chosen >= 0) & placed  # [T]
+        same = (
+            valid_tn
+            & (statics.dom_tn == dom_chosen[:, None])
+            & valid_chosen[:, None]
+        )
+        inc = jnp.where(same, 1.0, 0.0)  # [T, N]
 
         def bump(arr, vals):
-            return arr.at[t_idx, dsafe].add(vals * vw)
+            return arr + vals[:, None] * inc
 
-        new_state = SchedState(
-            free=free,
-            cnt_match=bump(state.cnt_match, statics.s_match[g]),
-            cnt_own_anti=bump(state.cnt_own_anti, statics.a_anti_req[g]),
-            cnt_own_aff=bump(state.cnt_own_aff, statics.a_aff_req[g]),
-            w_own_aff_pref=bump(state.w_own_aff_pref, statics.w_aff_pref[g]),
-            w_own_anti_pref=bump(state.w_own_anti_pref, statics.w_anti_pref[g]),
-            vg_free=vg_free,
-            sdev_free=sdev_free,
-            gpu_free=gpu_free,
-            ports_used=ports_used,
-            vols_any=vols_any,
-            vols_rw=vols_rw,
+        updates["cnt_match"] = bump(state.cnt_match, statics.s_match[g])
+        updates["cnt_total"] = state.cnt_total + statics.s_match[g] * jnp.where(
+            valid_chosen, 1.0, 0.0
         )
-    else:
-        new_state = state._replace(
-            free=free,
-            vg_free=vg_free,
-            sdev_free=sdev_free,
-            gpu_free=gpu_free,
-            ports_used=ports_used,
-            vols_any=vols_any,
-            vols_rw=vols_rw,
-        )
+        if f.interpod_req:
+            updates["cnt_own_anti"] = bump(state.cnt_own_anti, statics.a_anti_req[g])
+            updates["cnt_own_aff"] = bump(state.cnt_own_aff, statics.a_aff_req[g])
+        if f.interpod_pref:
+            updates["w_own_aff_pref"] = bump(
+                state.w_own_aff_pref, statics.w_aff_pref[g]
+            )
+            updates["w_own_anti_pref"] = bump(
+                state.w_own_anti_pref, statics.w_anti_pref[g]
+            )
+    new_state = state._replace(**updates)
 
     out_node = jnp.where(placed, chosen, -1)
     return new_state, (out_node, reason, pod_lvm_alloc, pod_dev_take, pod_gpu_shares)
 
 
-@partial(jax.jit, static_argnums=(), donate_argnums=(1,))
-def _run_scan(statics: StaticArrays, state: SchedState, pods):
-    return jax.lax.scan(partial(schedule_step, statics), state, pods)
+@partial(jax.jit, static_argnums=(3,), donate_argnums=(1,))
+def _run_scan(statics: StaticArrays, state: SchedState, pods, flags: StepFlags = StepFlags()):
+    return jax.lax.scan(partial(schedule_step, statics, flags=flags), state, pods)
 
 
 class Engine:
@@ -437,10 +527,12 @@ class Engine:
         }
         self.last_state: SchedState = None
 
-    def _dispatch(self, statics: StaticArrays, state: SchedState, pods):
+    def _dispatch(
+        self, statics: StaticArrays, state: SchedState, pods, flags: StepFlags
+    ):
         """Run the compiled scan. `ShardedEngine` (simtpu/parallel) overrides
         this to lay the node axis out across a device mesh."""
-        return _run_scan(statics, state, pods)
+        return _run_scan(statics, state, pods, flags)
 
     def place(self, batch: PodBatch):
         """Schedule one batch.
@@ -465,8 +557,9 @@ class Engine:
         )
         statics = statics_from(tensors)
         ext = batch.ext
+        flags = flags_from(tensors, batch.ext)
         final_state, (nodes, reasons, lvm_alloc, dev_take, gpu_shares) = self._dispatch(
-            statics, state, pods
+            statics, state, pods, flags
         )
         self.last_state = final_state
         nodes = np.asarray(nodes)

@@ -29,12 +29,22 @@ def _add_at_rows(dst: np.ndarray, idx: np.ndarray, src: np.ndarray) -> None:
 class SchedState(NamedTuple):
     """Mutable-under-scan cluster state.
 
+    Topology counts are stored **per node**, not per domain: `cnt_*[t, n]` is
+    the count in node n's domain for term t's topology key (0 where the node
+    misses the key). Placing a pod updates them with one vectorized
+    same-domain compare (`dom_tn == dom_tn[:, chosen]`) — no gather or
+    scatter appears anywhere in the scan step, which is what keeps the step
+    fast on TPU (gathers over the domain axis were the dominant cost), and
+    the [T, N] layout shards over the node axis with everything else.
+
     free:            [N, R] remaining allocatable per node
-    cnt_match:       [T, D] placed pods matching term t's selector+ns, per domain
-    cnt_own_anti:    [T, D] placed pods owning required anti-affinity term t
-    cnt_own_aff:     [T, D] placed pods owning required affinity term t
-    w_own_aff_pref:  [T, D] summed preferred-affinity weights of placed owners
-    w_own_anti_pref: [T, D] summed preferred-anti-affinity weights
+    cnt_match:       [T, N] placed pods matching term t in node n's domain
+    cnt_total:       [T] cluster-wide matching count per term (pods placed on
+                     nodes carrying the key — interpod first-pod escape)
+    cnt_own_anti:    [T, N] placed pods owning required anti-affinity term t
+    cnt_own_aff:     [T, N] placed pods owning required affinity term t
+    w_own_aff_pref:  [T, N] summed preferred-affinity weights of placed owners
+    w_own_anti_pref: [T, N] summed preferred-anti-affinity weights
     vg_free:         [N, V] free LVM volume-group space (Open-Local)
     sdev_free:       [N, SD] exclusive storage devices still unallocated
     gpu_free:        [N, GD] free GPU memory per device (GPU-share)
@@ -45,6 +55,7 @@ class SchedState(NamedTuple):
 
     free: jnp.ndarray
     cnt_match: jnp.ndarray
+    cnt_total: jnp.ndarray
     cnt_own_anti: jnp.ndarray
     cnt_own_aff: jnp.ndarray
     w_own_aff_pref: jnp.ndarray
@@ -133,13 +144,27 @@ def build_state(
                         (t_idx[valid], dom_pt[valid]),
                         vals,
                     )
+    # per-domain counts → per-node counts (the scan-state layout, SchedState)
+    if t:
+        dom_tn = tensors.dom_tn()  # [T, N]
+        valid_tn = dom_tn >= 0
+        safe_tn = np.where(valid_tn, dom_tn, 0)
+        t_col = np.arange(t)[:, None]
+        cnt_n = np.where(valid_tn[None], cnt[:, t_col, safe_tn], 0.0).astype(
+            np.float32
+        )  # [5, T, N]
+        cnt_total = cnt[0].sum(axis=1)
+    else:
+        cnt_n = np.zeros((5, 0, n), np.float32)
+        cnt_total = np.zeros(0, np.float32)
     return SchedState(
         free=jnp.asarray(free),
-        cnt_match=jnp.asarray(cnt[0]),
-        cnt_own_anti=jnp.asarray(cnt[1]),
-        cnt_own_aff=jnp.asarray(cnt[2]),
-        w_own_aff_pref=jnp.asarray(cnt[3]),
-        w_own_anti_pref=jnp.asarray(cnt[4]),
+        cnt_match=jnp.asarray(cnt_n[0]),
+        cnt_total=jnp.asarray(cnt_total),
+        cnt_own_anti=jnp.asarray(cnt_n[1]),
+        cnt_own_aff=jnp.asarray(cnt_n[2]),
+        w_own_aff_pref=jnp.asarray(cnt_n[3]),
+        w_own_anti_pref=jnp.asarray(cnt_n[4]),
         vg_free=jnp.asarray(vg_free),
         sdev_free=jnp.asarray(sdev_free),
         gpu_free=jnp.asarray(gpu_free),

@@ -76,9 +76,8 @@ def attach_limits_ok(
 
 
 def topology_spread_filter(
-    cnt_match: jnp.ndarray,  # [T, D] placed pods matching term selector, per domain
-    node_dom: jnp.ndarray,  # [K, N] global domain id per topo key (-1 absent)
-    term_topo: jnp.ndarray,  # [T]
+    cnt_at: jnp.ndarray,  # [T, N] placed pods matching term t at node n's domain
+    valid: jnp.ndarray,  # [T, N] node carries term t's topology key
     max_skew: jnp.ndarray,  # [T] maxSkew of the pod's DoNotSchedule constraints (0 = inactive)
     elig_nodes: jnp.ndarray,  # [N] nodes eligible for the pod (static mask ∩ valid)
 ) -> jnp.ndarray:
@@ -90,29 +89,18 @@ def topology_spread_filter(
     The eligible-domain minimum is taken over domains containing ≥1 node that
     passes the pod's static filters (upstream restricts to nodes passing
     nodeSelector/nodeAffinity; our static mask folds taints in as well — a
-    strictly tighter, usually identical set). Counts are cluster-wide per
+    strictly tighter, usually identical set); since every eligible domain
+    surfaces its count at its eligible nodes, the per-node masked minimum of
+    `cnt_at` equals the per-domain minimum. Counts are cluster-wide per
     domain rather than restricted to eligible nodes.
     """
-    t_count, d_count = cnt_match.shape
-    n = node_dom.shape[-1] if node_dom.ndim else elig_nodes.shape[0]
+    t_count, n = cnt_at.shape
     active = max_skew > 0
     if t_count == 0:
         return jnp.ones(n, bool)
-    if d_count == 0:
-        # term universe exists but no node carries any topology key: every
-        # active constraint is unsatisfiable (upstream filters nodes missing
-        # the key), so feasibility is simply "pod has no hard constraint"
-        return jnp.broadcast_to(~jnp.any(active), (n,))
-    dom_tn = node_dom[term_topo]  # [T, N]
-    valid = dom_tn >= 0
-    safe = jnp.where(valid, dom_tn, 0)
-    t_idx = jnp.arange(t_count)[:, None]
-    cnt_at = jnp.where(valid, cnt_match[t_idx, safe], 0.0)  # [T, N]
-    # eligible-domain incidence and per-term minimum count
-    contrib = (valid & elig_nodes[None, :]).astype(jnp.int32)
-    elig_td = jnp.zeros((t_count, d_count), jnp.int32).at[t_idx, safe].max(contrib)
     inf = jnp.float32(3.4e38)
-    min_cnt = jnp.min(jnp.where(elig_td > 0, cnt_match, inf), axis=1)  # [T]
+    elig = valid & elig_nodes[None, :]
+    min_cnt = jnp.min(jnp.where(elig, cnt_at, inf), axis=1)  # [T]
     min_cnt = jnp.where(min_cnt >= inf, 0.0, min_cnt)
     ok_tn = (~active[:, None]) | (
         valid & (cnt_at + 1.0 - min_cnt[:, None] <= max_skew[:, None])
@@ -121,10 +109,10 @@ def topology_spread_filter(
 
 
 def interpod_filter(
-    cnt_match: jnp.ndarray,  # [T, D] placed pods matching term selector+ns
-    cnt_own_anti: jnp.ndarray,  # [T, D] placed pods owning required anti term
-    node_dom: jnp.ndarray,  # [K, N] global domain id per topo key (-1 absent)
-    term_topo: jnp.ndarray,  # [T] topo-key index per term
+    cnt_at: jnp.ndarray,  # [T, N] placed pods matching term t at node n's domain
+    own_anti_at: jnp.ndarray,  # [T, N] placed owners of required anti term t
+    valid: jnp.ndarray,  # [T, N] node carries term t's topology key
+    cnt_total: jnp.ndarray,  # [T] cluster-wide matching count per term
     s_match: jnp.ndarray,  # [T] incoming pod matches term selector+ns
     a_aff: jnp.ndarray,  # [T] incoming pod requires affinity term t
     a_anti: jnp.ndarray,  # [T] incoming pod requires anti-affinity term t
@@ -140,30 +128,23 @@ def interpod_filter(
       pod may have a matching placed pod in the node's domain.
     - satisfyExistingPodsAntiAffinity: no placed pod owning a required
       anti-affinity term that matches the incoming pod may share its domain.
-    Returns mask [N].
+    The [T, N] inputs are the engine's per-node count state. Returns mask [N].
     """
-    t_count, _ = cnt_match.shape
+    t_count, n = cnt_at.shape
     if t_count == 0:
-        return jnp.ones(node_dom.shape[-1] if node_dom.ndim else 0, bool)
-
-    dom_tn = node_dom[term_topo]  # [T, N] domain id of each node for each term's key
-    valid = dom_tn >= 0
-    safe = jnp.where(valid, dom_tn, 0)
-    t_idx = jnp.arange(t_count)[:, None]
-    match_at = jnp.where(valid, cnt_match[t_idx, safe], 0.0)  # [T, N]
-    own_anti_at = jnp.where(valid, cnt_own_anti[t_idx, safe], 0.0)
+        return jnp.ones(n, bool)
 
     # anti-affinity: incoming pod's terms
-    anti_violated = jnp.any(a_anti[:, None] & (match_at > 0), axis=0)  # [N]
+    anti_violated = jnp.any(a_anti[:, None] & (cnt_at > 0), axis=0)  # [N]
     # symmetry: existing pods' anti terms that select the incoming pod
     sym_violated = jnp.any(s_match[:, None] & (own_anti_at > 0), axis=0)
 
     # affinity: every required term satisfied in-domain (key must exist)
-    aff_term_ok = (~a_aff[:, None]) | (valid & (match_at > 0))  # [T, N]
+    aff_term_ok = (~a_aff[:, None]) | (valid & (cnt_at > 0))  # [T, N]
     aff_ok = jnp.all(aff_term_ok, axis=0)
     # first-pod-in-series escape: no matching pod anywhere for any required
     # term AND the pod matches all its own terms AND node has all topo keys
-    total_match = jnp.sum(jnp.where(a_aff, jnp.sum(cnt_match, axis=1), 0.0))
+    total_match = jnp.sum(jnp.where(a_aff, cnt_total, 0.0))
     self_ok = (
         (total_match == 0)
         & jnp.all(jnp.where(a_aff, s_match, True))

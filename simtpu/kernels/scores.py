@@ -92,28 +92,8 @@ def taint_toleration_score(intolerable_cnt: jnp.ndarray, mask: jnp.ndarray) -> j
     )
 
 
-def _counts_at_nodes(
-    cnt_match: jnp.ndarray,  # [T, D]
-    node_dom: jnp.ndarray,  # [K, N]
-    term_topo: jnp.ndarray,  # [T]
-    term_w: jnp.ndarray,  # [T] per-term weight (0 = term not counted)
-) -> jnp.ndarray:
-    """Weighted sum over terms of each node's domain count → [N]."""
-    t_count = cnt_match.shape[0]
-    if t_count == 0:
-        return jnp.zeros(node_dom.shape[-1] if node_dom.ndim else 0, jnp.float32)
-    dom_tn = node_dom[term_topo]
-    valid = dom_tn >= 0
-    safe = jnp.where(valid, dom_tn, 0)
-    t_idx = jnp.arange(t_count)[:, None]
-    cnt_at = jnp.where(valid, cnt_match[t_idx, safe], 0.0)
-    return jnp.sum(term_w[:, None] * cnt_at, axis=0)
-
-
 def topology_spread_score(
-    cnt_match: jnp.ndarray,  # [T, D]
-    node_dom: jnp.ndarray,  # [K, N]
-    term_topo: jnp.ndarray,  # [T]
+    cnt_at: jnp.ndarray,  # [T, N] matching placed pods at each node's domain
     soft_w: jnp.ndarray,  # [T] ScheduleAnyway constraint multiplicity
     mask: jnp.ndarray,  # [N] feasible nodes
 ) -> jnp.ndarray:
@@ -121,7 +101,7 @@ def topology_spread_score(
     registry weight 2 applied by the caller): lower matching count in the
     node's domains → higher score, inverse-min-max to [0, 100]; nodes missing
     a topology key count 0 for that constraint."""
-    raw = _counts_at_nodes(cnt_match, node_dom, term_topo, soft_w)
+    raw = soft_w @ cnt_at
     big = jnp.float32(3.4e38)
     lo = jnp.min(jnp.where(mask, raw, big))
     hi = jnp.max(jnp.where(mask, raw, -big))
@@ -132,9 +112,7 @@ def topology_spread_score(
 
 
 def selector_spread_score(
-    cnt_match: jnp.ndarray,  # [T, D]
-    node_dom: jnp.ndarray,  # [K, N]
-    term_topo: jnp.ndarray,  # [T]
+    cnt_at: jnp.ndarray,  # [T, N] matching placed pods at each node's domain
     ss_host: jnp.ndarray,  # [T] hostname-key counting terms of the pod
     ss_zone: jnp.ndarray,  # [T] zone-key counting terms
     mask: jnp.ndarray,  # [N]
@@ -142,8 +120,8 @@ def selector_spread_score(
     """SelectorSpread score (`plugins/selectorspread/selector_spread.go`):
     spread pods of the same service/controller across nodes, then zones with
     zoneWeighting=2/3 when zones exist."""
-    cnt_host = _counts_at_nodes(cnt_match, node_dom, term_topo, ss_host.astype(jnp.float32))
-    cnt_zone = _counts_at_nodes(cnt_match, node_dom, term_topo, ss_zone.astype(jnp.float32))
+    cnt_host = ss_host.astype(jnp.float32) @ cnt_at
+    cnt_zone = ss_zone.astype(jnp.float32) @ cnt_at
     max_host = jnp.max(jnp.where(mask, cnt_host, 0.0))
     max_zone = jnp.max(jnp.where(mask, cnt_zone, 0.0))
     node_score = jnp.where(
@@ -164,12 +142,10 @@ def selector_spread_score(
 
 
 def interpod_score(
-    cnt_match: jnp.ndarray,  # [T, D]
-    own_aff_req: jnp.ndarray,  # [T, D] placed owners of required affinity terms
-    w_own_aff_pref: jnp.ndarray,  # [T, D] summed weights of placed owners
-    w_own_anti_pref: jnp.ndarray,  # [T, D]
-    node_dom: jnp.ndarray,  # [K, N]
-    term_topo: jnp.ndarray,  # [T]
+    cnt_at: jnp.ndarray,  # [T, N] matching placed pods at each node's domain
+    own_aff_at: jnp.ndarray,  # [T, N] placed owners of required affinity terms
+    w_own_aff_at: jnp.ndarray,  # [T, N] summed preferred-affinity owner weights
+    w_own_anti_at: jnp.ndarray,  # [T, N]
     s_match: jnp.ndarray,  # [T] incoming pod matches term
     w_aff_pref: jnp.ndarray,  # [T] incoming pod's preferred affinity weights
     w_anti_pref: jnp.ndarray,  # [T]
@@ -181,23 +157,11 @@ def interpod_score(
       preferred (anti-)affinity terms, and symmetrically
     + placed pods' preferred terms (and required affinity terms, scaled by
       HardPodAffinityWeight=1) that select the incoming pod.
+    The [T, N] inputs are the engine's per-node count state (SchedState).
     Raw, un-normalized; caller applies maxabs_normalize.
     """
-    t_count = cnt_match.shape[0]
-    if t_count == 0:
-        return jnp.zeros(node_dom.shape[-1] if node_dom.ndim else 0, jnp.float32)
-    dom_tn = node_dom[term_topo]  # [T, N]
-    valid = dom_tn >= 0
-    safe = jnp.where(valid, dom_tn, 0)
-    t_idx = jnp.arange(t_count)[:, None]
-
-    def at(counts):
-        return jnp.where(valid, counts[t_idx, safe], 0.0)
-
-    incoming = (w_aff_pref - w_anti_pref)[:, None] * at(cnt_match)
-    symmetric = s_match[:, None] * (
-        at(w_own_aff_pref)
-        - at(w_own_anti_pref)
-        + hard_pod_affinity_weight * at(own_aff_req)
+    incoming = (w_aff_pref - w_anti_pref) @ cnt_at
+    symmetric = s_match.astype(jnp.float32) @ (
+        w_own_aff_at - w_own_anti_at + hard_pod_affinity_weight * own_aff_at
     )
-    return jnp.sum(incoming + symmetric, axis=0)
+    return incoming + symmetric

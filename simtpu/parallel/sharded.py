@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..engine.scan import Engine, SchedState, StaticArrays, schedule_step
+from ..engine.scan import Engine, SchedState, StaticArrays, StepFlags, schedule_step
 from .mesh import NODE_AXIS, node_shard_count
 
 
@@ -50,7 +50,7 @@ def pad_statics(statics: StaticArrays, multiple: int) -> Tuple[StaticArrays, int
             node_pref=_pad_axis(statics.node_pref, 1, pad, 0.0),
             taint_intol=_pad_axis(statics.taint_intol, 1, pad, 0.0),
             static_score=_pad_axis(statics.static_score, 1, pad, 0.0),
-            node_dom=_pad_axis(statics.node_dom, 1, pad, -1),
+            dom_tn=_pad_axis(statics.dom_tn, 1, pad, -1),
             has_storage=_pad_axis(statics.has_storage, 0, pad, False),
             vg_cap=_pad_axis(statics.vg_cap, 0, pad, 0.0),
             vg_name_id=_pad_axis(statics.vg_name_id, 0, pad, -1),
@@ -70,6 +70,11 @@ def pad_state(state: SchedState, pad: int) -> SchedState:
         return state
     return state._replace(
         free=_pad_axis(state.free, 0, pad, 0.0),
+        cnt_match=_pad_axis(state.cnt_match, 1, pad, 0.0),
+        cnt_own_anti=_pad_axis(state.cnt_own_anti, 1, pad, 0.0),
+        cnt_own_aff=_pad_axis(state.cnt_own_aff, 1, pad, 0.0),
+        w_own_aff_pref=_pad_axis(state.w_own_aff_pref, 1, pad, 0.0),
+        w_own_anti_pref=_pad_axis(state.w_own_anti_pref, 1, pad, 0.0),
         vg_free=_pad_axis(state.vg_free, 0, pad, 0.0),
         sdev_free=_pad_axis(state.sdev_free, 0, pad, False),
         gpu_free=_pad_axis(state.gpu_free, 0, pad, 0.0),
@@ -92,8 +97,7 @@ def statics_sharding(mesh: Mesh) -> StaticArrays:
         node_pref=trail,
         taint_intol=trail,
         static_score=trail,
-        node_dom=trail,
-        term_topo=rep,
+        dom_tn=trail,
         s_match=rep,
         a_aff_req=rep,
         a_anti_req=rep,
@@ -122,14 +126,16 @@ def statics_sharding(mesh: Mesh) -> StaticArrays:
 
 def state_sharding(mesh: Mesh) -> SchedState:
     lead2 = NamedSharding(mesh, P(NODE_AXIS, None))
+    trail = NamedSharding(mesh, P(None, NODE_AXIS))  # [T, N] per-node counts
     rep = NamedSharding(mesh, P())
     return SchedState(
         free=lead2,
-        cnt_match=rep,
-        cnt_own_anti=rep,
-        cnt_own_aff=rep,
-        w_own_aff_pref=rep,
-        w_own_anti_pref=rep,
+        cnt_match=trail,
+        cnt_total=rep,
+        cnt_own_anti=trail,
+        cnt_own_aff=trail,
+        w_own_aff_pref=trail,
+        w_own_anti_pref=trail,
         vg_free=lead2,
         sdev_free=lead2,
         gpu_free=lead2,
@@ -139,16 +145,16 @@ def state_sharding(mesh: Mesh) -> SchedState:
     )
 
 
-def _scan_fn(statics, state, pods):
-    return jax.lax.scan(partial(schedule_step, statics), state, pods)
-
-
-def build_sharded_scan(mesh: Mesh):
+def build_sharded_scan(mesh: Mesh, flags: StepFlags = StepFlags()):
     """Compile the placement scan with the node axis laid out over `mesh`."""
     st_spec = statics_sharding(mesh)
     state_spec = state_sharding(mesh)
     rep = NamedSharding(mesh, P())
     pods_rep = None  # resolved at call time: every per-pod array is replicated
+
+    def _scan_fn(statics, state, pods):
+        return jax.lax.scan(partial(schedule_step, statics, flags=flags), state, pods)
+
     return jax.jit(
         _scan_fn,
         in_shardings=(st_spec, state_spec, pods_rep),
@@ -167,14 +173,17 @@ class ShardedEngine(Engine):
     def __init__(self, tensorizer, mesh: Mesh):
         super().__init__(tensorizer)
         self.mesh = mesh
-        self._sharded_scan = build_sharded_scan(mesh)
+        self._scans = {}  # StepFlags → compiled sharded scan
         self._shards = node_shard_count(mesh)
 
-    def _dispatch(self, statics: StaticArrays, state: SchedState, pods):
+    def _dispatch(self, statics: StaticArrays, state: SchedState, pods, flags: StepFlags):
+        scan = self._scans.get(flags)
+        if scan is None:
+            scan = self._scans[flags] = build_sharded_scan(self.mesh, flags)
         statics, pad = pad_statics(statics, self._shards)
         state = pad_state(state, pad)
         statics = jax.device_put(statics, statics_sharding(self.mesh))
         state = jax.device_put(state, state_sharding(self.mesh))
         pods = jax.device_put(pods, NamedSharding(self.mesh, P()))
-        final_state, out = self._sharded_scan(statics, state, pods)
+        final_state, out = scan(statics, state, pods)
         return final_state, out

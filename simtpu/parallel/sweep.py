@@ -30,7 +30,9 @@ from ..core.objects import AppResource, ResourceTypes, set_label
 from ..core.tensorize import Tensorizer
 from ..engine.scan import (
     StaticArrays,
+    StepFlags,
     build_pod_arrays,
+    flags_from,
     schedule_step,
     statics_from,
 )
@@ -43,17 +45,19 @@ from .mesh import NODE_AXIS, SWEEP_AXIS
 from .sharded import pad_state, pad_statics, state_sharding, statics_sharding
 
 
-def _scan(statics, state, pods):
-    return jax.lax.scan(partial(schedule_step, statics), state, pods)
-
-
-@partial(jax.jit, static_argnums=())
-def _sweep_scan(statics: StaticArrays, valid_s: jnp.ndarray, state, pods):
+@partial(jax.jit, static_argnums=(4,))
+def _sweep_scan(
+    statics: StaticArrays,
+    valid_s: jnp.ndarray,
+    state,
+    pods,
+    flags: StepFlags = StepFlags(),
+):
     """vmap the scan over the candidate axis; only node_valid varies."""
 
     def one(valid):
         st = statics._replace(node_valid=statics.node_valid & valid)
-        return _scan(st, state, pods)
+        return jax.lax.scan(partial(schedule_step, st, flags=flags), state, pods)
 
     return jax.vmap(one)(valid_s)
 
@@ -148,7 +152,9 @@ def sweep_feasibility(
     else:
         valid_arr = jnp.asarray(valid_s)
 
-    _, outs = _sweep_scan(statics, valid_arr, state, pods_arrays)
+    _, outs = _sweep_scan(
+        statics, valid_arr, state, pods_arrays, flags_from(tensors, batch.ext)
+    )
     nodes_sp = np.asarray(outs[0])[:n_cand]  # [S, P] chosen node (-1 = failed)
 
     # per-candidate failure count, ignoring pods that only exist on clones
