@@ -465,13 +465,23 @@ def simulate(
     extended_resources: Sequence[str] = (),
     engine_factory=None,
     use_greed: bool = False,
+    bulk: bool = False,
 ) -> SimulateResult:
     """One-shot simulation (`pkg/simulator/core.go:64-103`): expand cluster
     workloads, run the cluster, then schedule each app in configured order.
     Unscheduled pods accumulate across the cluster and every app; node status
     reflects the final cluster. Pass
     `engine_factory=lambda t: ShardedEngine(t, mesh)` to run the scan with the
-    node axis sharded over a device mesh (simtpu/parallel)."""
+    node axis sharded over a device mesh (simtpu/parallel), or `bulk=True`
+    to place same-spec pod runs in bulk rounds (engine/rounds.py —
+    feasibility-exact, tie-breaking may differ from the serial scan). The two
+    are mutually exclusive."""
+    if bulk:
+        if engine_factory is not None:
+            raise ValueError("bulk=True and engine_factory are mutually exclusive")
+        from .engine.rounds import RoundsEngine
+
+        engine_factory = RoundsEngine
     sim = Simulator(
         extra_resources=extended_resources,
         engine_factory=engine_factory,

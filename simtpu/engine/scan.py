@@ -90,15 +90,22 @@ class StaticArrays(NamedTuple):
     taint_intol: jnp.ndarray  # [G, N]
     static_score: jnp.ndarray  # [G, N] ImageLocality + NodePreferAvoidPods (pre-weighted)
     dom_tn: jnp.ndarray  # [T, N] node n's domain for term t's topo key (-1 absent)
-    s_match: jnp.ndarray  # [G, T]
-    a_aff_req: jnp.ndarray  # [G, T]
-    a_anti_req: jnp.ndarray  # [G, T]
-    w_aff_pref: jnp.ndarray  # [G, T]
-    w_anti_pref: jnp.ndarray  # [G, T]
-    spread_hard: jnp.ndarray  # [G, T] maxSkew (0 = inactive)
-    spread_soft: jnp.ndarray  # [G, T] ScheduleAnyway multiplicity
-    ss_host: jnp.ndarray  # [G, T] SelectorSpread hostname terms
-    ss_zone: jnp.ndarray  # [G, T] SelectorSpread zone terms
+    # Term incidence is compacted per group: g_terms[g] lists the <= Tc term
+    # indices relevant to group g (-1 pad), and every [G, Tc] matrix below is
+    # aligned to those columns. The scan step row-gathers just those rows
+    # from the [T, N] count state, so per-pod topology work is O(Tc x N)
+    # instead of O(T x N) — T grows with the number of workloads
+    # (SelectorSpread interns terms per controller), Tc stays small.
+    g_terms: jnp.ndarray  # [G, Tc] relevant term indices (-1 pad)
+    s_match: jnp.ndarray  # [G, Tc]
+    a_aff_req: jnp.ndarray  # [G, Tc]
+    a_anti_req: jnp.ndarray  # [G, Tc]
+    w_aff_pref: jnp.ndarray  # [G, Tc]
+    w_anti_pref: jnp.ndarray  # [G, Tc]
+    spread_hard: jnp.ndarray  # [G, Tc] maxSkew (0 = inactive)
+    spread_soft: jnp.ndarray  # [G, Tc] ScheduleAnyway multiplicity
+    ss_host: jnp.ndarray  # [G, Tc] SelectorSpread hostname terms
+    ss_zone: jnp.ndarray  # [G, Tc] SelectorSpread zone terms
     ports_req: jnp.ndarray  # [G, P] host-port request incidence
     vol_rw_req: jnp.ndarray  # [G, W] exclusive volume read-write incidence
     vol_ro_req: jnp.ndarray  # [G, W] exclusive volume read-only incidence
@@ -147,8 +154,39 @@ def build_pod_arrays(batch: PodBatch, n_resources: int):
     return req, pods
 
 
+def _compact_terms(tensors: ClusterTensors):
+    """Per-group relevant-term compaction (see StaticArrays.g_terms)."""
+    g_n, t_n = tensors.s_match.shape
+    relevant = (
+        tensors.s_match
+        | tensors.a_aff_req
+        | tensors.a_anti_req
+        | (tensors.w_aff_pref != 0)
+        | (tensors.w_anti_pref != 0)
+        | (tensors.spread_hard > 0)
+        | (tensors.spread_soft > 0)
+        | tensors.ss_host
+        | tensors.ss_zone
+    )
+    per_g = [np.flatnonzero(row) for row in relevant]
+    t_cap = max((len(ids) for ids in per_g), default=0)
+    t_cap = 1 << max(t_cap - 1, 0).bit_length() if t_cap else 0
+    g_terms = np.full((g_n, t_cap), -1, np.int32)
+    for gi, ids in enumerate(per_g):
+        g_terms[gi, : len(ids)] = ids
+
+    def compact(mat, dtype=None):
+        out = np.zeros((g_n, t_cap), mat.dtype if dtype is None else dtype)
+        for gi, ids in enumerate(per_g):
+            out[gi, : len(ids)] = mat[gi, ids]
+        return out
+
+    return g_terms, compact
+
+
 def statics_from(tensors: ClusterTensors) -> StaticArrays:
     ext = tensors.ext
+    g_terms, compact = _compact_terms(tensors)
     return StaticArrays(
         alloc=jnp.asarray(tensors.alloc, jnp.float32),
         static_mask=jnp.asarray(tensors.static_mask),
@@ -159,15 +197,16 @@ def statics_from(tensors: ClusterTensors) -> StaticArrays:
         # the per-term domain gather node_dom[term_topo] is hoisted out of the
         # scan body: it is the single most-reused index structure of the step
         dom_tn=jnp.asarray(tensors.dom_tn(), jnp.int32),
-        s_match=jnp.asarray(tensors.s_match),
-        a_aff_req=jnp.asarray(tensors.a_aff_req),
-        a_anti_req=jnp.asarray(tensors.a_anti_req),
-        w_aff_pref=jnp.asarray(tensors.w_aff_pref),
-        w_anti_pref=jnp.asarray(tensors.w_anti_pref),
-        spread_hard=jnp.asarray(tensors.spread_hard, jnp.float32),
-        spread_soft=jnp.asarray(tensors.spread_soft, jnp.float32),
-        ss_host=jnp.asarray(tensors.ss_host),
-        ss_zone=jnp.asarray(tensors.ss_zone),
+        g_terms=jnp.asarray(g_terms),
+        s_match=jnp.asarray(compact(tensors.s_match)),
+        a_aff_req=jnp.asarray(compact(tensors.a_aff_req)),
+        a_anti_req=jnp.asarray(compact(tensors.a_anti_req)),
+        w_aff_pref=jnp.asarray(compact(tensors.w_aff_pref)),
+        w_anti_pref=jnp.asarray(compact(tensors.w_anti_pref)),
+        spread_hard=jnp.asarray(compact(tensors.spread_hard)),
+        spread_soft=jnp.asarray(compact(tensors.spread_soft)),
+        ss_host=jnp.asarray(compact(tensors.ss_host)),
+        ss_zone=jnp.asarray(compact(tensors.ss_zone)),
         ports_req=jnp.asarray(tensors.ports),
         vol_rw_req=jnp.asarray(tensors.vol_rw),
         vol_ro_req=jnp.asarray(tensors.vol_ro),
@@ -244,10 +283,51 @@ def flags_from(tensors: ClusterTensors, batch_ext: dict) -> StepFlags:
     )
 
 
-def schedule_step(
+class StepEval(NamedTuple):
+    """Everything one scheduling cycle derives before choosing a node:
+    the mask cascade, the combined score, and the extended-resource plans.
+    Shared by the serial scan (`schedule_step`) and the bulk rounds engine
+    (`engine/rounds.py`), which evaluates it at round boundaries."""
+
+    m_static: jnp.ndarray  # [N]
+    m_ports: jnp.ndarray
+    m_res: jnp.ndarray
+    m_vol: jnp.ndarray
+    m_att: jnp.ndarray
+    m_bind: jnp.ndarray
+    m_storage: jnp.ndarray
+    m_gpu: jnp.ndarray
+    m_spread: jnp.ndarray
+    m_all: jnp.ndarray
+    score: jnp.ndarray  # [N], -inf outside m_all
+    lvm_alloc: jnp.ndarray  # [N, V]
+    dev_take: jnp.ndarray  # [N, SD]
+    gpu_shares: jnp.ndarray  # [N, GD]
+
+    def fail_code(self) -> jnp.ndarray:
+        """First mask stage that emptied the candidate set (the scheduler's
+        '0/N nodes are available: <first failing filter>' status)."""
+        cascade = (
+            (self.m_static, FAIL_STATIC),
+            (self.m_ports, FAIL_PORTS),
+            (self.m_res, FAIL_RESOURCES),
+            (self.m_vol, FAIL_VOLUME),
+            (self.m_att, FAIL_ATTACH),
+            (self.m_bind, FAIL_VOLUME_BIND),
+            (self.m_storage, FAIL_STORAGE),
+            (self.m_gpu, FAIL_GPU),
+            (self.m_spread, FAIL_SPREAD),
+        )
+        fail = jnp.int32(FAIL_INTERPOD)
+        for mask, code in reversed(cascade):
+            fail = jnp.where(jnp.any(mask), fail, code)
+        return fail
+
+
+def filter_and_score(
     statics: StaticArrays, state: SchedState, pod, flags: StepFlags = StepFlags()
-) -> Tuple[SchedState, Tuple[jnp.ndarray, jnp.ndarray]]:
-    """One scheduling cycle for one pod against every node."""
+) -> StepEval:
+    """Run the full filter cascade and score sum for one pod vs every node."""
     (
         g,
         req,
@@ -263,13 +343,18 @@ def schedule_step(
     ) = pod
     n = statics.alloc.shape[0]
     node_ids = jnp.arange(n)
-    t_count = statics.dom_tn.shape[0]
+    t_cap = statics.g_terms.shape[1]
     f = flags
 
-    # state.cnt_* are already per-node ([T, N], SchedState) — the topology
-    # kernels read them directly; only the key-presence mask is derived here
-    if t_count:
-        valid_tn = statics.dom_tn >= 0
+    # row-gather the group's relevant slice of the per-node count state and
+    # domain map ([Tc, N] each — contiguous-row gathers, cheap on TPU)
+    if t_cap:
+        terms_g = statics.g_terms[g]  # [Tc]
+        tvalid = terms_g >= 0
+        tsafe = jnp.clip(terms_g, 0)
+        dom_sub = statics.dom_tn[tsafe]
+        valid_sub = (dom_sub >= 0) & tvalid[:, None]
+        cnt_sub = jnp.where(tvalid[:, None], state.cnt_match[tsafe], 0.0)
 
     static_m = statics.static_mask[g]
     # pin: -1 = unpinned, -2 = pinned to a nonexistent node (matches nothing)
@@ -340,18 +425,18 @@ def schedule_step(
     # PodTopologySpread hard constraints (filtering.go); eligible-domain
     # minimum taken over nodes passing the pod's static filters
     m_spread = m_gpu
-    if f.spread_hard and t_count:
+    if f.spread_hard and t_cap:
         m_spread = m_gpu & topology_spread_filter(
-            state.cnt_match, valid_tn, statics.spread_hard[g], m_static
+            cnt_sub, valid_sub, statics.spread_hard[g], m_static
         )
 
     m_all = m_spread
-    if f.interpod_req and t_count:
+    if f.interpod_req and t_cap:
         m_all = m_spread & interpod_filter(
-            state.cnt_match,
-            state.cnt_own_anti,
-            valid_tn,
-            state.cnt_total,
+            cnt_sub,
+            jnp.where(tvalid[:, None], state.cnt_own_anti[tsafe], 0.0),
+            valid_sub,
+            jnp.where(tvalid, state.cnt_total[tsafe], 0.0),
             statics.s_match[g],
             statics.a_aff_req[g],
             statics.a_anti_req[g],
@@ -371,26 +456,25 @@ def schedule_step(
         score += minmax_normalize(statics.node_pref[g], m_all)
     if f.taint_pref:
         score += taint_toleration_score(statics.taint_intol[g], m_all)
-    if (f.interpod_pref or f.interpod_req) and t_count:
+    if (f.interpod_pref or f.interpod_req) and t_cap:
+        tmask = tvalid[:, None]
         raw_ipa = interpod_score(
-            state.cnt_match,
-            state.cnt_own_aff,
-            state.w_own_aff_pref,
-            state.w_own_anti_pref,
+            cnt_sub,
+            jnp.where(tmask, state.cnt_own_aff[tsafe], 0.0),
+            jnp.where(tmask, state.w_own_aff_pref[tsafe], 0.0),
+            jnp.where(tmask, state.w_own_anti_pref[tsafe], 0.0),
             statics.s_match[g],
             statics.w_aff_pref[g],
             statics.w_anti_pref[g],
         )
         score += maxabs_normalize(raw_ipa, m_all)
     # PodTopologySpread soft constraints, registry weight 2
-    if f.spread_soft and t_count:
-        score += 2.0 * topology_spread_score(
-            state.cnt_match, statics.spread_soft[g], m_all
-        )
+    if f.spread_soft and t_cap:
+        score += 2.0 * topology_spread_score(cnt_sub, statics.spread_soft[g], m_all)
     # SelectorSpread (default workload/service spreading, weight 1)
-    if f.selector_spread and t_count:
+    if f.selector_spread and t_cap:
         score += selector_spread_score(
-            state.cnt_match, statics.ss_host[g], statics.ss_zone[g], m_all
+            cnt_sub, statics.ss_host[g], statics.ss_zone[g], m_all
         )
     # ImageLocality + NodePreferAvoidPods (static, pre-weighted)
     if f.static_score:
@@ -409,7 +493,48 @@ def schedule_step(
         )
     score = jnp.where(m_all, score, -jnp.inf)
 
-    chosen = jnp.where(forced, pin, jnp.argmax(score).astype(jnp.int32))
+    return StepEval(
+        m_static=m_static,
+        m_ports=m_ports,
+        m_res=m_res,
+        m_vol=m_vol,
+        m_att=m_att,
+        m_bind=m_bind,
+        m_storage=m_storage,
+        m_gpu=m_gpu,
+        m_spread=m_spread,
+        m_all=m_all,
+        score=score,
+        lvm_alloc=lvm_alloc,
+        dev_take=dev_take,
+        gpu_shares=gpu_shares,
+    )
+
+
+def schedule_step(
+    statics: StaticArrays, state: SchedState, pod, flags: StepFlags = StepFlags()
+) -> Tuple[SchedState, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """One scheduling cycle for one pod against every node."""
+    (
+        g,
+        req,
+        pin,
+        forced,
+        lvm_size,
+        lvm_vg,
+        dev_size,
+        dev_media,
+        gpu_mem,
+        gpu_count,
+        gpu_preset,
+    ) = pod
+    f = flags
+    t_cap = statics.g_terms.shape[1]
+    ev = filter_and_score(statics, state, pod, flags)
+    lvm_alloc, dev_take, gpu_shares = ev.lvm_alloc, ev.dev_take, ev.gpu_shares
+    feasible = jnp.any(ev.m_all)
+
+    chosen = jnp.where(forced, pin, jnp.argmax(ev.score).astype(jnp.int32))
     # forced pods must still land on a node of THIS candidate cluster: the
     # batched sweep expands DaemonSet pods for every clone node, and a clone
     # outside the candidate must not absorb state updates (topology counts,
@@ -417,24 +542,8 @@ def schedule_step(
     placed = jnp.where(
         forced, (pin >= 0) & statics.node_valid[jnp.clip(pin, 0)], feasible
     )
-    # first mask stage that emptied the candidate set names the failure (the
-    # scheduler's "0/N nodes are available: <first failing filter>" status)
-    cascade = (
-        (m_static, FAIL_STATIC),
-        (m_ports, FAIL_PORTS),
-        (m_res, FAIL_RESOURCES),
-        (m_vol, FAIL_VOLUME),
-        (m_att, FAIL_ATTACH),
-        (m_bind, FAIL_VOLUME_BIND),
-        (m_storage, FAIL_STORAGE),
-        (m_gpu, FAIL_GPU),
-        (m_spread, FAIL_SPREAD),
-    )
-    fail = jnp.int32(FAIL_INTERPOD)
-    for mask, code in reversed(cascade):
-        fail = jnp.where(jnp.any(mask), fail, code)
     reason = jnp.where(
-        placed, OK, jnp.where(forced, FAIL_NO_NODE, fail)
+        placed, OK, jnp.where(forced, FAIL_NO_NODE, ev.fail_code())
     ).astype(jnp.int32)
 
     # -- state update (no-op when not placed) -----------------------------
@@ -464,25 +573,30 @@ def schedule_step(
     pod_dev_take = dev_take[safe] & placed
     pod_gpu_shares = gpu_shares[safe] * w
 
-    if t_count:
-        # same-domain increment: every node sharing the chosen node's domain
-        # for term t gains the pod's incidence — a streaming [T, N] compare,
-        # no scatter (see SchedState)
-        dom_chosen = statics.dom_tn[:, safe]  # [T]
-        valid_chosen = (dom_chosen >= 0) & placed  # [T]
+    if t_cap:
+        # same-domain increment on the group's relevant term rows only:
+        # every node sharing the chosen node's domain for term t gains the
+        # pod's incidence — a [Tc, N] compare + row scatter (see SchedState)
+        terms_g = statics.g_terms[g]
+        tvalid = terms_g >= 0
+        tsafe = jnp.clip(terms_g, 0)
+        dom_sub = statics.dom_tn[tsafe]  # [Tc, N]
+        valid_sub = (dom_sub >= 0) & tvalid[:, None]
+        dom_chosen = dom_sub[:, safe]  # [Tc]
+        valid_chosen = (dom_chosen >= 0) & tvalid & placed  # [Tc]
         same = (
-            valid_tn
-            & (statics.dom_tn == dom_chosen[:, None])
+            valid_sub
+            & (dom_sub == dom_chosen[:, None])
             & valid_chosen[:, None]
         )
-        inc = jnp.where(same, 1.0, 0.0)  # [T, N]
+        inc = jnp.where(same, 1.0, 0.0)  # [Tc, N]
 
         def bump(arr, vals):
-            return arr + vals[:, None] * inc
+            return arr.at[tsafe].add(vals[:, None] * inc)
 
         updates["cnt_match"] = bump(state.cnt_match, statics.s_match[g])
-        updates["cnt_total"] = state.cnt_total + statics.s_match[g] * jnp.where(
-            valid_chosen, 1.0, 0.0
+        updates["cnt_total"] = state.cnt_total.at[tsafe].add(
+            statics.s_match[g] * jnp.where(valid_chosen, 1.0, 0.0)
         )
         if f.interpod_req:
             updates["cnt_own_anti"] = bump(state.cnt_own_anti, statics.a_anti_req[g])
@@ -542,6 +656,10 @@ class Engine:
         (LVM per-VG bytes, device take mask, GPU device shares).
         """
         tensors = self.tensorizer.freeze()
+        # batch context for _dispatch overrides (RoundsEngine segments pods
+        # by group/spec and needs the frozen tensors without re-freezing)
+        self._current_batch = batch
+        self._current_tensors = tensors
         r = tensors.alloc.shape[1]
         req, pods = build_pod_arrays(batch, r)
         state = build_state(
@@ -567,16 +685,15 @@ class Engine:
         lvm_alloc = np.asarray(lvm_alloc)
         dev_take = np.asarray(dev_take)
         gpu_shares = np.asarray(gpu_shares)
-        for i in range(len(nodes)):
-            if nodes[i] >= 0:
-                self.placed_group.append(int(batch.group[i]))
-                self.placed_node.append(int(nodes[i]))
-                self.placed_req.append(req[i])
-                self.ext_log["node"].append(int(nodes[i]))
-                self.ext_log["vg_alloc"].append(lvm_alloc[i])
-                self.ext_log["sdev_take"].append(dev_take[i])
-                self.ext_log["gpu_shares"].append(gpu_shares[i])
-                self.ext_log["gpu_mem"].append(float(ext["gpu_mem"][i]))
+        ok = np.flatnonzero(nodes >= 0)
+        self.placed_group.extend(np.asarray(batch.group)[ok].tolist())
+        self.placed_node.extend(nodes[ok].tolist())
+        self.placed_req.extend(req[ok])
+        self.ext_log["node"].extend(nodes[ok].tolist())
+        self.ext_log["vg_alloc"].extend(lvm_alloc[ok])
+        self.ext_log["sdev_take"].extend(dev_take[ok])
+        self.ext_log["gpu_shares"].extend(gpu_shares[ok])
+        self.ext_log["gpu_mem"].extend(np.asarray(ext["gpu_mem"])[ok].tolist())
         return nodes, reasons, {
             "lvm_alloc": lvm_alloc,
             "dev_take": dev_take,

@@ -98,6 +98,7 @@ def statics_sharding(mesh: Mesh) -> StaticArrays:
         taint_intol=trail,
         static_score=trail,
         dom_tn=trail,
+        g_terms=rep,
         s_match=rep,
         a_aff_req=rep,
         a_anti_req=rep,

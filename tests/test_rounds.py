@@ -1,0 +1,165 @@
+"""Tests for the bulk rounds engine (`simtpu/engine/rounds.py`), verified
+against the serial scan (SURVEY.md §2.3: "greedy parallel rounds ...
+verified against scan"): identical feasibility outcomes, zero constraint
+violations in the final state, and serial fallback for interacting pods.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import simtpu.constants as C
+from simtpu.api import simulate
+from simtpu.core.objects import AppResource, ResourceTypes, set_label
+from simtpu.engine.rounds import RoundsEngine
+from simtpu.synth import synth_apps, synth_cluster
+
+from .fixtures import (
+    make_fake_deployment,
+    make_fake_node,
+    make_fake_pod,
+    with_template_affinity,
+)
+
+
+def _placements(result):
+    out = {}
+    for status in result.node_status:
+        for pod in status.pods:
+            out[pod["metadata"]["name"]] = status.node["metadata"]["name"]
+    return out
+
+
+def _per_node_counts(result):
+    return {
+        s.node["metadata"]["name"]: len(s.pods) for s in result.node_status
+    }
+
+
+class TestBulkEquivalence:
+    def test_all_placed_matches_scan(self):
+        cluster = synth_cluster(40, seed=11, zones=4, taint_frac=0.1)
+        apps = synth_apps(
+            300,
+            seed=12,
+            zones=4,
+            pods_per_deployment=50,
+            selector_frac=0.2,
+            toleration_frac=0.1,
+            anti_affinity_frac=0.0,
+        )
+        serial = simulate(cluster, apps)
+        bulk = simulate(cluster, apps, bulk=True)
+        assert len(serial.unscheduled_pods) == len(bulk.unscheduled_pods) == 0
+        assert sum(len(s.pods) for s in serial.node_status) == sum(
+            len(s.pods) for s in bulk.node_status
+        )
+
+    def test_capacity_exhaustion_matches_scan(self):
+        # 4 nodes x 8 pod slots; 50 requested -> exactly 18 unscheduled on
+        # both engines, with a resource failure reason
+        nodes = [make_fake_node(f"n{i}", "8", "16Gi") for i in range(4)]
+        dep = make_fake_deployment("big", "default", 50, "1", "2Gi")
+        cluster = ResourceTypes(nodes=nodes)
+        apps = [AppResource(name="a", resource=ResourceTypes(deployments=[dep]))]
+        serial = simulate(cluster, apps)
+        bulk = simulate(cluster, apps, bulk=True)
+        assert len(serial.unscheduled_pods) == len(bulk.unscheduled_pods) == 18
+        assert "resources" in bulk.unscheduled_pods[0].reason
+
+    def test_no_overcommit(self):
+        cluster = synth_cluster(16, seed=3, zones=2)
+        apps = synth_apps(400, seed=4, zones=2, pods_per_deployment=100)
+        bulk = simulate(cluster, apps, bulk=True)
+        from simtpu.core.quantity import parse_quantity
+
+        for status in bulk.node_status:
+            cpu = parse_quantity(status.node["status"]["allocatable"]["cpu"])
+            used = 0.0
+            for pod in status.pods:
+                for c in pod["spec"]["containers"]:
+                    used += parse_quantity(
+                        ((c.get("resources") or {}).get("requests") or {}).get(
+                            "cpu", 0
+                        )
+                    )
+            assert used <= cpu + 1e-6
+
+    def test_spreading_quality_preserved(self):
+        # 100 identical 1-cpu pods over 10 idle 32-cpu nodes: the
+        # least-allocated slope must distribute them evenly, like serial
+        nodes = [make_fake_node(f"n{i}", "32", "64Gi") for i in range(10)]
+        dep = make_fake_deployment("spread", "default", 100, "1", "1Gi")
+        cluster = ResourceTypes(nodes=nodes)
+        apps = [AppResource(name="a", resource=ResourceTypes(deployments=[dep]))]
+        bulk = simulate(cluster, apps, bulk=True)
+        counts = _per_node_counts(bulk)
+        assert sum(counts.values()) == 100
+        assert max(counts.values()) == min(counts.values()) == 10
+
+    def test_anti_affinity_groups_fall_back_to_scan(self):
+        # required anti-affinity on own labels -> serial path; at most one
+        # pod per hostname domain
+        nodes = [make_fake_node(f"n{i}", "32", "64Gi") for i in range(12)]
+        dep = make_fake_deployment(
+            "anti",
+            "default",
+            12,
+            "1",
+            "1Gi",
+            with_template_affinity(
+                {
+                    "podAntiAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {
+                                "labelSelector": {
+                                    "matchLabels": {"simtpu-app": "anti"}
+                                },
+                                "topologyKey": C.LABEL_HOSTNAME,
+                            }
+                        ]
+                    }
+                }
+            ),
+        )
+        cluster = ResourceTypes(nodes=nodes)
+        apps = [AppResource(name="a", resource=ResourceTypes(deployments=[dep]))]
+        bulk = simulate(cluster, apps, bulk=True)
+        counts = _per_node_counts(bulk)
+        assert not bulk.unscheduled_pods
+        assert max(counts.values()) == 1 and sum(counts.values()) == 12
+
+    def test_host_port_run_capped_at_one_per_node(self):
+        nodes = [make_fake_node(f"n{i}", "32", "64Gi") for i in range(3)]
+        dep = make_fake_deployment("ported", "default", 10, "1", "1Gi")
+        dep["spec"]["template"]["spec"]["containers"][0]["ports"] = [
+            {"containerPort": 8080, "hostPort": 8080, "protocol": "TCP"}
+        ]
+        cluster = ResourceTypes(nodes=nodes)
+        apps = [AppResource(name="a", resource=ResourceTypes(deployments=[dep]))]
+        for flag in (False, True):
+            result = simulate(cluster, apps, bulk=flag)
+            counts = _per_node_counts(result)
+            assert max(counts.values(), default=0) == 1
+            assert len(result.unscheduled_pods) == 7
+
+    def test_mixed_batch_segments_interleave_correctly(self):
+        # bare pod + big deployment + bare pod: segment order must respect
+        # submission order so the trailing pod sees the deployment's usage
+        nodes = [make_fake_node("n0", "10", "100Gi")]
+        dep = make_fake_deployment("filler", "default", 9, "1", "1Gi")
+        pre = make_fake_pod("pre", "default", "1", "1Gi")
+        cluster = ResourceTypes(nodes=nodes, pods=[pre])
+        apps = [
+            AppResource(name="a", resource=ResourceTypes(deployments=[dep])),
+            AppResource(
+                name="b",
+                resource=ResourceTypes(
+                    pods=[make_fake_pod("post", "default", "1", "1Gi")]
+                ),
+            ),
+        ]
+        bulk = simulate(cluster, apps, bulk=True)
+        # 10 cpu total: pre(1) + 9 filler = full; "post" must fail
+        assert len(bulk.unscheduled_pods) == 1
+        assert bulk.unscheduled_pods[0].pod["metadata"]["name"].startswith("post")
