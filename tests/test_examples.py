@@ -1,0 +1,61 @@
+"""Golden end-to-end runs over the examples/ corpus through the real CLI —
+the analog of the reference's `example/` acceptance fixtures (SURVEY.md §4).
+Each config must plan successfully and print the report tables.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from simtpu.cli import main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _chdir_repo(monkeypatch):
+    # config paths are relative to the repository root
+    monkeypatch.chdir(REPO)
+
+
+def test_demo_config_plans_successfully(capsys):
+    rc = main(["apply", "-f", "examples/simtpu-config.yaml"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Success!" in out
+    # report tables show the demo nodes
+    for node in ("ctrl-0", "worker-a-0", "worker-a-1", "worker-b-0"):
+        assert node in out
+
+
+def test_gpushare_config_plans_successfully(capsys):
+    rc = main(
+        ["apply", "-f", "examples/simtpu-gpushare-config.yaml", "-e", "gpu"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Success!" in out
+    assert "gpu-node-0" in out
+
+
+def test_storage_config_plans_successfully(capsys):
+    rc = main(
+        ["apply", "-f", "examples/simtpu-storage-config.yaml", "-e", "open-local"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Success!" in out
+
+
+def test_gen_doc(tmp_path, capsys):
+    rc = main(["gen-doc", "--output", str(tmp_path)])
+    assert rc == 0
+    doc = (tmp_path / "simtpu.md").read_text()
+    assert "apply" in doc and "gen-doc" in doc
+
+
+def test_version(capsys):
+    assert main(["version"]) == 0
+    assert "simtpu version" in capsys.readouterr().out
