@@ -58,6 +58,7 @@ def cmd_apply(args: argparse.Namespace) -> int:
         interactive=args.interactive,
         extended_resources=args.extended_resources or [],
         search=args.search,
+        bulk=args.bulk,
     )
     try:
         applier = Applier(opts)
@@ -79,6 +80,9 @@ def cmd_apply(args: argparse.Namespace) -> int:
         print(C.COLOR_GREEN, end="")
         print(report(plan.result.node_status, opts.extended_resources))
         print(C.COLOR_RESET, end="")
+        if plan.timings:
+            phases = "  ".join(f"{k}={v:.2f}s" for k, v in plan.timings.items())
+            print(f"phase timings: {phases}")
         return 0
     print(f"{C.COLOR_RED}{plan.message}{C.COLOR_RESET}")
     if plan.result is not None:
@@ -136,6 +140,12 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["binary", "linear"],
         default="binary",
         help="min-node-add search strategy (linear = reference-exact walk)",
+    )
+    apply_p.add_argument(
+        "--bulk",
+        action="store_true",
+        help="place replica runs with the bulk rounds engine (faster on "
+        "large app lists; tie-breaking may differ from the serial scan)",
     )
     apply_p.set_defaults(func=cmd_apply)
 

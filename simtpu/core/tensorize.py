@@ -961,6 +961,35 @@ class Tensorizer:
 
     # -- batches -----------------------------------------------------------
 
+    @staticmethod
+    def _pod_fingerprint(pod: dict):
+        """Identity-based fingerprint of everything `_group_of_pod`,
+        `pod_requests` and `pod_extended_demand` read.
+
+        Workload expansion clones replicas from one normalized prototype
+        (`workloads/expand.py` _clone_pod), so replicas *share* their nested
+        spec objects — id() equality over those plus the per-pod value fields
+        lets a batch of identical pods tensorize once. ids are stable for the
+        duration of the call (the pods list keeps everything alive).
+        """
+        spec = pod.get("spec") or {}
+        meta = pod.get("metadata") or {}
+        return (
+            id(spec.get("containers")),
+            id(spec.get("initContainers")),
+            id(spec.get("affinity")),
+            id(spec.get("tolerations")),
+            id(spec.get("nodeSelector")),
+            id(spec.get("topologySpreadConstraints")),
+            id(spec.get("volumes")),
+            id(spec.get("overhead")),
+            id(meta.get("ownerReferences")),
+            meta.get("namespace") or "",
+            spec.get("nodeName") or "",
+            tuple(sorted((meta.get("labels") or {}).items())),
+            tuple(sorted((meta.get("annotations") or {}).items())),
+        )
+
     def add_pods(self, pods: Sequence[dict]) -> PodBatch:
         """Intern a batch of pods, growing group/term vocabularies."""
         p = len(pods)
@@ -968,8 +997,16 @@ class Tensorizer:
         pin = np.full(p, -1, np.int32)
         forced = np.zeros(p, bool)
         reqs: List[Dict[str, float]] = []
-        demands = [pod_extended_demand(pod, self.catalog, self.vg_names) for pod in pods]
+        demands = []
+        cache = {}
         for i, pod in enumerate(pods):
+            fp = self._pod_fingerprint(pod)
+            hit = cache.get(fp)
+            if hit is not None:
+                group[i], pin[i], forced[i], r, demand = hit
+                reqs.append(r)
+                demands.append(demand)
+                continue
             g, pin_name = _group_of_pod(pod)
             group[i] = self._intern_group(g)
             node_name = pod_node_name(pod)
@@ -981,6 +1018,8 @@ class Tensorizer:
                 # everywhere (the NodeAffinity filter would match no node)
                 pin[i] = self.node_idx.get(pin_name, -2)
             reqs.append(pod_requests(pod))
+            demands.append(pod_extended_demand(pod, self.catalog, self.vg_names))
+            cache[fp] = (group[i], pin[i], forced[i], reqs[-1], demands[-1])
         self._refresh_s_match()
         req = np.zeros((p, len(self.resources)), np.float32)
         for i, r in enumerate(reqs):

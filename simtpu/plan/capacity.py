@@ -51,6 +51,10 @@ class PlanResult:
     message: str = ""
     # per-candidate-count unscheduled totals, for transparency
     probes: Dict[int, int] = field(default_factory=dict)
+    # per-phase wall-clock seconds (ingest, plan), the observability the
+    # reference lacks (SURVEY.md §5: vendored metrics exist but are never
+    # exported)
+    timings: Dict[str, float] = field(default_factory=dict)
 
 
 def new_fake_nodes(template: dict, count: int) -> List[dict]:
@@ -178,6 +182,7 @@ def plan_capacity(
     extended_resources: Sequence[str] = (),
     search: str = "binary",
     progress: Optional[Callable[[str], None]] = None,
+    bulk: bool = False,
 ) -> PlanResult:
     """Find the minimum clone count of `new_node` that deploys everything."""
     say = progress or (lambda s: None)
@@ -190,7 +195,7 @@ def plan_capacity(
         say(f"add {i} node(s)")
         trial = ResourceTypes(**{k: list(v) for k, v in vars(cluster).items()})
         trial.nodes = list(cluster.nodes) + new_fake_nodes(new_node, i)
-        result = simulate(trial, apps, extended_resources=extended_resources)
+        result = simulate(trial, apps, extended_resources=extended_resources, bulk=bulk)
         probes[i] = len(result.unscheduled_pods)
         return result
 
@@ -295,6 +300,7 @@ class ApplierOptions:
     interactive: bool = False
     extended_resources: Sequence[str] = ()
     search: str = "binary"
+    bulk: bool = False  # place replica runs with the bulk rounds engine
 
 
 class Applier:
@@ -337,17 +343,41 @@ class Applier:
         select_apps: Optional[Callable[[List[str]], List[str]]] = None,
         progress: Optional[Callable[[str], None]] = None,
     ) -> PlanResult:
+        import contextlib
+        import os
+        import time as _time
+
+        timings: Dict[str, float] = {}
+        t0 = _time.perf_counter()
         apps = self.load_apps()
         if select_apps is not None:
+            # human think-time must not count toward the ingest phase
+            timings["ingest"] = _time.perf_counter() - t0
             chosen = set(select_apps([a.name for a in apps]))
             apps = [a for a in apps if a.name in chosen]
+            t0 = _time.perf_counter()
         cluster = self.load_cluster()
         new_node = self.load_new_node()
-        return plan_capacity(
-            cluster,
-            apps,
-            new_node,
-            extended_resources=self.opts.extended_resources,
-            search=self.opts.search,
-            progress=progress,
-        )
+        timings["ingest"] = timings.get("ingest", 0.0) + _time.perf_counter() - t0
+
+        # SIMTPU_TRACE=<dir> captures a jax.profiler trace of the plan phase
+        trace_dir = os.environ.get("SIMTPU_TRACE", "")
+        ctx = contextlib.nullcontext()
+        if trace_dir:
+            import jax
+
+            ctx = jax.profiler.trace(trace_dir)
+        t0 = _time.perf_counter()
+        with ctx:
+            plan = plan_capacity(
+                cluster,
+                apps,
+                new_node,
+                extended_resources=self.opts.extended_resources,
+                search=self.opts.search,
+                progress=progress,
+                bulk=self.opts.bulk,
+            )
+        timings["plan"] = _time.perf_counter() - t0
+        plan.timings = timings
+        return plan

@@ -28,6 +28,8 @@ def test_demo_config_plans_successfully(capsys):
     # report tables show the demo nodes
     for node in ("ctrl-0", "worker-a-0", "worker-a-1", "worker-b-0"):
         assert node in out
+    # the chart-mode app rendered and scheduled (3 queue-broker pods)
+    assert out.count("queue-broker") >= 3
 
 
 def test_gpushare_config_plans_successfully(capsys):
