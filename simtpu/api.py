@@ -85,9 +85,11 @@ class Simulator:
         extra_resources: Sequence[str] = (),
         engine_factory=None,
         use_greed: bool = False,
+        sched_config=None,
     ):
         self._extra_resources = extra_resources
         self._use_greed = use_greed
+        self._sched_config = sched_config
         self._engine_factory = engine_factory or Engine
         self._tensorizer: Optional[Tensorizer] = None
         self._engine: Optional[Engine] = None
@@ -114,6 +116,7 @@ class Simulator:
             pvs=list(cluster.persistent_volumes),
         )
         self._engine = self._engine_factory(self._tensorizer)
+        self._engine.sched_config = self._sched_config
         self._schedule_pods(cluster.pods)
         return self._result()
 
@@ -466,6 +469,7 @@ def simulate(
     engine_factory=None,
     use_greed: bool = False,
     bulk: bool = False,
+    sched_config=None,
 ) -> SimulateResult:
     """One-shot simulation (`pkg/simulator/core.go:64-103`): expand cluster
     workloads, run the cluster, then schedule each app in configured order.
@@ -486,6 +490,7 @@ def simulate(
         extra_resources=extended_resources,
         engine_factory=engine_factory,
         use_greed=use_greed,
+        sched_config=sched_config,
     )
     cluster = ResourceTypes(**{k: list(v) for k, v in vars(cluster).items()})
     cluster_pods = get_valid_pods_exclude_daemonset(cluster)

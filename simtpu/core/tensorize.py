@@ -98,34 +98,37 @@ class NodeLabelIndex:
     def __init__(self, nodes: Sequence[dict]):
         self.n = len(nodes)
         self.names = np.array([name_of(n) for n in nodes])
-        self._kv: Dict[Tuple[str, str], np.ndarray] = {}
-        self._key: Dict[str, np.ndarray] = {}
+        # per key: an [N] int32 value-id array (-1 = key absent) plus the
+        # value→id map. Storage is O(keys x N) — a dense bool column per
+        # (key, value) pair would be O(N^2) through high-cardinality keys
+        # like kubernetes.io/hostname.
+        self._vid: Dict[str, np.ndarray] = {}
+        self._vmap: Dict[str, Dict[str, int]] = {}
         self._val: Dict[str, np.ndarray] = {}  # raw values per key (for Gt/Lt)
-        # plain-dict hits before allocating: dict.setdefault would build a
-        # fresh N-element array per *occurrence*, turning this O(N·labels)
-        # loop into the tensorization bottleneck on 10k+-node clusters
-        kv, key, val = self._kv, self._key, self._val
         for i, node in enumerate(nodes):
             for k, v in labels_of(node).items():
                 v = "" if v is None else str(v)
-                arr = kv.get((k, v))
-                if arr is None:
-                    arr = kv[(k, v)] = np.zeros(self.n, bool)
-                arr[i] = True
-                arr = key.get(k)
-                if arr is None:
-                    arr = key[k] = np.zeros(self.n, bool)
-                    val[k] = np.full(self.n, "", object)
-                arr[i] = True
-                val[k][i] = v
+                vid = self._vid.get(k)
+                if vid is None:
+                    vid = self._vid[k] = np.full(self.n, -1, np.int32)
+                    self._vmap[k] = {}
+                    self._val[k] = np.full(self.n, "", object)
+                vm = self._vmap[k]
+                j = vm.get(v)
+                if j is None:
+                    j = vm[v] = len(vm)
+                vid[i] = j
+                self._val[k][i] = v
 
     def has_kv(self, key: str, value: str) -> np.ndarray:
-        arr = self._kv.get((key, value))
-        return arr if arr is not None else np.zeros(self.n, bool)
+        vid = self._vid.get(key)
+        if vid is None:
+            return np.zeros(self.n, bool)
+        return vid == self._vmap[key].get(value, -2)
 
     def has_key(self, key: str) -> np.ndarray:
-        arr = self._key.get(key)
-        return arr if arr is not None else np.zeros(self.n, bool)
+        vid = self._vid.get(key)
+        return vid >= 0 if vid is not None else np.zeros(self.n, bool)
 
     def match_requirement(self, req: dict, field_names: Optional[np.ndarray] = None) -> np.ndarray:
         """Vectorized NodeSelectorRequirement over all nodes.
@@ -396,7 +399,7 @@ class ClusterTensors:
     static_mask: np.ndarray  # [G, N] bool — unschedulable+taints+affinity+selector
     node_pref_score: np.ndarray  # [G, N] f32 — NodeAffinity preferred raw score
     taint_intolerable: np.ndarray  # [G, N] f32 — count of intolerable PreferNoSchedule
-    static_score: np.ndarray  # [G, N] f32 — ImageLocality + NodePreferAvoidPods
+    static_score: np.ndarray  # [G, N] f32 — ImageLocality
 
     # inter-pod term axis
     terms: List[Term]
@@ -414,6 +417,8 @@ class ClusterTensors:
     # host-port axis (interned (protocol, hostPort) pairs)
     ports: np.ndarray = None  # [G, P] bool — group requests port p
     n_ports: int = 0
+
+    avoid_pen: np.ndarray = None  # [G, N] f32 — NodePreferAvoidPods penalty
 
     # shared volume-identity axis (VolumeRestrictions + NodeVolumeLimits)
     vol_mask: np.ndarray = None  # [G, N] bool — VolumeBinding+VolumeZone feasibility
@@ -520,6 +525,37 @@ class Tensorizer:
         for i, node in enumerate(self.nodes):
             if node_unschedulable(node):
                 self.taints[i] = self.taints[i] + [_UNSCHEDULABLE_TAINT]
+        # distinct-taint incidence: clusters carry few distinct taints, so
+        # per-group toleration checks run per *distinct taint* and fan out to
+        # nodes through these masks instead of a Python loop over N nodes
+        self._hard_taints: List[dict] = []  # NoSchedule / NoExecute
+        self._pref_taints: List[dict] = []  # PreferNoSchedule
+        hard_ids: Dict[str, int] = {}
+        pref_ids: Dict[str, int] = {}
+        hard_rows: List[np.ndarray] = []
+        pref_rows: List[np.ndarray] = []
+        for i, taints in enumerate(self.taints):
+            for taint in taints:
+                effect = taint.get("effect")
+                if effect in ("NoSchedule", "NoExecute"):
+                    ids, rows, bucket = hard_ids, hard_rows, self._hard_taints
+                elif effect == "PreferNoSchedule":
+                    ids, rows, bucket = pref_ids, pref_rows, self._pref_taints
+                else:
+                    continue
+                key = _canon(taint)
+                t = ids.get(key)
+                if t is None:
+                    t = ids[key] = len(bucket)
+                    bucket.append(taint)
+                    rows.append(np.zeros(n, bool))
+                rows[t][i] = True
+        self._hard_taint_incid = (
+            np.stack(hard_rows) if hard_rows else np.zeros((0, n), bool)
+        )
+        self._pref_taint_incid = (
+            np.stack(pref_rows) if pref_rows else np.zeros((0, n), bool)
+        )
 
         # NodePreferAvoidPods: static per-node avoid flag (annotation)
         self.prefer_avoid = np.array(
@@ -559,6 +595,7 @@ class Tensorizer:
         self._node_pref: List[np.ndarray] = []
         self._taint_intol: List[np.ndarray] = []
         self._static_score: List[np.ndarray] = []
+        self._avoid_pen: List[np.ndarray] = []
         # group×term incidence, grown row-wise (lists of dict[t]=val)
         self._s_match: List[Dict[int, bool]] = []
         self._a_aff: List[Dict[int, bool]] = []
@@ -612,14 +649,11 @@ class Tensorizer:
         NoExecute + unschedulable), nodeSelector, required node affinity."""
         li = self.label_index
         mask = np.ones(li.n, bool)
-        # TaintToleration + NodeUnschedulable
-        for i in range(li.n):
-            for taint in self.taints[i]:
-                if taint.get("effect") not in ("NoSchedule", "NoExecute"):
-                    continue
-                if not any(toleration_tolerates_taint(t, taint) for t in g.tolerations):
-                    mask[i] = False
-                    break
+        # TaintToleration + NodeUnschedulable: evaluate tolerations once per
+        # distinct taint, fan out through the node-incidence matrix
+        for t, taint in enumerate(self._hard_taints):
+            if not any(toleration_tolerates_taint(tol, taint) for tol in g.tolerations):
+                mask &= ~self._hard_taint_incid[t]
         # nodeSelector: every kv must be a node label
         for k, v in (g.node_selector or {}).items():
             mask &= li.has_kv(k, "" if v is None else str(v))
@@ -745,14 +779,9 @@ class Tensorizer:
         """Count of PreferNoSchedule taints the group does not tolerate
         (`plugins/tainttoleration` Score)."""
         out = np.zeros(self.label_index.n, np.float32)
-        for i in range(self.label_index.n):
-            cnt = 0
-            for taint in self.taints[i]:
-                if taint.get("effect") != "PreferNoSchedule":
-                    continue
-                if not any(toleration_tolerates_taint(t, taint) for t in g.tolerations):
-                    cnt += 1
-            out[i] = cnt
+        for t, taint in enumerate(self._pref_taints):
+            if not any(toleration_tolerates_taint(tol, taint) for tol in g.tolerations):
+                out += self._pref_taint_incid[t]
         return out
 
     # ImageLocality thresholds (`plugins/imagelocality/image_locality.go`)
@@ -760,11 +789,10 @@ class Tensorizer:
     _IMG_MAX = 1000 * 1024 * 1024
 
     def _static_score_for(self, g: PodGroup) -> np.ndarray:
-        """Per-node score terms that depend only on (group, node specs):
-        ImageLocality (w=1) + NodePreferAvoidPods (w=10000), both pre-weighted
-        (`registry.go:101-145`; neither plugin has a NormalizeScore)."""
+        """ImageLocality score, which depends only on (group, node specs)
+        (`plugins/imagelocality/image_locality.go`; no NormalizeScore)."""
         n = self.label_index.n
-        # ImageLocality: sum of node-resident image sizes scaled by spread
+        # sum of node-resident image sizes scaled by spread
         sum_scores = np.zeros(n, np.float64)
         if n:
             for img in set(g.images):
@@ -780,15 +808,17 @@ class Tensorizer:
             100.0,
         )
         img_score[sum_scores < self._IMG_MIN] = 0.0
-        score = img_score.astype(np.float32)
-        # NodePreferAvoidPods for RC/RS-owned pods: upstream adds
-        # weight·score = 10000·100 on non-avoid nodes and 0 on avoid nodes.
-        # Adding ~1e6 uniformly would erase sub-0.0625 deltas from the other
-        # plugins in float32, so keep the argmax-equivalent penalty form:
-        # 0 baseline, -1e6 only on avoid-annotated nodes.
+        return img_score.astype(np.float32)
+
+    def _avoid_penalty_for(self, g: PodGroup) -> np.ndarray:
+        """NodePreferAvoidPods for RC/RS-owned pods: upstream adds
+        weight·score = 10000·100 on non-avoid nodes and 0 on avoid nodes.
+        Adding ~1e6 uniformly would erase sub-0.0625 deltas from the other
+        plugins in float32, so keep the argmax-equivalent penalty form:
+        0 baseline, -1e6 only on avoid-annotated nodes."""
         if g.owner_kind in (C.KIND_RC, C.KIND_RS):
-            score -= 10000.0 * 100.0 * self.prefer_avoid.astype(np.float32)
-        return score
+            return -10000.0 * 100.0 * self.prefer_avoid.astype(np.float32)
+        return np.zeros(self.label_index.n, np.float32)
 
     def _spread_selectors_for(self, g: PodGroup) -> List[dict]:
         """LabelSelectors the SelectorSpread score counts against: services
@@ -824,6 +854,7 @@ class Tensorizer:
         self._node_pref.append(self._node_pref_for(g))
         self._taint_intol.append(self._taint_intol_for(g))
         self._static_score.append(self._static_score_for(g))
+        self._avoid_pen.append(self._avoid_penalty_for(g))
 
         # NodePorts: intern the group's (protocol, port) pairs
         prow: Dict[int, bool] = {}
@@ -1098,6 +1129,9 @@ class Tensorizer:
             ),
             static_score=(
                 np.stack(self._static_score) if g_n else np.zeros((0, n), np.float32)
+            ),
+            avoid_pen=(
+                np.stack(self._avoid_pen) if g_n else np.zeros((0, n), np.float32)
             ),
             terms=list(self.terms),
             term_topo_key=np.asarray(self._term_topo, np.int32),

@@ -197,24 +197,34 @@ def _round_core(
     return state._replace(**updates), m_n
 
 
-@partial(jax.jit, static_argnums=(4, 5), donate_argnums=(1,))
+@partial(jax.jit, static_argnums=(4, 5, 6), donate_argnums=(1,))
 def _round_place_many(
     statics: StaticArrays,
     state: SchedState,
     seg_pods,  # pod-tuple arrays with a leading segment axis [S, ...]
     ks,  # [S] i32 run lengths (0 = padding)
     n_domains: int,
+    k_cap: int,  # static max run length: bounds the per-segment output
     flags: StepFlags = StepFlags(),
 ):
     """All consecutive bulk rounds in one compiled call: a lax.scan over the
     segment axis, so a batch of hundreds of deployment runs costs one
-    dispatch and one [S, N] result transfer instead of per-run round trips.
-    Returns (final_state, m_sn [S, N])."""
+    dispatch and one [S, k_cap] result transfer instead of per-run round
+    trips (the per-node intake [S, N] stays on device — at 100k nodes it
+    would be a gigabyte-scale host copy). Returns (final_state,
+    assign [S, k_cap]): slot j of segment s holds the node index of the
+    segment's j-th placed pod, -1 beyond the placed count."""
+
+    slots = jnp.arange(k_cap)
 
     def body(state, xs):
         pod, k = xs
         new_state, m_n = _round_core(statics, state, pod, k, n_domains, flags)
-        return new_state, m_n
+        # expand per-node intake into slot→node assignments on device
+        cum = jnp.cumsum(m_n)
+        assign = jnp.searchsorted(cum, slots.astype(m_n.dtype), side="right")
+        assign = jnp.where(slots < cum[-1], assign, -1).astype(jnp.int32)
+        return new_state, assign
 
     return jax.lax.scan(body, state, (seg_pods, ks))
 
@@ -229,6 +239,9 @@ class RoundsEngine(Engine):
 
     #: minimum run length worth a bulk round (shorter runs ride the scan)
     MIN_RUN = 8
+    #: maximum pods per bulk round — longer runs split into consecutive
+    #: rounds (bounds the [S, k_cap] output and keeps score slopes fresh)
+    MAX_RUN = 4096
 
     def _group_bulk_eligible(self, tensors, gid: int) -> bool:
         """A group's pods may interact with each other only through
@@ -278,7 +291,8 @@ class RoundsEngine(Engine):
         segments = []
         for a, b in zip(starts.tolist(), stops.tolist()):
             if eligible[a] and b - a >= self.MIN_RUN:
-                segments.append(("bulk", a, b))
+                for c in range(a, b, self.MAX_RUN):
+                    segments.append(("bulk", c, min(c + self.MAX_RUN, b)))
             elif segments and segments[-1][0] == "scan":
                 segments[-1] = ("scan", segments[-1][1], b)
             else:
@@ -351,19 +365,25 @@ class RoundsEngine(Engine):
             s_pad = self._pow2(s_real)
             firsts = np.array([i0 for _, i0, _ in run], np.int32)
             ks = np.array([j0 - i0 for _, i0, j0 in run], np.int32)
+            k_cap = self._pow2(int(ks.max()))
             firsts = np.pad(firsts, (0, s_pad - s_real), constant_values=firsts[-1])
             ks = np.pad(ks, (0, s_pad - s_real))  # k=0 rounds are no-ops
             seg_pods = tuple(jnp.asarray(np.asarray(arr)[firsts]) for arr in pods)
-            state, m_sn = _round_place_many(
-                statics, state, seg_pods, jnp.asarray(ks), tensors.n_domains, flags
+            state, assign_sk = _round_place_many(
+                statics,
+                state,
+                seg_pods,
+                jnp.asarray(ks),
+                tensors.n_domains,
+                k_cap,
+                flags,
             )
-            m_host = np.round(np.asarray(m_sn)).astype(np.int64)  # one transfer
+            assign_host = np.asarray(assign_sk)  # [S, k_cap], one transfer
             leftovers = []
             for s, (_, i0, j0) in enumerate(run):
-                m = m_host[s]
-                placed = int(m.sum())
-                take = np.flatnonzero(m)
-                nodes[i0 : i0 + placed] = np.repeat(take, m[take]).astype(np.int32)
+                row = assign_host[s]
+                placed = int((row >= 0).sum())
+                nodes[i0 : i0 + placed] = row[:placed]
                 reasons[i0 : i0 + placed] = 0
                 if placed < j0 - i0:
                     leftovers.append((i0 + placed, j0))

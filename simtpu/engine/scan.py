@@ -88,7 +88,8 @@ class StaticArrays(NamedTuple):
     vol_mask: jnp.ndarray  # [G, N] VolumeBinding+VolumeZone feasibility
     node_pref: jnp.ndarray  # [G, N]
     taint_intol: jnp.ndarray  # [G, N]
-    static_score: jnp.ndarray  # [G, N] ImageLocality + NodePreferAvoidPods (pre-weighted)
+    static_score: jnp.ndarray  # [G, N] ImageLocality score
+    avoid_pen: jnp.ndarray  # [G, N] NodePreferAvoidPods penalty (pre-weighted)
     dom_tn: jnp.ndarray  # [T, N] node n's domain for term t's topo key (-1 absent)
     # Term incidence is compacted per group: g_terms[g] lists the <= Tc term
     # indices relevant to group g (-1 pad), and every [G, Tc] matrix below is
@@ -120,6 +121,9 @@ class StaticArrays(NamedTuple):
     sdev_media: jnp.ndarray  # [N, SD]
     gpu_dev_exists: jnp.ndarray  # [N, GD]
     gpu_total: jnp.ndarray  # [N]
+    # score-term weights (schedconfig.SchedulerConfig — the
+    # --default-scheduler-config surface); order per simtpu/schedconfig.py
+    score_w: jnp.ndarray  # [schedconfig.N_TERMS]
     # candidate-cluster membership: False rows are "not in this what-if
     # cluster" (used by the batched capacity sweep, simtpu/parallel/sweep.py,
     # which vmaps this field over candidate node counts)
@@ -184,9 +188,14 @@ def _compact_terms(tensors: ClusterTensors):
     return g_terms, compact
 
 
-def statics_from(tensors: ClusterTensors) -> StaticArrays:
+def statics_from(tensors: ClusterTensors, sched_config=None) -> StaticArrays:
+    from ..schedconfig import DEFAULT_WEIGHTS
+
     ext = tensors.ext
     g_terms, compact = _compact_terms(tensors)
+    score_w = (
+        sched_config.score_weights if sched_config is not None else DEFAULT_WEIGHTS
+    )
     return StaticArrays(
         alloc=jnp.asarray(tensors.alloc, jnp.float32),
         static_mask=jnp.asarray(tensors.static_mask),
@@ -194,6 +203,7 @@ def statics_from(tensors: ClusterTensors) -> StaticArrays:
         node_pref=jnp.asarray(tensors.node_pref_score),
         taint_intol=jnp.asarray(tensors.taint_intolerable),
         static_score=jnp.asarray(tensors.static_score, jnp.float32),
+        avoid_pen=jnp.asarray(tensors.avoid_pen, jnp.float32),
         # the per-term domain gather node_dom[term_topo] is hoisted out of the
         # scan body: it is the single most-reused index structure of the step
         dom_tn=jnp.asarray(tensors.dom_tn(), jnp.int32),
@@ -220,6 +230,7 @@ def statics_from(tensors: ClusterTensors) -> StaticArrays:
         sdev_media=jnp.asarray(ext.sdev_media, jnp.int32),
         gpu_dev_exists=jnp.asarray(ext.gpu_dev_total > 0),
         gpu_total=jnp.asarray(ext.gpu_total, jnp.float32),
+        score_w=jnp.asarray(score_w, jnp.float32),
         node_valid=jnp.ones(tensors.alloc.shape[0], bool),
     )
 
@@ -279,7 +290,7 @@ def flags_from(tensors: ClusterTensors, batch_ext: dict) -> StepFlags:
         gpu=gpu,
         node_pref=bool(tensors.node_pref_score.any()),
         taint_pref=bool(tensors.taint_intolerable.any()),
-        static_score=bool(tensors.static_score.any()),
+        static_score=bool(tensors.static_score.any() or tensors.avoid_pen.any()),
     )
 
 
@@ -443,19 +454,21 @@ def filter_and_score(
         )
     feasible = jnp.any(m_all)
 
-    # -- scores (weights: registry.go:101-145 + Simon extension) ----------
+    # -- scores (weights: registry.go:101-145 + Simon extension, overridable
+    # via --default-scheduler-config → statics.score_w) -------------------
     # Every skipped term is constant across nodes for problems where its flag
     # is False (normalizers map all-zero raw scores to a constant), so
     # pruning preserves the argmax exactly.
-    score = least_allocated(state.free, statics.alloc, req)
-    score += balanced_allocation(state.free, statics.alloc, req)
+    w_ = statics.score_w
+    score = w_[0] * least_allocated(state.free, statics.alloc, req)
+    score += w_[1] * balanced_allocation(state.free, statics.alloc, req)
     # Simon score + the GPU-share score, which is the same dominant-share
     # formula (open-gpu-share.go:84-110): computed once, counted twice
-    score += 2.0 * minmax_normalize(simon_share(statics.alloc, req), m_all)
+    score += (w_[2] + w_[3]) * minmax_normalize(simon_share(statics.alloc, req), m_all)
     if f.node_pref:
-        score += minmax_normalize(statics.node_pref[g], m_all)
+        score += w_[4] * minmax_normalize(statics.node_pref[g], m_all)
     if f.taint_pref:
-        score += taint_toleration_score(statics.taint_intol[g], m_all)
+        score += w_[5] * taint_toleration_score(statics.taint_intol[g], m_all)
     if (f.interpod_pref or f.interpod_req) and t_cap:
         tmask = tvalid[:, None]
         raw_ipa = interpod_score(
@@ -467,21 +480,21 @@ def filter_and_score(
             statics.w_aff_pref[g],
             statics.w_anti_pref[g],
         )
-        score += maxabs_normalize(raw_ipa, m_all)
-    # PodTopologySpread soft constraints, registry weight 2
+        score += w_[6] * maxabs_normalize(raw_ipa, m_all)
+    # PodTopologySpread soft constraints, registry weight 2 by default
     if f.spread_soft and t_cap:
-        score += 2.0 * topology_spread_score(cnt_sub, statics.spread_soft[g], m_all)
+        score += w_[7] * topology_spread_score(cnt_sub, statics.spread_soft[g], m_all)
     # SelectorSpread (default workload/service spreading, weight 1)
     if f.selector_spread and t_cap:
-        score += selector_spread_score(
+        score += w_[8] * selector_spread_score(
             cnt_sub, statics.ss_host[g], statics.ss_zone[g], m_all
         )
-    # ImageLocality + NodePreferAvoidPods (static, pre-weighted)
+    # ImageLocality + NodePreferAvoidPods (static per group)
     if f.static_score:
-        score += statics.static_score[g]
+        score += w_[9] * statics.static_score[g] + w_[11] * statics.avoid_pen[g]
     # Open-Local score (binpack; plugin weight 1)
     if f.storage:
-        score += minmax_normalize(
+        score += w_[10] * minmax_normalize(
             open_local_score(
                 lvm_alloc,
                 statics.vg_cap,
@@ -628,6 +641,8 @@ class Engine:
 
     def __init__(self, tensorizer):
         self.tensorizer = tensorizer
+        #: optional schedconfig.SchedulerConfig (score-weight overrides)
+        self.sched_config = None
         self.placed_group: List[int] = []
         self.placed_node: List[int] = []
         self.placed_req: List[np.ndarray] = []
@@ -673,7 +688,7 @@ class Engine:
             ),
             self.ext_log,
         )
-        statics = statics_from(tensors)
+        statics = statics_from(tensors, self.sched_config)
         ext = batch.ext
         flags = flags_from(tensors, batch.ext)
         final_state, (nodes, reasons, lvm_alloc, dev_take, gpu_shares) = self._dispatch(

@@ -50,6 +50,7 @@ def pad_statics(statics: StaticArrays, multiple: int) -> Tuple[StaticArrays, int
             node_pref=_pad_axis(statics.node_pref, 1, pad, 0.0),
             taint_intol=_pad_axis(statics.taint_intol, 1, pad, 0.0),
             static_score=_pad_axis(statics.static_score, 1, pad, 0.0),
+            avoid_pen=_pad_axis(statics.avoid_pen, 1, pad, 0.0),
             dom_tn=_pad_axis(statics.dom_tn, 1, pad, -1),
             has_storage=_pad_axis(statics.has_storage, 0, pad, False),
             vg_cap=_pad_axis(statics.vg_cap, 0, pad, 0.0),
@@ -97,6 +98,7 @@ def statics_sharding(mesh: Mesh) -> StaticArrays:
         node_pref=trail,
         taint_intol=trail,
         static_score=trail,
+        avoid_pen=trail,
         dom_tn=trail,
         g_terms=rep,
         s_match=rep,
@@ -121,6 +123,7 @@ def statics_sharding(mesh: Mesh) -> StaticArrays:
         sdev_media=lead2,
         gpu_dev_exists=lead2,
         gpu_total=lead,
+        score_w=rep,
         node_valid=lead,
     )
 

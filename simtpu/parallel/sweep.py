@@ -69,6 +69,7 @@ def sweep_feasibility(
     candidates: Sequence[int],
     extended_resources: Sequence[str] = (),
     mesh=None,
+    sched_config=None,
 ):
     """Run every candidate clone-count in one batched placement.
 
@@ -111,7 +112,7 @@ def sweep_feasibility(
     )
     batch = tensorizer.add_pods(ordered)
     tensors = tensorizer.freeze()
-    statics = statics_from(tensors)
+    statics = statics_from(tensors, sched_config)
     r = tensors.alloc.shape[1]
     _, pods_arrays = build_pod_arrays(batch, r)
     state = build_state(
@@ -175,6 +176,7 @@ def plan_capacity_batched(
     extended_resources: Sequence[str] = (),
     mesh=None,
     progress=None,
+    sched_config=None,
 ):
     """Batched replacement for the serial min-node-add search.
 
@@ -194,7 +196,7 @@ def plan_capacity_batched(
     candidates = list(range(max_new_nodes))
     say(f"sweeping {len(candidates)} candidate sizes in one batch")
     failures, _, _ = sweep_feasibility(
-        cluster, apps, new_node, candidates, extended_resources, mesh
+        cluster, apps, new_node, candidates, extended_resources, mesh, sched_config
     )
     feasible = np.flatnonzero(failures == 0)
     probes = {int(c): int(f) for c, f in zip(candidates, failures)}
@@ -209,6 +211,7 @@ def plan_capacity_batched(
             extended_resources,
             search="binary",
             progress=progress,
+            sched_config=sched_config,
         )
     from ..plan.capacity import new_fake_nodes
 
@@ -220,7 +223,9 @@ def plan_capacity_batched(
         say(f"candidate add = {best} node(s); re-simulating exactly")
         trial = ResourceTypes(**{k: list(v) for k, v in vars(cluster).items()})
         trial.nodes = list(cluster.nodes) + new_fake_nodes(new_node, best)
-        result = simulate(trial, apps, extended_resources=extended_resources)
+        result = simulate(
+            trial, apps, extended_resources=extended_resources, sched_config=sched_config
+        )
         ok, reason = satisfy_resource_setting(result)
         if ok:
             return PlanResult(True, best, result, "Success!", probes)

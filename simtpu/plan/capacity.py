@@ -183,6 +183,7 @@ def plan_capacity(
     search: str = "binary",
     progress: Optional[Callable[[str], None]] = None,
     bulk: bool = False,
+    sched_config=None,
 ) -> PlanResult:
     """Find the minimum clone count of `new_node` that deploys everything."""
     say = progress or (lambda s: None)
@@ -195,7 +196,13 @@ def plan_capacity(
         say(f"add {i} node(s)")
         trial = ResourceTypes(**{k: list(v) for k, v in vars(cluster).items()})
         trial.nodes = list(cluster.nodes) + new_fake_nodes(new_node, i)
-        result = simulate(trial, apps, extended_resources=extended_resources, bulk=bulk)
+        result = simulate(
+            trial,
+            apps,
+            extended_resources=extended_resources,
+            bulk=bulk,
+            sched_config=sched_config,
+        )
         probes[i] = len(result.unscheduled_pods)
         return result
 
@@ -330,6 +337,15 @@ class Applier:
             return create_cluster_resource_from_client(self.config.cluster.kube_config)
         return create_cluster_resource_from_cluster_config(self.config.cluster.custom_config)
 
+    def _sched_config(self):
+        """Parse --default-scheduler-config when given
+        (`pkg/simulator/utils.go:281` loads the file the same way)."""
+        if not self.opts.default_scheduler_config:
+            return None
+        from ..schedconfig import SchedulerConfig
+
+        return SchedulerConfig.from_file(self.opts.default_scheduler_config)
+
     def load_new_node(self) -> dict:
         content = get_yaml_content_from_directory(self.config.new_node)
         resources = get_objects_from_yaml_content(content)
@@ -377,6 +393,7 @@ class Applier:
                 search=self.opts.search,
                 progress=progress,
                 bulk=self.opts.bulk,
+                sched_config=self._sched_config(),
             )
         timings["plan"] = _time.perf_counter() - t0
         plan.timings = timings

@@ -1,0 +1,103 @@
+"""KubeSchedulerConfiguration consumption.
+
+The reference accepts `--default-scheduler-config` and merges the file into
+its in-memory scheduler profile before force-enabling the Simon/Open-Local/
+Open-Gpu-Share plugins (`pkg/simulator/utils.go:212-289`). The practically
+configurable surface of that file is the score-plugin set: which plugins run
+and with what weight. This module lowers that surface onto the engine's
+score-term weight vector (`scan.StaticArrays.score_w`).
+
+Filter plugins are hard constraints in this engine and cannot be disabled
+(matching the reference, which never disables filters either — it only
+appends to them).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+import numpy as np
+import yaml
+
+# score-term order in StaticArrays.score_w — must match scan.schedule_step
+TERM_LEAST = 0  # NodeResourcesLeastAllocated
+TERM_BALANCED = 1  # NodeResourcesBalancedAllocation
+TERM_SIMON = 2  # Simon (dominant share)
+TERM_GPU = 3  # Open-Gpu-Share (same formula as Simon)
+TERM_NODE_PREF = 4  # NodeAffinity (preferred)
+TERM_TAINT = 5  # TaintToleration
+TERM_IPA = 6  # InterPodAffinity
+TERM_SPREAD_SOFT = 7  # PodTopologySpread (ScheduleAnyway)
+TERM_SS = 8  # SelectorSpread
+TERM_IMAGE = 9  # ImageLocality
+TERM_OPEN_LOCAL = 10  # Open-Local binpack
+TERM_AVOID = 11  # NodePreferAvoidPods (penalty form; registry weight folded in)
+N_TERMS = 12
+
+#: default-provider weights (`vendor/.../algorithmprovider/registry.go:101-145`
+#: — PodTopologySpread carries weight 2; NodePreferAvoidPods' 10000 is folded
+#: into its penalty term, so its weight here stays 1)
+DEFAULT_WEIGHTS = np.array(
+    [1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 2.0, 1.0, 1.0, 1.0, 1.0], np.float32
+)
+_PLUGIN_TO_TERM = {
+    "NodeResourcesLeastAllocated": TERM_LEAST,
+    "NodeResourcesBalancedAllocation": TERM_BALANCED,
+    "Simon": TERM_SIMON,
+    "Open-Gpu-Share": TERM_GPU,
+    "NodeAffinity": TERM_NODE_PREF,
+    "TaintToleration": TERM_TAINT,
+    "InterPodAffinity": TERM_IPA,
+    "PodTopologySpread": TERM_SPREAD_SOFT,
+    "SelectorSpread": TERM_SS,
+    "ImageLocality": TERM_IMAGE,
+    "NodePreferAvoidPods": TERM_AVOID,
+    "Open-Local": TERM_OPEN_LOCAL,
+}
+
+
+@dataclass
+class SchedulerConfig:
+    """Score-weight view of a KubeSchedulerConfiguration."""
+
+    score_weights: np.ndarray = field(
+        default_factory=lambda: DEFAULT_WEIGHTS.copy()
+    )
+
+    @classmethod
+    def from_file(cls, path: str) -> "SchedulerConfig":
+        """Parse profiles[0].plugins.score of a KubeSchedulerConfiguration.
+
+        `enabled: [{name, weight}]` overrides that plugin's weight (defaulting
+        to 1); `disabled: [{name}]` (or `{name: "*"}`) zeroes it.
+        """
+        with open(path) as f:
+            doc = yaml.safe_load(f) or {}
+        if "KubeSchedulerConfiguration" not in str(doc.get("kind", "")):
+            raise ValueError(
+                f"{path}: not a KubeSchedulerConfiguration (kind={doc.get('kind')!r})"
+            )
+        weights = DEFAULT_WEIGHTS.copy()
+        profiles = doc.get("profiles") or []
+        score = ((profiles[0].get("plugins") or {}).get("score") or {}) if profiles else {}
+        for item in score.get("disabled") or []:
+            name = (item or {}).get("name", "")
+            if name == "*":
+                weights[:] = 0.0
+            elif name in _PLUGIN_TO_TERM:
+                weights[_PLUGIN_TO_TERM[name]] = 0.0
+        explicit = set()
+        for item in score.get("enabled") or []:
+            name = (item or {}).get("name", "")
+            if name in _PLUGIN_TO_TERM:
+                term = _PLUGIN_TO_TERM[name]
+                weights[term] = float(item.get("weight", 1) or 1)
+                explicit.add(term)
+        # the reference force-enables its own plugins AFTER merging the file
+        # (`pkg/simulator/utils.go:259-276`): Simon, Open-Gpu-Share and
+        # Open-Local always run; an explicit weight override still applies
+        for term in (TERM_SIMON, TERM_GPU, TERM_OPEN_LOCAL):
+            if term not in explicit:
+                weights[term] = DEFAULT_WEIGHTS[term]
+        return cls(score_weights=weights)

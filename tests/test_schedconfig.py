@@ -1,0 +1,133 @@
+"""Tests for KubeSchedulerConfiguration consumption (`simtpu/schedconfig.py`):
+score-plugin weights/disables from the --default-scheduler-config file flow
+into the engine's score-term weight vector and change placement.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from simtpu.api import simulate
+from simtpu.core.objects import ResourceTypes
+from simtpu.schedconfig import (
+    DEFAULT_WEIGHTS,
+    TERM_NODE_PREF,
+    TERM_SPREAD_SOFT,
+    SchedulerConfig,
+)
+
+from .fixtures import make_fake_node, make_fake_pod, with_node_labels
+
+
+CONFIG_YAML = """
+apiVersion: kubescheduler.config.k8s.io/v1beta1
+kind: KubeSchedulerConfiguration
+profiles:
+  - plugins:
+      score:
+        disabled:
+          - name: NodeResourcesBalancedAllocation
+        enabled:
+          - name: NodeAffinity
+            weight: 50
+          - name: PodTopologySpread
+            weight: 7
+"""
+
+
+def test_from_file(tmp_path):
+    p = tmp_path / "sched.yaml"
+    p.write_text(CONFIG_YAML)
+    cfg = SchedulerConfig.from_file(str(p))
+    assert cfg.score_weights[TERM_NODE_PREF] == 50.0
+    assert cfg.score_weights[TERM_SPREAD_SOFT] == 7.0
+    assert cfg.score_weights[1] == 0.0  # balanced disabled
+    assert cfg.score_weights[0] == DEFAULT_WEIGHTS[0]
+
+
+def test_wildcard_disable_keeps_forced_plugins(tmp_path):
+    # the reference force-enables Simon/Open-Gpu-Share/Open-Local AFTER
+    # merging the user config (utils.go:259-276) — 'disabled: *' cannot
+    # remove them
+    from simtpu.schedconfig import TERM_GPU, TERM_OPEN_LOCAL, TERM_SIMON
+
+    p = tmp_path / "sched.yaml"
+    p.write_text(
+        "kind: KubeSchedulerConfiguration\n"
+        "profiles:\n"
+        "  - plugins:\n"
+        "      score:\n"
+        "        disabled: [{name: '*'}]\n"
+    )
+    cfg = SchedulerConfig.from_file(str(p))
+    assert cfg.score_weights[TERM_SIMON] == DEFAULT_WEIGHTS[TERM_SIMON]
+    assert cfg.score_weights[TERM_GPU] == DEFAULT_WEIGHTS[TERM_GPU]
+    assert cfg.score_weights[TERM_OPEN_LOCAL] == DEFAULT_WEIGHTS[TERM_OPEN_LOCAL]
+    assert cfg.score_weights[0] == 0.0  # everything else really is off
+
+
+def test_image_locality_and_prefer_avoid_are_separate_terms(tmp_path):
+    from simtpu.schedconfig import TERM_AVOID, TERM_IMAGE
+
+    p = tmp_path / "sched.yaml"
+    p.write_text(
+        "kind: KubeSchedulerConfiguration\n"
+        "profiles:\n"
+        "  - plugins:\n"
+        "      score:\n"
+        "        disabled: [{name: ImageLocality}]\n"
+    )
+    cfg = SchedulerConfig.from_file(str(p))
+    assert cfg.score_weights[TERM_IMAGE] == 0.0
+    assert cfg.score_weights[TERM_AVOID] == DEFAULT_WEIGHTS[TERM_AVOID]
+
+
+def test_bad_kind_rejected(tmp_path):
+    p = tmp_path / "bad.yaml"
+    p.write_text("kind: Deployment\n")
+    with pytest.raises(ValueError):
+        SchedulerConfig.from_file(str(p))
+
+
+def test_weights_change_placement():
+    # n0 is busier (least-allocated favors n1) but strongly preferred by node
+    # affinity: default weights pick n0; disabling the NodeAffinity score
+    # flips the choice to the emptier n1
+    nodes = [
+        make_fake_node("n0", "16", "32Gi", with_node_labels({"tier": "gold"})),
+        make_fake_node("n1", "16", "32Gi"),
+    ]
+    busy = make_fake_pod("busy", "default", "8", "16Gi")
+    busy["spec"]["nodeName"] = "n0"
+    pod = make_fake_pod("p", "default", "1", "1Gi")
+    pod["spec"]["affinity"] = {
+        "nodeAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {
+                    "weight": 100,
+                    "preference": {
+                        "matchExpressions": [
+                            {"key": "tier", "operator": "In", "values": ["gold"]}
+                        ]
+                    },
+                }
+            ]
+        }
+    }
+
+    def run(cfg):
+        cluster = ResourceTypes(
+            nodes=[dict(n) for n in nodes], pods=[dict(busy), dict(pod)]
+        )
+        result = simulate(cluster, [], sched_config=cfg)
+        for status in result.node_status:
+            for placed in status.pods:
+                if placed["metadata"]["name"].startswith("p"):
+                    return status.node["metadata"]["name"]
+        return None
+
+    assert run(None) == "n0"
+    w = DEFAULT_WEIGHTS.copy()
+    w[TERM_NODE_PREF] = 0.0
+    assert run(SchedulerConfig(score_weights=w)) == "n1"
