@@ -51,8 +51,10 @@ from .scan import (
     filter_and_score,
 )
 
-_NEG = jnp.float32(-3.4e38)
-_BIG = jnp.float32(3.4e38)
+# plain floats: a module-level jnp constant would initialize the JAX backend
+# at import time, before callers can pick a platform
+_NEG = -3.4e38
+_BIG = 3.4e38
 
 
 def _round_core(
@@ -197,8 +199,7 @@ def _round_core(
     return state._replace(**updates), m_n
 
 
-@partial(jax.jit, static_argnums=(4, 5, 6), donate_argnums=(1,))
-def _round_place_many(
+def rounds_scan(
     statics: StaticArrays,
     state: SchedState,
     seg_pods,  # pod-tuple arrays with a leading segment axis [S, ...]
@@ -207,13 +208,15 @@ def _round_place_many(
     k_cap: int,  # static max run length: bounds the per-segment output
     flags: StepFlags = StepFlags(),
 ):
-    """All consecutive bulk rounds in one compiled call: a lax.scan over the
-    segment axis, so a batch of hundreds of deployment runs costs one
-    dispatch and one [S, k_cap] result transfer instead of per-run round
-    trips (the per-node intake [S, N] stays on device — at 100k nodes it
-    would be a gigabyte-scale host copy). Returns (final_state,
-    assign [S, k_cap]): slot j of segment s holds the node index of the
-    segment's j-th placed pod, -1 beyond the placed count."""
+    """All consecutive bulk rounds as one lax.scan over the segment axis, so
+    a batch of hundreds of deployment runs costs one dispatch and one
+    [S, k_cap] result transfer instead of per-run round trips (the per-node
+    intake [S, N] stays on device — at 100k nodes it would be a
+    gigabyte-scale host copy). Returns (final_state, assign [S, k_cap]):
+    slot j of segment s holds the node index of the segment's j-th placed
+    pod, -1 beyond the placed count. Unjitted — the local engine jits it
+    directly (`_round_place_many`), the sharded engine with mesh shardings
+    (`parallel/sharded.py`)."""
 
     slots = jnp.arange(k_cap)
 
@@ -227,6 +230,19 @@ def _round_place_many(
         return new_state, assign
 
     return jax.lax.scan(body, state, (seg_pods, ks))
+
+
+@partial(jax.jit, static_argnums=(4, 5, 6), donate_argnums=(1,))
+def _round_place_many(
+    statics: StaticArrays,
+    state: SchedState,
+    seg_pods,
+    ks,
+    n_domains: int,
+    k_cap: int,
+    flags: StepFlags = StepFlags(),
+):
+    return rounds_scan(statics, state, seg_pods, ks, n_domains, k_cap, flags)
 
 
 class RoundsEngine(Engine):
@@ -324,13 +340,23 @@ class RoundsEngine(Engine):
     def _pow2(x: int) -> int:
         return 1 << max(x - 1, 0).bit_length()
 
-    def _run_scan_segment(self, statics, state, pods, a, b, flags):
+    def _scan_call(self, statics, state, seg, flags):
+        """Dispatch one serial-scan segment (overridden by the sharded
+        subclass to run on a mesh)."""
         from .scan import _run_scan
 
+        return _run_scan(statics, state, seg, flags)
+
+    def _bulk_call(self, statics, state, seg_pods, ks, n_domains, k_cap, flags):
+        """Dispatch one multi-round bulk call (overridden by the sharded
+        subclass to run on a mesh)."""
+        return _round_place_many(statics, state, seg_pods, ks, n_domains, k_cap, flags)
+
+    def _run_scan_segment(self, statics, state, pods, a, b, flags):
         seg = self._pad_pods(
             tuple(arr[a:b] for arr in pods), self._pow2(b - a)
         )
-        state, outs = _run_scan(statics, state, seg, flags)
+        state, outs = self._scan_call(statics, state, seg, flags)
         return state, tuple(np.asarray(o)[: b - a] for o in outs)
 
     def _dispatch(self, statics: StaticArrays, state: SchedState, pods, flags):
@@ -369,7 +395,7 @@ class RoundsEngine(Engine):
             firsts = np.pad(firsts, (0, s_pad - s_real), constant_values=firsts[-1])
             ks = np.pad(ks, (0, s_pad - s_real))  # k=0 rounds are no-ops
             seg_pods = tuple(jnp.asarray(np.asarray(arr)[firsts]) for arr in pods)
-            state, assign_sk = _round_place_many(
+            state, assign_sk = self._bulk_call(
                 statics,
                 state,
                 seg_pods,

@@ -9,13 +9,20 @@ candidate-size loop become two mesh axes:
 """
 
 from .mesh import NODE_AXIS, SWEEP_AXIS, make_mesh, node_shard_count
-from .sharded import ShardedEngine, build_sharded_scan, pad_state, pad_statics
+from .sharded import (
+    ShardedEngine,
+    ShardedRoundsEngine,
+    build_sharded_scan,
+    pad_state,
+    pad_statics,
+)
 from .sweep import plan_capacity_batched, sweep_feasibility
 
 __all__ = [
     "NODE_AXIS",
     "SWEEP_AXIS",
     "ShardedEngine",
+    "ShardedRoundsEngine",
     "build_sharded_scan",
     "make_mesh",
     "node_shard_count",

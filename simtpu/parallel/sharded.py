@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..engine.rounds import RoundsEngine
 from ..engine.scan import Engine, SchedState, StaticArrays, StepFlags, schedule_step
 from .mesh import NODE_AXIS, node_shard_count
 
@@ -191,3 +192,61 @@ class ShardedEngine(Engine):
         pods = jax.device_put(pods, NamedSharding(self.mesh, P()))
         final_state, out = scan(statics, state, pods)
         return final_state, out
+
+
+def build_sharded_rounds(mesh: Mesh, n_domains: int, k_cap: int, flags: StepFlags):
+    """Compile the bulk multi-round scan with the node axis over `mesh`."""
+    from ..engine.rounds import rounds_scan
+
+    st_spec = statics_sharding(mesh)
+    state_spec = state_sharding(mesh)
+    rep = NamedSharding(mesh, P())
+
+    def fn(statics, state, seg_pods, ks):
+        return rounds_scan(statics, state, seg_pods, ks, n_domains, k_cap, flags)
+
+    return jax.jit(
+        fn,
+        in_shardings=(st_spec, state_spec, None, rep),
+        out_shardings=(state_spec, rep),
+        donate_argnums=(1,),
+    )
+
+
+class ShardedRoundsEngine(RoundsEngine):
+    """Bulk rounds engine with every node-indexed array laid out over a
+    device mesh: rounds, serial fallbacks and leftovers all execute under
+    GSPMD, composing the two parallel axes of this framework (bulk pod
+    runs × sharded nodes). Placements are identical to the unsharded
+    RoundsEngine (dead-node padding is unselectable)."""
+
+    def __init__(self, tensorizer, mesh: Mesh):
+        super().__init__(tensorizer)
+        self.mesh = mesh
+        self._shards = node_shard_count(mesh)
+        self._scan_jits = {}
+        self._bulk_jits = {}
+
+    def _dispatch(self, statics, state, pods, flags):
+        statics, pad = pad_statics(statics, self._shards)
+        state = pad_state(state, pad)
+        statics = jax.device_put(statics, statics_sharding(self.mesh))
+        state = jax.device_put(state, state_sharding(self.mesh))
+        # pods stay host-side: segments slice them and the jits shard
+        # replicated inputs on entry
+        return super()._dispatch(statics, state, pods, flags)
+
+    def _scan_call(self, statics, state, seg, flags):
+        fn = self._scan_jits.get(flags)
+        if fn is None:
+            fn = self._scan_jits[flags] = build_sharded_scan(self.mesh, flags)
+        return fn(statics, state, seg)
+
+    def _bulk_call(self, statics, state, seg_pods, ks, n_domains, k_cap, flags):
+        key = (n_domains, k_cap, flags)
+        fn = self._bulk_jits.get(key)
+        if fn is None:
+            fn = self._bulk_jits[key] = build_sharded_rounds(
+                self.mesh, n_domains, k_cap, flags
+            )
+        return fn(statics, state, seg_pods, ks)

@@ -77,6 +77,34 @@ class TestShardedEngine:
         assert _placements(base) == _placements(result)
 
 
+class TestShardedRoundsEngine:
+    def test_identical_to_unsharded_bulk(self):
+        """Bulk rounds under GSPMD must match the unsharded rounds engine."""
+        from simtpu.parallel import ShardedRoundsEngine
+
+        cluster = synth_cluster(13, seed=31, zones=3, taint_frac=0.2)
+        apps = synth_apps(
+            60,
+            seed=32,
+            zones=3,
+            pods_per_deployment=20,
+            selector_frac=0.3,
+            toleration_frac=0.2,
+            anti_affinity_frac=0.2,
+        )
+        seed_name_hashes(0)
+        base = simulate(cluster, apps, bulk=True)
+        mesh = make_mesh(sweep=1)
+        seed_name_hashes(0)
+        sharded = simulate(
+            cluster,
+            apps,
+            engine_factory=lambda t: ShardedRoundsEngine(t, mesh),
+        )
+        assert _placements(base) == _placements(sharded)
+        assert len(base.unscheduled_pods) == len(sharded.unscheduled_pods)
+
+
 class TestBatchedSweep:
     def test_matches_serial_planner(self, scenario):
         """The one-shot vmapped sweep must find the same minimum node count
