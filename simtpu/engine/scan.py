@@ -655,6 +655,8 @@ class Engine:
             "gpu_mem": [],
         }
         self.last_state: SchedState = None
+        self._last_vocab = None  # vocabulary sizes behind last_state
+        self._state_dirty = False  # log surgery (preemption) invalidates reuse
 
     def _dispatch(
         self, statics: StaticArrays, state: SchedState, pods, flags: StepFlags
@@ -677,17 +679,30 @@ class Engine:
         self._current_tensors = tensors
         r = tensors.alloc.shape[1]
         req, pods = build_pod_arrays(batch, r)
-        state = build_state(
-            tensors,
-            np.asarray(self.placed_group, np.int32),
-            np.asarray(self.placed_node, np.int32),
-            (
-                np.stack([np.pad(q, (0, r - q.shape[0])) for q in self.placed_req])
-                if self.placed_req
-                else np.zeros((0, r), np.float32)
-            ),
-            self.ext_log,
-        )
+        # carry the previous batch's final state forward when nothing that
+        # shapes it changed; a grown vocabulary (new groups may retro-match
+        # new terms) or log surgery (preemption) forces the full rebuild
+        vocab = (r, tensors.n_terms, tensors.n_ports, tensors.n_vols)
+        if (
+            self.last_state is not None
+            and not self._state_dirty
+            and self._last_vocab == vocab
+        ):
+            state = self.last_state
+        else:
+            state = build_state(
+                tensors,
+                np.asarray(self.placed_group, np.int32),
+                np.asarray(self.placed_node, np.int32),
+                (
+                    np.stack(
+                        [np.pad(q, (0, r - q.shape[0])) for q in self.placed_req]
+                    )
+                    if self.placed_req
+                    else np.zeros((0, r), np.float32)
+                ),
+                self.ext_log,
+            )
         statics = statics_from(tensors, self.sched_config)
         ext = batch.ext
         flags = flags_from(tensors, batch.ext)
@@ -695,6 +710,10 @@ class Engine:
             statics, state, pods, flags
         )
         self.last_state = final_state
+        # cache bookkeeping only after a successful dispatch: a failed run
+        # must not leave the reuse branch validating a stale/donated state
+        self._last_vocab = vocab
+        self._state_dirty = False
         nodes = np.asarray(nodes)
         reasons = np.asarray(reasons)
         lvm_alloc = np.asarray(lvm_alloc)
@@ -722,6 +741,7 @@ class Engine:
 
     def remove_placements(self, indices: List[int]) -> dict:
         """Delete log entries at `indices`; returns an undo token."""
+        self._state_dirty = True
         idx = sorted(set(indices))
         ext = self.ext_log
         saved = {
@@ -750,6 +770,7 @@ class Engine:
 
     def restore_placements(self, saved: dict) -> None:
         """Undo a remove_placements (entries return to their positions)."""
+        self._state_dirty = True
         ext = self.ext_log
         for i, entry in zip(saved["indices"], saved["entries"]):
             g, node, req, enode, vg, sdev, gpu_sh, gpu_mem = entry

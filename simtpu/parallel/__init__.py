@@ -8,7 +8,13 @@ candidate-size loop become two mesh axes:
   (`sweep.plan_capacity_batched`).
 """
 
-from .mesh import NODE_AXIS, SWEEP_AXIS, make_mesh, node_shard_count
+from .mesh import (
+    NODE_AXIS,
+    SWEEP_AXIS,
+    initialize_multihost,
+    make_mesh,
+    node_shard_count,
+)
 from .sharded import (
     ShardedEngine,
     ShardedRoundsEngine,
@@ -24,6 +30,7 @@ __all__ = [
     "ShardedEngine",
     "ShardedRoundsEngine",
     "build_sharded_scan",
+    "initialize_multihost",
     "make_mesh",
     "node_shard_count",
     "pad_state",

@@ -63,3 +63,40 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def node_shard_count(mesh: Mesh) -> int:
     return mesh.shape[NODE_AXIS]
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    sweep: Optional[int] = None,
+) -> Mesh:
+    """Join a multi-host run and build the global ("sweep", "nodes") mesh.
+
+    The reference has no distributed backend at all (single process,
+    SURVEY.md §2.3); this is the TPU-native equivalent: `jax.distributed`
+    wires the hosts (ICI within a slice, DCN across slices), and the returned
+    mesh spans every global device. The natural layout is "sweep" across DCN
+    (each slice evaluates candidate cluster sizes independently — zero
+    cross-slice traffic inside a simulation) and "nodes" across ICI, which
+    `sweep=<number of slices>` produces when slices are enumerated
+    contiguously, the JAX default.
+
+    Arguments default to the TPU environment's auto-detection (GKE/Cloud TPU
+    set them via environment); pass them explicitly elsewhere. Call once per
+    process before any other JAX use.
+    """
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    if sweep is None:
+        # one sweep row per slice when the topology exposes slice indices,
+        # else a flat node axis
+        slice_ids = {getattr(d, "slice_index", 0) for d in jax.devices()}
+        sweep = len(slice_ids) if len(slice_ids) > 1 else 1
+    return make_mesh(jax.devices(), sweep=sweep)

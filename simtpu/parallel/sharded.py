@@ -168,7 +168,31 @@ def build_sharded_scan(mesh: Mesh, flags: StepFlags = StepFlags()):
     )
 
 
-class ShardedEngine(Engine):
+class _MeshMixin:
+    """Shared mesh plumbing for the sharded engines: input padding/layout and
+    the per-flags compiled-scan cache."""
+
+    def _init_mesh(self, mesh: Mesh) -> None:
+        self.mesh = mesh
+        self._shards = node_shard_count(mesh)
+        self._scan_jits = {}  # StepFlags → compiled sharded serial scan
+
+    def _shard_inputs(self, statics: StaticArrays, state: SchedState):
+        statics, _ = pad_statics(statics, self._shards)
+        # a state carried over from the previous batch is already padded
+        state = pad_state(state, statics.alloc.shape[0] - state.free.shape[0])
+        statics = jax.device_put(statics, statics_sharding(self.mesh))
+        state = jax.device_put(state, state_sharding(self.mesh))
+        return statics, state
+
+    def _sharded_scan_for(self, flags: StepFlags):
+        fn = self._scan_jits.get(flags)
+        if fn is None:
+            fn = self._scan_jits[flags] = build_sharded_scan(self.mesh, flags)
+        return fn
+
+
+class ShardedEngine(_MeshMixin, Engine):
     """Engine whose scan runs with the node axis sharded over a mesh.
 
     Drop-in for `Engine` inside `simtpu.api.Simulator`: identical placements
@@ -177,20 +201,12 @@ class ShardedEngine(Engine):
 
     def __init__(self, tensorizer, mesh: Mesh):
         super().__init__(tensorizer)
-        self.mesh = mesh
-        self._scans = {}  # StepFlags → compiled sharded scan
-        self._shards = node_shard_count(mesh)
+        self._init_mesh(mesh)
 
     def _dispatch(self, statics: StaticArrays, state: SchedState, pods, flags: StepFlags):
-        scan = self._scans.get(flags)
-        if scan is None:
-            scan = self._scans[flags] = build_sharded_scan(self.mesh, flags)
-        statics, pad = pad_statics(statics, self._shards)
-        state = pad_state(state, pad)
-        statics = jax.device_put(statics, statics_sharding(self.mesh))
-        state = jax.device_put(state, state_sharding(self.mesh))
+        statics, state = self._shard_inputs(statics, state)
         pods = jax.device_put(pods, NamedSharding(self.mesh, P()))
-        final_state, out = scan(statics, state, pods)
+        final_state, out = self._sharded_scan_for(flags)(statics, state, pods)
         return final_state, out
 
 
@@ -213,7 +229,7 @@ def build_sharded_rounds(mesh: Mesh, n_domains: int, k_cap: int, flags: StepFlag
     )
 
 
-class ShardedRoundsEngine(RoundsEngine):
+class ShardedRoundsEngine(_MeshMixin, RoundsEngine):
     """Bulk rounds engine with every node-indexed array laid out over a
     device mesh: rounds, serial fallbacks and leftovers all execute under
     GSPMD, composing the two parallel axes of this framework (bulk pod
@@ -222,25 +238,17 @@ class ShardedRoundsEngine(RoundsEngine):
 
     def __init__(self, tensorizer, mesh: Mesh):
         super().__init__(tensorizer)
-        self.mesh = mesh
-        self._shards = node_shard_count(mesh)
-        self._scan_jits = {}
+        self._init_mesh(mesh)
         self._bulk_jits = {}
 
     def _dispatch(self, statics, state, pods, flags):
-        statics, pad = pad_statics(statics, self._shards)
-        state = pad_state(state, pad)
-        statics = jax.device_put(statics, statics_sharding(self.mesh))
-        state = jax.device_put(state, state_sharding(self.mesh))
+        statics, state = self._shard_inputs(statics, state)
         # pods stay host-side: segments slice them and the jits shard
         # replicated inputs on entry
         return super()._dispatch(statics, state, pods, flags)
 
     def _scan_call(self, statics, state, seg, flags):
-        fn = self._scan_jits.get(flags)
-        if fn is None:
-            fn = self._scan_jits[flags] = build_sharded_scan(self.mesh, flags)
-        return fn(statics, state, seg)
+        return self._sharded_scan_for(flags)(statics, state, seg)
 
     def _bulk_call(self, statics, state, seg_pods, ks, n_domains, k_cap, flags):
         key = (n_domains, k_cap, flags)

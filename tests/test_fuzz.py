@@ -1,0 +1,68 @@
+"""Randomized cross-engine equivalence (the scan-vs-parallel check SURVEY.md
+§5 calls for in place of a race detector): on random synthetic problems, the
+serial scan, bulk rounds, and sharded engines must agree on feasibility
+outcomes, and no engine may overcommit any node.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from simtpu.api import simulate
+from simtpu.core.quantity import parse_quantity
+from simtpu.synth import synth_apps, synth_cluster
+from simtpu.workloads.expand import seed_name_hashes
+
+
+def _counts(result):
+    return sorted(
+        (s.node["metadata"]["name"], len(s.pods)) for s in result.node_status
+    )
+
+
+def _assert_no_overcommit(result):
+    for status in result.node_status:
+        alloc = status.node["status"]["allocatable"]
+        for res in ("cpu", "memory"):
+            cap = parse_quantity(alloc[res])
+            used = 0.0
+            for pod in status.pods:
+                for c in pod["spec"]["containers"]:
+                    used += parse_quantity(
+                        ((c.get("resources") or {}).get("requests") or {}).get(res, 0)
+                    )
+            assert used <= cap * (1 + 1e-6), (
+                f"{status.node['metadata']['name']} overcommitted {res}: "
+                f"{used} > {cap}"
+            )
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303, 404])
+def test_scan_vs_bulk_equivalence(seed):
+    rng = np.random.default_rng(seed)
+    n_nodes = int(rng.integers(8, 40))
+    n_pods = int(rng.integers(40, 220))
+    cluster = synth_cluster(
+        n_nodes, seed=seed, zones=int(rng.integers(1, 5)), taint_frac=0.15
+    )
+    apps = synth_apps(
+        n_pods,
+        seed=seed + 1,
+        zones=3,
+        pods_per_deployment=int(rng.integers(5, 40)),
+        selector_frac=0.25,
+        toleration_frac=0.15,
+        anti_affinity_frac=0.25,
+    )
+    seed_name_hashes(seed)
+    serial = simulate(cluster, apps)
+    seed_name_hashes(seed)
+    bulk = simulate(cluster, apps, bulk=True)
+    # feasibility equivalence: same number of pods placed and unplaced
+    assert sum(len(s.pods) for s in serial.node_status) == sum(
+        len(s.pods) for s in bulk.node_status
+    )
+    assert len(serial.unscheduled_pods) == len(bulk.unscheduled_pods)
+    _assert_no_overcommit(serial)
+    _assert_no_overcommit(bulk)
