@@ -22,9 +22,24 @@ __all__ = [
     "AppResource",
     "NodeStatus",
     "ResourceTypes",
+    "SchedulerConfig",
     "SimulateResult",
     "Simulator",
     "UnscheduledPod",
+    "plan_capacity",
     "simulate",
     "__version__",
 ]
+
+
+def __getattr__(name):
+    # lazy: the planner pulls in the full engine/parallel stack
+    if name == "plan_capacity":
+        from .plan.capacity import plan_capacity
+
+        return plan_capacity
+    if name == "SchedulerConfig":
+        from .schedconfig import SchedulerConfig
+
+        return SchedulerConfig
+    raise AttributeError(name)

@@ -129,11 +129,12 @@ def _round_core(
 
     kf = jnp.minimum(jnp.float32(k), jnp.sum(cap))
     hi = jnp.max(s0)
-    lo = (
-        jnp.min(jnp.where(ev.m_all, s0, _BIG))
-        - jnp.max(jnp.where(ev.m_all, slope, 0.0)) * jnp.float32(k)
-        - 1.0
-    )
+    # every node's lowest usable virtual slot bounds the k-th best from
+    # below: count(lo) = sum(cap) >= kf holds by construction, and the range
+    # stays tight (score-scale, not worst-case slope x k), so 40 bisection
+    # steps resolve far below any real score delta
+    low_slot = s0 - slope * jnp.clip(cap - 1.0, 0.0, jnp.float32(k))
+    lo = jnp.min(jnp.where(ev.m_all, low_slot, _BIG)) - 1.0
 
     def body(_, bounds):
         lo, hi = bounds
@@ -141,7 +142,7 @@ def _round_core(
         over = jnp.sum(counts(mid)) > kf
         return jnp.where(over, mid, lo), jnp.where(over, hi, mid)
 
-    lo, hi = jax.lax.fori_loop(0, 48, body, (lo, hi))
+    lo, hi = jax.lax.fori_loop(0, 40, body, (lo, hi))
     m_n = counts(hi)  # ~kf placements, every slot scoring above hi
     # clamp any overshoot (tie plateaus, k=0 padding) by ascending node index
     cum_m = jnp.cumsum(m_n)
