@@ -49,6 +49,7 @@ from .scan import (
     StaticArrays,
     StepFlags,
     filter_and_score,
+    score_pod,
 )
 
 # plain floats: a module-level jnp constant would initialize the JAX backend
@@ -100,18 +101,19 @@ def _round_core(
     cap = jnp.where(ev.m_all, cap, 0.0)
 
     # -- score slope: re-score after one hypothetical pod per node --------
+    # score-only: the filter cascade need not rerun — the round keeps its
+    # start-of-round masks (m_all) and the caps carry the hard constraints
     hyp = state._replace(free=state.free - req[None, :])
     if t_cap:
         bump1 = jnp.where(valid_sub, statics.s_match[g][:, None], 0.0)
         hyp = hyp._replace(cnt_match=state.cnt_match.at[tsafe].add(bump1))
-    ev1 = filter_and_score(statics, hyp, pod, flags)
+    score1 = score_pod(statics, hyp, g, req, ev.m_all, flags)
     # slope clamped >= 0: the threshold search needs non-increasing
     # sequences; a genuinely increasing score (rare: balanced_allocation
     # improving) fills one node until capacity under serial semantics, which
-    # slope 0 reproduces up to ties
-    # the 1e6 ceiling keeps nodes that turn infeasible in the hypothetical
-    # state (score -inf, i.e. capacity 1) on a finite search range
-    slope = jnp.clip(jnp.where(ev.m_all, ev.score - ev1.score, 0.0), 0.0, 1e6)
+    # slope 0 reproduces up to ties. The 1e6 ceiling keeps pathological
+    # per-pod drops (free crossing zero) on a finite search range.
+    slope = jnp.clip(jnp.where(ev.m_all, ev.score - score1, 0.0), 0.0, 1e6)
     s0 = jnp.where(ev.m_all, ev.score, _NEG)
 
     # -- threshold search: pick the kf best virtual placements ------------

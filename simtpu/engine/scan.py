@@ -335,6 +335,75 @@ class StepEval(NamedTuple):
         return fail
 
 
+def score_pod(
+    statics: StaticArrays,
+    state: SchedState,
+    g,
+    req,
+    m_all,
+    flags: StepFlags = StepFlags(),
+    storage_raw=None,
+) -> jnp.ndarray:
+    """The combined score sum for one pod spec over all nodes, -inf outside
+    `m_all` (weights: registry.go:101-145 + Simon extension, overridable via
+    --default-scheduler-config → statics.score_w).
+
+    Every term skipped by a False flag is constant across nodes for such
+    problems (normalizers map all-zero raw scores to a constant), so pruning
+    preserves the argmax exactly. `storage_raw` is the raw Open-Local score
+    (computed by the filter pass, which owns the storage plans); None drops
+    the term — argmax-neutral for pods without storage demand.
+
+    Shared by the filter cascade (`filter_and_score`) and the bulk rounds
+    engine's slope re-score (`engine/rounds.py`), which evaluates it on a
+    hypothetical state without re-running the filters.
+    """
+    f = flags
+    t_cap = statics.g_terms.shape[1]
+    if t_cap:
+        terms_g = statics.g_terms[g]
+        tvalid = terms_g >= 0
+        tsafe = jnp.clip(terms_g, 0)
+        cnt_sub = jnp.where(tvalid[:, None], state.cnt_match[tsafe], 0.0)
+    w_ = statics.score_w
+    score = w_[0] * least_allocated(state.free, statics.alloc, req)
+    score += w_[1] * balanced_allocation(state.free, statics.alloc, req)
+    # Simon score + the GPU-share score, which is the same dominant-share
+    # formula (open-gpu-share.go:84-110): computed once, counted twice
+    score += (w_[2] + w_[3]) * minmax_normalize(simon_share(statics.alloc, req), m_all)
+    if f.node_pref:
+        score += w_[4] * minmax_normalize(statics.node_pref[g], m_all)
+    if f.taint_pref:
+        score += w_[5] * taint_toleration_score(statics.taint_intol[g], m_all)
+    if (f.interpod_pref or f.interpod_req) and t_cap:
+        tmask = tvalid[:, None]
+        raw_ipa = interpod_score(
+            cnt_sub,
+            jnp.where(tmask, state.cnt_own_aff[tsafe], 0.0),
+            jnp.where(tmask, state.w_own_aff_pref[tsafe], 0.0),
+            jnp.where(tmask, state.w_own_anti_pref[tsafe], 0.0),
+            statics.s_match[g],
+            statics.w_aff_pref[g],
+            statics.w_anti_pref[g],
+        )
+        score += w_[6] * maxabs_normalize(raw_ipa, m_all)
+    # PodTopologySpread soft constraints, registry weight 2 by default
+    if f.spread_soft and t_cap:
+        score += w_[7] * topology_spread_score(cnt_sub, statics.spread_soft[g], m_all)
+    # SelectorSpread (default workload/service spreading, weight 1)
+    if f.selector_spread and t_cap:
+        score += w_[8] * selector_spread_score(
+            cnt_sub, statics.ss_host[g], statics.ss_zone[g], m_all
+        )
+    # ImageLocality + NodePreferAvoidPods (static per group)
+    if f.static_score:
+        score += w_[9] * statics.static_score[g] + w_[11] * statics.avoid_pen[g]
+    # Open-Local score (binpack; plugin weight 1)
+    if storage_raw is not None:
+        score += w_[10] * minmax_normalize(storage_raw, m_all)
+    return jnp.where(m_all, score, -jnp.inf)
+
+
 def filter_and_score(
     statics: StaticArrays, state: SchedState, pod, flags: StepFlags = StepFlags()
 ) -> StepEval:
@@ -454,57 +523,16 @@ def filter_and_score(
         )
     feasible = jnp.any(m_all)
 
-    # -- scores (weights: registry.go:101-145 + Simon extension, overridable
-    # via --default-scheduler-config → statics.score_w) -------------------
-    # Every skipped term is constant across nodes for problems where its flag
-    # is False (normalizers map all-zero raw scores to a constant), so
-    # pruning preserves the argmax exactly.
-    w_ = statics.score_w
-    score = w_[0] * least_allocated(state.free, statics.alloc, req)
-    score += w_[1] * balanced_allocation(state.free, statics.alloc, req)
-    # Simon score + the GPU-share score, which is the same dominant-share
-    # formula (open-gpu-share.go:84-110): computed once, counted twice
-    score += (w_[2] + w_[3]) * minmax_normalize(simon_share(statics.alloc, req), m_all)
-    if f.node_pref:
-        score += w_[4] * minmax_normalize(statics.node_pref[g], m_all)
-    if f.taint_pref:
-        score += w_[5] * taint_toleration_score(statics.taint_intol[g], m_all)
-    if (f.interpod_pref or f.interpod_req) and t_cap:
-        tmask = tvalid[:, None]
-        raw_ipa = interpod_score(
-            cnt_sub,
-            jnp.where(tmask, state.cnt_own_aff[tsafe], 0.0),
-            jnp.where(tmask, state.w_own_aff_pref[tsafe], 0.0),
-            jnp.where(tmask, state.w_own_anti_pref[tsafe], 0.0),
-            statics.s_match[g],
-            statics.w_aff_pref[g],
-            statics.w_anti_pref[g],
-        )
-        score += w_[6] * maxabs_normalize(raw_ipa, m_all)
-    # PodTopologySpread soft constraints, registry weight 2 by default
-    if f.spread_soft and t_cap:
-        score += w_[7] * topology_spread_score(cnt_sub, statics.spread_soft[g], m_all)
-    # SelectorSpread (default workload/service spreading, weight 1)
-    if f.selector_spread and t_cap:
-        score += w_[8] * selector_spread_score(
-            cnt_sub, statics.ss_host[g], statics.ss_zone[g], m_all
-        )
-    # ImageLocality + NodePreferAvoidPods (static per group)
-    if f.static_score:
-        score += w_[9] * statics.static_score[g] + w_[11] * statics.avoid_pen[g]
-    # Open-Local score (binpack; plugin weight 1)
+    storage_raw = None
     if f.storage:
-        score += w_[10] * minmax_normalize(
-            open_local_score(
-                lvm_alloc,
-                statics.vg_cap,
-                dev_tight,
-                jnp.sum(lvm_size > 0),
-                jnp.sum(dev_size > 0),
-            ),
-            m_all,
+        storage_raw = open_local_score(
+            lvm_alloc,
+            statics.vg_cap,
+            dev_tight,
+            jnp.sum(lvm_size > 0),
+            jnp.sum(dev_size > 0),
         )
-    score = jnp.where(m_all, score, -jnp.inf)
+    score = score_pod(statics, state, g, req, m_all, flags, storage_raw)
 
     return StepEval(
         m_static=m_static,
