@@ -71,8 +71,11 @@ def _round_core(
     Returns (new_state, m_n [N] pods placed per node).
     """
     (g, req, pin, forced, *_ext) = pod
-    t_cap = statics.g_terms.shape[1]
     f = flags
+    # the topology count state is only read when some topology feature is
+    # compiled in — skip its (scatter-heavy) update entirely otherwise
+    use_topo = f.spread_hard or f.spread_soft or f.selector_spread or f.interpod_req or f.interpod_pref
+    t_cap = statics.g_terms.shape[1] if use_topo else 0
     if t_cap:
         terms_g = statics.g_terms[g]
         tvalid = terms_g >= 0

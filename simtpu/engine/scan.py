@@ -570,7 +570,11 @@ def schedule_step(
         gpu_preset,
     ) = pod
     f = flags
-    t_cap = statics.g_terms.shape[1]
+    use_topo = (
+        f.spread_hard or f.spread_soft or f.selector_spread
+        or f.interpod_req or f.interpod_pref
+    )
+    t_cap = statics.g_terms.shape[1] if use_topo else 0
     ev = filter_and_score(statics, state, pod, flags)
     lvm_alloc, dev_take, gpu_shares = ev.lvm_alloc, ev.dev_take, ev.gpu_shares
     feasible = jnp.any(ev.m_all)
