@@ -739,13 +739,29 @@ class Tensorizer:
                 if pv is None:
                     continue  # bound to a PV we weren't given: no constraint
                 mask &= self._pv_node_mask(pv)
+                continue
+            # unbound: upstream findMatchingVolume takes a PV pre-bound to
+            # this very claim first — claimRef naming the claim wins
+            # regardless of class/capacity (IsVolumeBoundToClaim requires
+            # exact namespace+name equality; an empty claimRef namespace
+            # never matches)
+            prebound = np.zeros(li.n, bool)
+            has_prebound = False
+            for pv in self.pv_map.values():
+                ref = (pv.get("spec") or {}).get("claimRef") or {}
+                if ref.get("name") == claim and ref.get("namespace") == g.namespace:
+                    has_prebound = True
+                    prebound |= self._pv_node_mask(pv)
+            if has_prebound:
+                mask &= prebound
             elif sc_name:
                 if sc_name not in self.catalog:
                     # unbound, named class doesn't exist →
                     # UnschedulableAndUnresolvable
                     return np.zeros(li.n, bool)
             else:
-                # static provisioning: any unclaimed PV with enough capacity
+                # static provisioning: any unclaimed classless PV with
+                # enough capacity
                 want = parse_quantity(
                     ((spec.get("resources") or {}).get("requests") or {}).get(
                         "storage", 0

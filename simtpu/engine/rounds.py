@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..kernels.filters import _RES_EPS
 from .scan import (
     Engine,
     SchedState,
@@ -86,10 +87,13 @@ def _round_core(
     ev = filter_and_score(statics, state, pod, flags)
 
     # -- per-node intake caps --------------------------------------------
+    # same relative slack as filters.resources_fit, so a node that passes
+    # the serial filter within tolerance also gets a non-zero bulk cap
     with_req = req > 0
+    slack = _RES_EPS * jnp.maximum(jnp.abs(state.free), 1.0)
     ratio = jnp.where(
         with_req[None, :],
-        jnp.floor((state.free + 1e-6) / jnp.maximum(req, 1e-30)[None, :]),
+        jnp.floor((state.free + slack) / jnp.maximum(req, 1e-30)[None, :]),
         _BIG,
     )
     cap = jnp.min(ratio, axis=1)

@@ -738,6 +738,10 @@ class Engine:
         statics = statics_from(tensors, self.sched_config)
         ext = batch.ext
         flags = flags_from(tensors, batch.ext)
+        # a donating dispatch can invalidate `state`'s buffers before raising
+        # (RoundsEngine makes several donating calls per batch); mark dirty so
+        # a retry rebuilds from the log instead of reusing a dead buffer
+        self._state_dirty = True
         final_state, (nodes, reasons, lvm_alloc, dev_take, gpu_shares) = self._dispatch(
             statics, state, pods, flags
         )

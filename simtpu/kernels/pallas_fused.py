@@ -27,13 +27,14 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ..core.tensorize import RES_CPU, RES_MEMORY
+from .filters import _RES_EPS as _EPS
 from .scores import MAX_NODE_SCORE
 
 # float32 sublane granule; the resource axis is padded up to a multiple
 _SUBLANE = 8
 # default node-axis tile: 2048 f32 lanes ≈ 8 KiB per row-block in VMEM
 _TILE_N = 2048
-_EPS = 1e-5  # matches filters._RES_EPS
 
 
 def _pad_to(x: jnp.ndarray, axis: int, multiple: int, value=0.0) -> jnp.ndarray:
@@ -68,8 +69,11 @@ def _kernel(req_ref, free_ref, alloc_ref, fit_ref, lb_ref, dom_ref, *, n_res):
     okf = jnp.where(free + slack >= req, 1.0, 0.0)
     fit = jnp.min(jnp.maximum(okf, 1.0 - act), axis=0)
 
-    # NodeResourcesLeastAllocated over cpu+memory (rows 0, 1)
-    cpumem = jnp.where(rows < 2, 1.0, 0.0)
+    # NodeResourcesLeastAllocated over the cpu+memory rows (two separate
+    # wheres: a bool-vector OR intermediate would hit Mosaic's i8→i1 limits)
+    cpumem = jnp.where(rows == RES_CPU, 1.0, 0.0) + jnp.where(
+        rows == RES_MEMORY, 1.0, 0.0
+    )
     fa = jnp.clip(free - req, 0.0, None)
     lfrac = jnp.where(alloc > 0, fa / jnp.maximum(alloc, 1e-30), 0.0)
     least = jnp.sum(lfrac * cpumem, axis=0) * (MAX_NODE_SCORE / 2.0)
@@ -77,7 +81,7 @@ def _kernel(req_ref, free_ref, alloc_ref, fit_ref, lb_ref, dom_ref, *, n_res):
     # NodeResourcesBalancedAllocation (two-resource form)
     used_after = alloc - free + req
     ufrac = jnp.where(alloc > 0, used_after / jnp.maximum(alloc, 1e-30), 1.0)
-    balanced = (1.0 - jnp.abs(ufrac[0, :] - ufrac[1, :])) * MAX_NODE_SCORE
+    balanced = (1.0 - jnp.abs(ufrac[RES_CPU, :] - ufrac[RES_MEMORY, :])) * MAX_NODE_SCORE
 
     # Simon dominant share against static allocatable (scores.simon_share)
     denom = alloc - req

@@ -18,6 +18,7 @@ import ctypes
 import hashlib
 import os
 import subprocess
+import tempfile
 from typing import Optional, Sequence
 
 import numpy as np
@@ -36,13 +37,28 @@ def _build() -> Optional[str]:
     out = os.path.join(_BUILD_DIR, f"simtpu_native_{digest}.so")
     if os.path.exists(out):
         return out
-    os.makedirs(_BUILD_DIR, exist_ok=True)
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", out + ".tmp", _SRC]
+    # build into a unique temp file so concurrent importers (pytest-xdist)
+    # can't interleave writes; os.replace makes publication atomic. An
+    # unwritable package dir (read-only install) just means numpy fallback.
+    try:
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
+        os.close(fd)
+    except OSError:
+        return None
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.chmod(tmp, 0o755)  # mkstemp's 0600 would break shared installs
+        os.replace(tmp, out)
     except (OSError, subprocess.SubprocessError):
         return None
-    os.replace(out + ".tmp", out)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
     return out
 
 

@@ -401,6 +401,51 @@ class TestVolumeBindingAndZone:
         )
         assert not mask.any()
 
+    def test_prebound_pv_claimref_matches_claim(self):
+        # a PV pre-bound via claimRef to the querying claim (PVC.volumeName
+        # still empty) must bind — upstream findMatchingVolume prefers exactly
+        # such PVs — and restricts the pod to that PV's reachable nodes
+        nodes = [
+            make_fake_node(
+                "n0", "8", "16Gi", with_node_labels({"topology.kubernetes.io/zone": "z1"})
+            ),
+            make_fake_node(
+                "n1", "8", "16Gi", with_node_labels({"topology.kubernetes.io/zone": "z2"})
+            ),
+        ]
+        pvc = _pvc("claim")
+        pvc["spec"]["resources"] = {"requests": {"storage": "10Gi"}}
+        pv = {
+            "kind": "PersistentVolume",
+            "metadata": {
+                "name": "pv-a",
+                "labels": {"topology.kubernetes.io/zone": "z2"},
+            },
+            "spec": {
+                # pre-bound, and smaller than the request: claimRef match
+                # wins regardless of capacity
+                "capacity": {"storage": "1Gi"},
+                "claimRef": {"namespace": "default", "name": "claim"},
+            },
+        }
+        mask = self._mask(
+            nodes, _raw_pod_with_pvc("p0", "claim"), pvcs=[pvc], pvs=[pv]
+        )
+        assert list(mask) == [False, True]
+        # a claimRef naming a DIFFERENT claim still excludes the PV
+        pv_other = {
+            "kind": "PersistentVolume",
+            "metadata": {"name": "pv-b"},
+            "spec": {
+                "capacity": {"storage": "20Gi"},
+                "claimRef": {"namespace": "default", "name": "other"},
+            },
+        }
+        mask = self._mask(
+            nodes, _raw_pod_with_pvc("p1", "claim"), pvcs=[pvc], pvs=[pv_other]
+        )
+        assert not mask.any()
+
     def test_open_local_claims_skip_volume_binding(self):
         # open-local SCs are scheduled by the storage kernels; the static
         # volume mask must not reject them even without PV objects
