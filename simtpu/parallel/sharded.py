@@ -224,7 +224,7 @@ def build_sharded_rounds(mesh: Mesh, n_domains: int, k_cap: int, flags: StepFlag
     return jax.jit(
         fn,
         in_shardings=(st_spec, state_spec, None, rep),
-        out_shardings=(state_spec, rep),
+        out_shardings=(state_spec, (rep, rep, rep, rep)),
         donate_argnums=(1,),
     )
 

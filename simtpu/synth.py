@@ -29,6 +29,7 @@ def make_node(
     taints=None,
     gpu: Tuple[int, int] = None,  # (count, mem_mib_per_device)
     storage_gib: Tuple[int, ...] = (),
+    device_gib: Tuple[Tuple[int, str], ...] = (),  # (gib, "ssd"|"hdd") each
 ) -> dict:
     alloc = {
         "cpu": f"{cpu_milli}m",
@@ -40,14 +41,22 @@ def make_node(
         count, mem = gpu
         alloc["alibabacloud.com/gpu-count"] = str(count)
         alloc["alibabacloud.com/gpu-mem"] = f"{count * mem}Mi"
-    if storage_gib:
+    if storage_gib or device_gib:
         annotations["simon/node-local-storage"] = json.dumps(
             {
                 "vgs": [
                     {"name": f"vg{j}", "capacity": g * (1 << 30), "requested": 0}
                     for j, g in enumerate(storage_gib)
                 ],
-                "devices": [],
+                "devices": [
+                    {
+                        "device": f"/dev/sd{chr(ord('b') + j)}",
+                        "capacity": g * (1 << 30),
+                        "mediaType": media,
+                        "isAllocated": False,
+                    }
+                    for j, (g, media) in enumerate(device_gib)
+                ],
             }
         )
     return {
@@ -70,11 +79,10 @@ def make_deployment(
     anti_affinity_topo: str = None,
     gpu_mem_mib: int = 0,
     lvm_gib: int = 0,
+    device_gib: int = 0,  # exclusive-SSD claim size
 ) -> dict:
     labels = {"app": name}
     requests = {"cpu": f"{cpu_milli}m", "memory": f"{mem_mib}Mi"}
-    if gpu_mem_mib:
-        requests["alibabacloud.com/gpu-mem"] = f"{gpu_mem_mib}Mi"
     spec = {
         "containers": [
             {"name": "c", "image": "app", "resources": {"requests": requests}}
@@ -98,30 +106,42 @@ def make_deployment(
                 ]
             }
         }
-    meta = {"labels": dict(labels)}
+    # pod labels/annotations come from the OWNER's metadata, not the
+    # template's (SetObjectMetaFromObject copies owner.GetLabels()/
+    # GetAnnotations(), utils.go:336-346; the gpushare example carries its
+    # gpu annotations on the workload metadata accordingly)
+    annotations = {}
+    if gpu_mem_mib:
+        annotations["alibabacloud.com/gpu-mem"] = f"{gpu_mem_mib}Mi"
+        annotations["alibabacloud.com/gpu-count"] = "1"
+    volumes = []
     if lvm_gib:
         # unnamed-VG LVM volume → binpack across node VGs (common.go:59-107)
-        meta["annotations"] = {
-            "simon/pod-local-storage": json.dumps(
-                {
-                    "volumes": [
-                        {
-                            "kind": "LVM",
-                            "scName": "open-local-lvm",
-                            "size": lvm_gib * (1 << 30),
-                        }
-                    ]
-                }
-            )
-        }
+        volumes.append(
+            {"kind": "LVM", "scName": "open-local-lvm", "size": lvm_gib * (1 << 30)}
+        )
+    if device_gib:
+        # exclusive-device claim (media resolved via the SC catalog)
+        volumes.append(
+            {
+                "kind": "SSD",
+                "scName": "open-local-device-ssd",
+                "size": device_gib * (1 << 30),
+            }
+        )
+    if volumes:
+        annotations["simon/pod-local-storage"] = json.dumps({"volumes": volumes})
+    meta = {"name": name, "namespace": namespace, "labels": dict(labels)}
+    if annotations:
+        meta["annotations"] = annotations
     return {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
-        "metadata": {"name": name, "namespace": namespace},
+        "metadata": meta,
         "spec": {
             "replicas": replicas,
             "selector": {"matchLabels": labels},
-            "template": {"metadata": meta, "spec": spec},
+            "template": {"metadata": {"labels": dict(labels)}, "spec": spec},
         },
     }
 
@@ -152,15 +172,38 @@ def synth_cluster(
         if rng.random() < gpu_frac:
             gpu = (int(rng.integers(2, 9)), 16384)
         storage = ()
+        devices = ()
         if rng.random() < storage_frac:
-            storage = (int(rng.integers(200, 1000)),)
+            # 1-2 VGs exercises the multi-container binpack fill
+            storage = tuple(
+                int(rng.integers(200, 1000)) for _ in range(int(rng.integers(1, 3)))
+            )
+            if rng.random() < 0.5:
+                devices = tuple(
+                    (int(rng.integers(100, 500)), "ssd")
+                    for _ in range(int(rng.integers(1, 4)))
+                )
         cpu = int(rng.choice([16000, 32000, 64000, 96000]))
         mem = int(rng.choice([64, 128, 256, 384]))
         nodes.append(
-            make_node(f"node-{i:06d}", cpu, mem, labels, taints, gpu, storage)
+            make_node(f"node-{i:06d}", cpu, mem, labels, taints, gpu, storage, devices)
         )
     res = ResourceTypes()
     res.nodes = nodes
+    if storage_frac > 0:
+        # the device SCs the pod claims name (media resolved from parameters)
+        res.storage_classes = [
+            {
+                "kind": "StorageClass",
+                "metadata": {"name": "open-local-device-ssd"},
+                "parameters": {"mediaType": "ssd"},
+            },
+            {
+                "kind": "StorageClass",
+                "metadata": {"name": "open-local-device-hdd"},
+                "parameters": {"mediaType": "hdd"},
+            },
+        ]
     return res
 
 
@@ -189,7 +232,10 @@ def synth_apps(
         if roll < gpu_frac:
             kw["gpu_mem_mib"] = int(rng.choice([4096, 8192, 16384]))
         elif roll < gpu_frac + storage_frac:
-            kw["lvm_gib"] = int(rng.integers(5, 40))
+            if rng.random() < 0.3:
+                kw["device_gib"] = int(rng.integers(50, 200))
+            else:
+                kw["lvm_gib"] = int(rng.integers(5, 40))
         if rng.random() < selector_frac:
             kw["node_selector"] = {
                 "topology.kubernetes.io/zone": f"zone-{int(rng.integers(zones))}"
