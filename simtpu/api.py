@@ -173,6 +173,24 @@ class Simulator:
         )
 
     def _schedule_pods(self, pods: Sequence[dict]) -> None:
+        # Only default-scheduler pods enter the *scheduling* path: the
+        # reference's pod informer filters on SchedulerName ==
+        # DefaultSchedulerName (`pkg/simulator/simulator.go:100-104`), so an
+        # unbound pod addressed to a foreign scheduler is never placed and
+        # never reported failed. Pods already bound via spec.nodeName still
+        # occupy capacity regardless of schedulerName (the reference creates
+        # them in the fake cluster; only the event handler is filtered).
+        # (Normalization defaults an *empty* schedulerName, workloads/expand.py,
+        # so only explicitly foreign pods are excluded.)
+        pods = [
+            p
+            for p in pods
+            if (p.get("spec") or {}).get("nodeName")
+            # falsy covers absent, "" and YAML null — Go unmarshals all three
+            # to "" and the scheduler treats "" as the default profile
+            or ((p.get("spec") or {}).get("schedulerName") or C.DEFAULT_SCHEDULER_NAME)
+            == C.DEFAULT_SCHEDULER_NAME
+        ]
         if not pods:
             return
         batch = self._tensorizer.add_pods(pods)

@@ -193,6 +193,10 @@ def _azure_key(src: dict) -> str:
     return f"azure:{src['diskName']}"
 
 
+def _cinder_key(src: dict) -> str:
+    return f"cinder:{src['volumeID']}"
+
+
 def _iscsi_key(src: dict) -> str:
     # upstream conflicts on same IQN *and* same LUN (volume_restrictions.go
     # isVolumeConflict): both participate in the identity
@@ -240,19 +244,30 @@ def pod_volume_conflicts(pod: dict) -> tuple:
     return tuple(sorted(set(rw))), tuple(sorted(set(ro) - set(rw)))
 
 
-#: NodeVolumeLimits classes, in the order of the engine's attach-limit
+#: NodeVolumeLimits classes, in the order of the engine's static attach-limit
 #: columns: (allocatable resource name, default limit when unpublished).
 #: Defaults mirror the in-tree values (`plugins/nodevolumelimits/non_csi.go`
-#: DefaultMaxEBSVolumes / DefaultMaxGCEPDVolumes / DefaultMaxAzureDiskVolumes).
+#: DefaultMaxEBSVolumes / DefaultMaxGCEPDVolumes / DefaultMaxAzureDiskVolumes,
+#: `pkg/volume/util/attach_limit.go` DefaultMaxCinderVolumes). CSI classes are
+#: per-driver and appended dynamically by the Tensorizer
+#: (`plugins/nodevolumelimits/csi.go` — `attachable-volumes-csi-<driver>`).
 ATTACH_CLASSES = (
     ("attachable-volumes-aws-ebs", 39.0),
     ("attachable-volumes-gce-pd", 16.0),
     ("attachable-volumes-azure-disk", 16.0),
+    ("attachable-volumes-cinder", 256.0),
 )
 
 
+def csi_attach_limit_key(driver: str) -> str:
+    """Per-driver CSI limit resource name (`pkg/volume/util/attach_limit.go`
+    GetCSIAttachLimitKey: `attachable-volumes-csi-` prefix, driver appended)."""
+    return f"attachable-volumes-csi-{driver}"
+
+
 def _attachable_source(src_holder: dict) -> tuple:
-    """(volume-key, class-index) of an inline EBS/GCE/Azure source, else None.
+    """(volume-key, class-index) of an inline EBS/GCE/Azure/Cinder source,
+    else None.
 
     Keys are shared with `pod_volume_conflicts` so one interned volume
     identity serves both VolumeRestrictions and NodeVolumeLimits.
@@ -266,6 +281,9 @@ def _attachable_source(src_holder: dict) -> tuple:
     src = src_holder.get("azureDisk")
     if src and src.get("diskName"):
         return _azure_key(src), 2
+    src = src_holder.get("cinder")
+    if src and src.get("volumeID"):
+        return _cinder_key(src), 3
     return None
 
 
@@ -285,6 +303,18 @@ def pv_attachable_source(pv: dict) -> tuple:
     """The PV's attachable (key, class-index), or None (non_csi.go
     filterAttachableVolumes resolves PVC → PV → volume source)."""
     return _attachable_source((pv.get("spec") or {}))
+
+
+def pv_csi_source(pv: dict) -> tuple:
+    """The PV's CSI (volume-key, driver-name), or None.
+
+    CSILimits counts only PVC-backed CSI volumes, keyed by driver +
+    volumeHandle (`plugins/nodevolumelimits/csi.go` filterAttachableVolumes /
+    getCSIDriverInfo); each driver gets its own per-node limit class."""
+    src = (pv.get("spec") or {}).get("csi")
+    if src and src.get("driver") and src.get("volumeHandle"):
+        return f"csi:{src['driver']}:{src['volumeHandle']}", str(src["driver"])
+    return None
 
 
 def pod_owner_kind(pod: dict) -> str:

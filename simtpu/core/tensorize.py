@@ -71,7 +71,9 @@ from .objects import (
     pod_tolerations,
     pod_topology_spread_constraints,
     pod_volume_conflicts,
+    csi_attach_limit_key,
     pv_attachable_source,
+    pv_csi_source,
 )
 from .quantity import parse_quantity
 from .vocab import Interner
@@ -617,6 +619,11 @@ class Tensorizer:
         self._vol_ro_rows: List[Dict[int, bool]] = []
         self._vol_att_rows: List[Dict[int, bool]] = []
         self._vol_class: Dict[int, int] = {}  # vol index → attach class
+        # attach-limit class axis: the static in-tree classes plus one class
+        # per CSI driver seen in a bound PV (csi.go per-driver limit keys);
+        # CSI defaults to no limit — upstream enforces only a published limit
+        self.attach_classes: List[tuple] = list(ATTACH_CLASSES)
+        self._csi_class: Dict[str, int] = {}  # driver → class index
 
     # -- topology ----------------------------------------------------------
 
@@ -904,6 +911,18 @@ class Tensorizer:
             pair = pv_attachable_source(pv)
             if pair is not None:
                 att_pairs.append(pair)
+                continue
+            csi = pv_csi_source(pv)
+            if csi is not None:
+                key, driver = csi
+                cls = self._csi_class.get(driver)
+                if cls is None:
+                    cls = len(self.attach_classes)
+                    self.attach_classes.append(
+                        (csi_attach_limit_key(driver), np.inf)
+                    )
+                    self._csi_class[driver] = cls
+                att_pairs.append((key, cls))
         for key, cls in set(att_pairs):
             w = self.vols.intern(key)
             vatt[w] = True
@@ -984,10 +1003,10 @@ class Tensorizer:
         """[N, C] per-node attach limits: the published `attachable-volumes-*`
         allocatable, or the in-tree default when the key is absent (a
         published 0 stays 0 — upstream only falls back when unset)."""
-        out = np.zeros((len(self.nodes), len(ATTACH_CLASSES)), np.float32)
+        out = np.zeros((len(self.nodes), len(self.attach_classes)), np.float32)
         for i, node in enumerate(self.nodes):
             allocatable = node_allocatable(node)
-            for c, (res, default) in enumerate(ATTACH_CLASSES):
+            for c, (res, default) in enumerate(self.attach_classes):
                 out[i, c] = allocatable.get(res, default)
         return out
 
@@ -1123,7 +1142,7 @@ class Tensorizer:
         for gi, row in enumerate(self._vol_att_rows):
             for w, v in row.items():
                 vol_att[gi, w] = v
-        vol_class_mask = np.zeros((len(ATTACH_CLASSES), w_n), bool)
+        vol_class_mask = np.zeros((len(self.attach_classes), w_n), bool)
         for w, cls in self._vol_class.items():
             vol_class_mask[cls, w] = True
         return ClusterTensors(

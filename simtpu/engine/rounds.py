@@ -21,14 +21,24 @@ can be placed in one round:
    one per-domain segment reduction per round).
 
 Placement is *feasibility-exact* — the caps enforce every hard constraint
-the serial engine enforces for these pods — but score-approximate: scores
-within a round use round-start normalizers, so tie-breaking against the
-serial scan can differ. Runs whose pods interact through hard constraints
-(their labels match their own required (anti-)affinity or DoNotSchedule
-spread constraints), carry extended-resource demands, or are forced/pinned
-fall back to the serial scan pod-by-pod, so correctness never rests on the
-bulk path. Pods a round cannot place are retried through the serial step,
-which also produces their exact failure reason.
+the serial engine enforces for these pods, and nothing is ever overcommitted
+— but score-approximate: scores within a round use round-start normalizers,
+so tie-breaking against the serial scan can differ, and under VG/device
+fragmentation a different packing can strand or save a final pod of a run
+(placed-count divergence bounded to a sliver in the equivalence fuzz;
+the reference itself breaks score ties randomly,
+`core/generic_scheduler.go:188-209`, so exact counts are not reproducible
+even reference-vs-reference). Extended-resource runs ride the bulk path when each
+pod consumes one slot of one container: a single LVM claim (named or
+binpack), a single exclusive-device claim, or gpu_count == 1 without a
+preset gpu-index — per-node intake caps are then sums of per-container slot
+counts, and the greedy fill visits containers tightest-first like the serial
+kernels. Runs whose pods interact through hard constraints (their labels
+match their own required (anti-)affinity or DoNotSchedule spread
+constraints), carry multi-claim / multi-GPU / preset-index demands, or are
+forced/pinned fall back to the serial scan pod-by-pod, so correctness never
+rests on the bulk path. Pods a round cannot place are retried through the
+serial step, which also produces their exact failure reason.
 
 The reference has no analog — it schedules strictly pod-at-a-time
 (`pkg/simulator/simulator.go:219-244`); this is the TPU-shaped replacement
@@ -57,6 +67,15 @@ from .scan import (
 # at import time, before callers can pick a platform
 _NEG = -3.4e38
 _BIG = 3.4e38
+
+
+def _floor_slots(free: jnp.ndarray, size) -> jnp.ndarray:
+    """floor(free / size) guarded against f32 division rounding up across an
+    integer boundary (the serial kernels' compare-and-subtract never
+    overshoots): if the admitted count would exceed the free space, drop one
+    slot. Degenerate lanes (size 0/negative free) are masked by the caller."""
+    c = jnp.floor(free / jnp.maximum(size, 1e-30))
+    return jnp.where(c * size > free, c - 1.0, c)
 
 
 def _fill_order(cap_x: jnp.ndarray, free_x: jnp.ndarray):
@@ -111,7 +130,6 @@ def _round_core(
         gpu_preset,
     ) = pod
     f = flags
-    n = statics.alloc.shape[0]
     # the topology count state is only read when some topology feature is
     # compiled in — skip its (scatter-heavy) update entirely otherwise
     use_topo = f.spread_hard or f.spread_soft or f.selector_spread or f.interpod_req or f.interpod_pref
@@ -161,7 +179,7 @@ def _round_core(
         )
         c_vg = jnp.where(
             has_lvm & elig_vg & (state.vg_free >= l_size),
-            jnp.floor(state.vg_free / jnp.maximum(l_size, 1e-30)),
+            _floor_slots(state.vg_free, l_size),
             0.0,
         )
         cap = jnp.where(has_lvm, jnp.minimum(cap, jnp.sum(c_vg, axis=1)), cap)
@@ -186,9 +204,7 @@ def _round_core(
         is_gpu = gpu_mem > 0
         free_g = jnp.where(statics.gpu_dev_exists, state.gpu_free, -1.0)
         c_gpu = jnp.where(
-            is_gpu & (free_g >= gpu_mem),
-            jnp.floor(free_g / jnp.maximum(gpu_mem, 1e-30)),
-            0.0,
+            is_gpu & (free_g >= gpu_mem), _floor_slots(free_g, gpu_mem), 0.0
         )
         cap = jnp.where(is_gpu, jnp.minimum(cap, jnp.sum(c_gpu, axis=1)), cap)
         ord_gpu, cs_gpu, cum_gpu = _fill_order(c_gpu, free_g)
@@ -207,13 +223,10 @@ def _round_core(
     # improving) fills one node until capacity under serial semantics, which
     # slope 0 reproduces up to ties. The 1e6 ceiling keeps pathological
     # per-pod drops (free crossing zero) on a finite search range.
-    base = ev.score
-    if f.storage:
-        # ev.score carries the per-node Open-Local binpack term that score1
-        # lacks; take the slope storage-free so the within-round sequence
-        # stays arithmetic (the binpack term still ranks nodes through s0)
-        base = score_pod(statics, state, g, req, ev.m_all, flags)
-    slope = jnp.clip(jnp.where(ev.m_all, base - score1, 0.0), 0.0, 1e6)
+    # the slope is taken storage-free (ev.score carries the per-node
+    # Open-Local binpack term that score1 lacks) so the within-round sequence
+    # stays arithmetic; the binpack term still ranks nodes through s0
+    slope = jnp.clip(jnp.where(ev.m_all, ev.score_nostorage - score1, 0.0), 0.0, 1e6)
     s0 = jnp.where(ev.m_all, ev.score, _NEG)
 
     # -- threshold search: pick the kf best virtual placements ------------
@@ -601,5 +614,9 @@ class RoundsEngine(Engine):
                     statics, state, pods, a2, b2, flags
                 )
                 nodes[a2:b2], reasons[a2:b2] = outs[0], outs[1]
+                # a leftover CAN still place (e.g. a cross-group spread
+                # constraint relaxed by intervening placements) — keep its
+                # extended-resource plans for the host-side logs/annotations
+                lvm_alloc[a2:b2], dev_take[a2:b2], gpu_shares[a2:b2] = outs[2:5]
         return state, (nodes, reasons, lvm_alloc, dev_take, gpu_shares)
 

@@ -311,6 +311,7 @@ class StepEval(NamedTuple):
     m_spread: jnp.ndarray
     m_all: jnp.ndarray
     score: jnp.ndarray  # [N], -inf outside m_all
+    score_nostorage: jnp.ndarray  # [N] score minus the Open-Local term
     lvm_alloc: jnp.ndarray  # [N, V]
     dev_take: jnp.ndarray  # [N, SD]
     gpu_shares: jnp.ndarray  # [N, GD]
@@ -342,7 +343,6 @@ def score_pod(
     req,
     m_all,
     flags: StepFlags = StepFlags(),
-    storage_raw=None,
 ) -> jnp.ndarray:
     """The combined score sum for one pod spec over all nodes, -inf outside
     `m_all` (weights: registry.go:101-145 + Simon extension, overridable via
@@ -350,13 +350,11 @@ def score_pod(
 
     Every term skipped by a False flag is constant across nodes for such
     problems (normalizers map all-zero raw scores to a constant), so pruning
-    preserves the argmax exactly. `storage_raw` is the raw Open-Local score
-    (computed by the filter pass, which owns the storage plans); None drops
-    the term — argmax-neutral for pods without storage demand.
-
-    Shared by the filter cascade (`filter_and_score`) and the bulk rounds
-    engine's slope re-score (`engine/rounds.py`), which evaluates it on a
-    hypothetical state without re-running the filters.
+    preserves the argmax exactly. The Open-Local storage term is NOT included
+    here — `filter_and_score` owns the storage plans and adds it into
+    `StepEval.score`, keeping the storage-free base (`score_nostorage`)
+    available to the bulk rounds engine's slope re-score (`engine/rounds.py`)
+    without a second full pass.
     """
     f = flags
     t_cap = statics.g_terms.shape[1]
@@ -398,9 +396,6 @@ def score_pod(
     # ImageLocality + NodePreferAvoidPods (static per group)
     if f.static_score:
         score += w_[9] * statics.static_score[g] + w_[11] * statics.avoid_pen[g]
-    # Open-Local score (binpack; plugin weight 1)
-    if storage_raw is not None:
-        score += w_[10] * minmax_normalize(storage_raw, m_all)
     return jnp.where(m_all, score, -jnp.inf)
 
 
@@ -523,7 +518,11 @@ def filter_and_score(
         )
     feasible = jnp.any(m_all)
 
-    storage_raw = None
+    # the Open-Local term is computed outside score_pod so the storage-free
+    # base score comes for free (the bulk rounds engine needs it for its
+    # within-round slope without a second full score pass)
+    score = score_pod(statics, state, g, req, m_all, flags)
+    storage_term = 0.0
     if f.storage:
         storage_raw = open_local_score(
             lvm_alloc,
@@ -532,7 +531,7 @@ def filter_and_score(
             jnp.sum(lvm_size > 0),
             jnp.sum(dev_size > 0),
         )
-    score = score_pod(statics, state, g, req, m_all, flags, storage_raw)
+        storage_term = statics.score_w[10] * minmax_normalize(storage_raw, m_all)
 
     return StepEval(
         m_static=m_static,
@@ -545,7 +544,8 @@ def filter_and_score(
         m_gpu=m_gpu,
         m_spread=m_spread,
         m_all=m_all,
-        score=score,
+        score=score + storage_term,
+        score_nostorage=score,
         lvm_alloc=lvm_alloc,
         dev_take=dev_take,
         gpu_shares=gpu_shares,

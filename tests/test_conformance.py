@@ -584,3 +584,61 @@ class TestSimulate:
             n for p, n in placements.items() if p.startswith("frontend")
         }
         assert frontend_nodes == backend_nodes
+
+
+class TestSchedulerNameGating:
+    """Only `default-scheduler` pods enter the simulation — the reference's
+    pod informer filters on SchedulerName (`pkg/simulator/simulator.go:100-104`),
+    so a foreign-scheduler pod is neither placed nor reported unschedulable."""
+
+    def test_foreign_scheduler_pod_excluded(self):
+        from .fixtures import make_fake_node, make_fake_pod
+
+        nodes = [make_fake_node("n0", "8", "16Gi")]
+        ours = make_fake_pod("ours", "default", "1", "1Gi")
+        foreign = make_fake_pod("foreign", "default", "1", "1Gi")
+        foreign["spec"]["schedulerName"] = "volcano"
+        result = simulate(
+            ResourceTypes(nodes=nodes, pods=[ours, foreign]), []
+        )
+        placed = {name_of(p) for st in result.node_status for p in st.pods}
+        assert placed == {"ours"}
+        assert not result.unscheduled_pods
+
+    def test_bound_foreign_pod_still_occupies_capacity(self):
+        # a pod already bound via spec.nodeName consumes node resources
+        # regardless of schedulerName — the reference creates bound pods in
+        # the fake cluster unconditionally; only the event handler is filtered
+        from .fixtures import make_fake_node, make_fake_pod
+
+        nodes = [make_fake_node("n0", "8", "16Gi")]
+        bound = make_fake_pod("bound", "default", "6", "1Gi")
+        bound["spec"]["schedulerName"] = "volcano"
+        bound["spec"]["nodeName"] = "n0"
+        big = make_fake_pod("big", "default", "6", "1Gi")
+        result = simulate(ResourceTypes(nodes=nodes, pods=[bound, big]), [])
+        placed = {name_of(p) for st in result.node_status for p in st.pods}
+        assert "bound" in placed
+        # only 2 CPU remain after the bound pod — "big" must fail
+        assert [name_of(u.pod) for u in result.unscheduled_pods] == ["big"]
+
+    def test_empty_scheduler_name_defaults_to_ours(self):
+        from .fixtures import make_fake_node, make_fake_pod
+
+        nodes = [make_fake_node("n0", "8", "16Gi")]
+        pod = make_fake_pod("p0", "default", "1", "1Gi")
+        pod["spec"]["schedulerName"] = ""
+        result = simulate(ResourceTypes(nodes=nodes, pods=[pod]), [])
+        placed = {name_of(p) for st in result.node_status for p in st.pods}
+        assert placed == {"p0"}
+
+    def test_null_scheduler_name_defaults_to_ours(self):
+        # YAML `schedulerName: null` unmarshals to "" in Go — treated as ours
+        from .fixtures import make_fake_node, make_fake_pod
+
+        nodes = [make_fake_node("n0", "8", "16Gi")]
+        pod = make_fake_pod("p0", "default", "1", "1Gi")
+        pod["spec"]["schedulerName"] = None
+        result = simulate(ResourceTypes(nodes=nodes, pods=[pod]), [])
+        placed = {name_of(p) for st in result.node_status for p in st.pods}
+        assert placed == {"p0"}

@@ -262,6 +262,139 @@ class TestNodeVolumeLimits:
         assert len(_placements(result)) == 1
         assert not result.unscheduled_pods
 
+    def test_cinder_published_limit_enforced(self):
+        # CinderLimits (`nodevolumelimits/non_csi.go` cinderVolumeFilter):
+        # inline cinder volumes count against attachable-volumes-cinder
+        node = make_fake_node(
+            "n0", "32", "64Gi", with_allocatable("attachable-volumes-cinder", "1")
+        )
+        pods = [
+            make_fake_pod(
+                f"p{i}",
+                "default",
+                "1",
+                "1Gi",
+                with_volume({"name": "d", "cinder": {"volumeID": f"cv-{i}"}}),
+            )
+            for i in range(2)
+        ]
+        result = simulate(ResourceTypes(nodes=[node], pods=pods), [])
+        assert len(_placements(result)) == 1
+        assert len(result.unscheduled_pods) == 1
+        assert "max volume count" in result.unscheduled_pods[0].reason
+
+    def test_cinder_default_limit_when_unpublished(self):
+        # DefaultMaxCinderVolumes = 256 (`pkg/volume/util/attach_limit.go`)
+        node = make_fake_node("n0", "32", "64Gi")
+        pod = make_fake_pod(
+            "p0",
+            "default",
+            "1",
+            "1Gi",
+            with_volume({"name": "a", "cinder": {"volumeID": "cv-a"}}),
+        )
+        result = simulate(ResourceTypes(nodes=[node], pods=[pod]), [])
+        assert len(_placements(result)) == 1
+
+    def test_csi_per_driver_limit_enforced(self):
+        # CSILimits (`nodevolumelimits/csi.go`): PVC-backed CSI volumes count
+        # against the per-driver `attachable-volumes-csi-<driver>` allocatable
+        node = make_fake_node(
+            "n0",
+            "32",
+            "64Gi",
+            with_allocatable("attachable-volumes-csi-ebs.csi.aws.com", "1"),
+        )
+        pvs = [
+            {
+                "kind": "PersistentVolume",
+                "metadata": {"name": f"pv-{i}"},
+                "spec": {
+                    "csi": {
+                        "driver": "ebs.csi.aws.com",
+                        "volumeHandle": f"vol-{i}",
+                    }
+                },
+            }
+            for i in range(2)
+        ]
+        pvcs = [_pvc(f"claim-{i}", volume_name=f"pv-{i}") for i in range(2)]
+        pod = make_fake_pod("p0", "default", "1", "1Gi")
+        pod["spec"]["volumes"] = [
+            {"name": f"v{i}", "persistentVolumeClaim": {"claimName": f"claim-{i}"}}
+            for i in range(2)
+        ]
+        tz = Tensorizer([node], pvcs=pvcs, pvs=pvs)
+        batch = tz.add_pods([pod])
+        tensors = tz.freeze()
+        g = batch.group[0]
+        assert tensors.vol_att[g].sum() == 2
+        # the dynamic CSI class was appended after the 4 static classes
+        csi_cls = tz._csi_class["ebs.csi.aws.com"]
+        assert csi_cls == 4
+        assert tensors.attach_limits[0, csi_cls] == 1.0
+        from simtpu.engine.scan import FAIL_ATTACH, Engine
+
+        nodes_out, reasons, _ = Engine(tz).place(batch)
+        assert nodes_out[0] == -1 and int(reasons[0]) == FAIL_ATTACH
+
+    def test_csi_unpublished_limit_is_unbounded(self):
+        # upstream enforces a CSI limit only when the node publishes one (via
+        # CSINode); an unpublished driver key imposes no cap
+        node = make_fake_node("n0", "32", "64Gi")
+        pvs = [
+            {
+                "kind": "PersistentVolume",
+                "metadata": {"name": f"pv-{i}"},
+                "spec": {
+                    "csi": {"driver": "pd.csi.storage.gke.io", "volumeHandle": f"h-{i}"}
+                },
+            }
+            for i in range(3)
+        ]
+        pvcs = [_pvc(f"claim-{i}", volume_name=f"pv-{i}") for i in range(3)]
+        pod = make_fake_pod("p0", "default", "1", "1Gi")
+        pod["spec"]["volumes"] = [
+            {"name": f"v{i}", "persistentVolumeClaim": {"claimName": f"claim-{i}"}}
+            for i in range(3)
+        ]
+        tz = Tensorizer([node], pvcs=pvcs, pvs=pvs)
+        batch = tz.add_pods([pod])
+        from simtpu.engine.scan import Engine
+
+        nodes_out, _, _ = Engine(tz).place(batch)
+        assert nodes_out[0] == 0
+
+    def test_csi_drivers_have_independent_classes(self):
+        # one driver's saturation must not block another driver's volumes
+        node = make_fake_node(
+            "n0",
+            "32",
+            "64Gi",
+            with_allocatable("attachable-volumes-csi-a.example.com", "0"),
+            with_allocatable("attachable-volumes-csi-b.example.com", "1"),
+        )
+        pvs = [
+            {
+                "kind": "PersistentVolume",
+                "metadata": {"name": "pv-b"},
+                "spec": {
+                    "csi": {"driver": "b.example.com", "volumeHandle": "h-b"}
+                },
+            }
+        ]
+        pvcs = [_pvc("claim-b", volume_name="pv-b")]
+        pod = make_fake_pod("p0", "default", "1", "1Gi")
+        pod["spec"]["volumes"] = [
+            {"name": "v", "persistentVolumeClaim": {"claimName": "claim-b"}}
+        ]
+        tz = Tensorizer([node], pvcs=pvcs, pvs=pvs)
+        batch = tz.add_pods([pod])
+        from simtpu.engine.scan import Engine
+
+        nodes_out, _, _ = Engine(tz).place(batch)
+        assert nodes_out[0] == 0
+
 
 def _raw_pod_with_pvc(name, claim):
     """A pod dict fed straight to the Tensorizer (no normalization)."""
