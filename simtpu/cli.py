@@ -59,6 +59,7 @@ def cmd_apply(args: argparse.Namespace) -> int:
         extended_resources=args.extended_resources or [],
         search=args.search,
         bulk=args.bulk,
+        corrected_ds_overhead=args.corrected_ds_overhead,
     )
     try:
         applier = Applier(opts)
@@ -146,6 +147,13 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="place replica runs with the bulk rounds engine (faster on "
         "large app lists; tie-breaking may differ from the serial scan)",
+    )
+    apply_p.add_argument(
+        "--corrected-ds-overhead",
+        action="store_true",
+        help="account daemonset overhead on the template node in the "
+        "can-ever-fit diagnostic (the reference pins its probe pod to a node "
+        "named 'simon', so the overhead silently contributes nothing)",
     )
     apply_p.set_defaults(func=cmd_apply)
 

@@ -129,20 +129,26 @@ def satisfy_resource_setting(result: SimulateResult) -> (bool, str):
     return True, ""
 
 
-def meet_resource_requests(node: dict, pod: dict, daemon_sets: Sequence[dict]) -> bool:
+def meet_resource_requests(
+    node: dict, pod: dict, daemon_sets: Sequence[dict], corrected: bool = False
+) -> bool:
     """Could the new-node template EVER hold this pod, once its daemonsets are
     accounted for? (`pkg/utils/utils.go:768-818`).
 
-    Reference quirk preserved: the probe daemon pod is pinned to a node named
-    `simon` (`utils.go:777` passes NewNodeNamePrefix as the node name), so
-    unless the template node is literally named "simon" the matchFields pin
-    fails NodeShouldRunPod and daemonset overhead contributes nothing.
+    Reference quirk preserved by default: the probe daemon pod is pinned to a
+    node named `simon` (`utils.go:777` passes NewNodeNamePrefix as the node
+    name), so unless the template node is literally named "simon" the
+    matchFields pin fails NodeShouldRunPod and daemonset overhead contributes
+    nothing — a DS-heavy cluster under-provisions exactly like the reference.
+    `corrected=True` pins the probe pod to the template node's own name so
+    the overhead is actually accounted (opt-in via `--corrected-ds-overhead`).
     """
     import json
 
+    probe_name = name_of(node) if corrected else C.NEW_NODE_NAME_PREFIX
     total_cpu = total_mem = 0.0
     for ds in daemon_sets:
-        daemon_pod = new_daemon_pod(ds, C.NEW_NODE_NAME_PREFIX)
+        daemon_pod = new_daemon_pod(ds, probe_name)
         if node_should_run_pod(node, daemon_pod):
             req = pod_requests(daemon_pod)
             total_cpu += req.get("cpu", 0.0)
@@ -184,6 +190,7 @@ def plan_capacity(
     progress: Optional[Callable[[str], None]] = None,
     bulk: bool = False,
     sched_config=None,
+    corrected_ds_overhead: bool = False,
 ) -> PlanResult:
     """Find the minimum clone count of `new_node` that deploys everything."""
     say = progress or (lambda s: None)
@@ -217,7 +224,9 @@ def plan_capacity(
                     "the pod cannot be scheduled successfully by adding node: "
                     "pod does not fit new node affinity or taints"
                 )
-            if not meet_resource_requests(new_node, pod, all_daemon_sets):
+            if not meet_resource_requests(
+                new_node, pod, all_daemon_sets, corrected=corrected_ds_overhead
+            ):
                 return (
                     f"failed to schedule pod {namespace_of(pod)}/{name_of(pod)}: "
                     "new node cannot meet resource requests of pod: the total "
@@ -308,6 +317,9 @@ class ApplierOptions:
     extended_resources: Sequence[str] = ()
     search: str = "binary"
     bulk: bool = False  # place replica runs with the bulk rounds engine
+    # account daemonset overhead on the template node in the can-ever-fit
+    # diagnostic (off = faithful to the reference's NewNodeNamePrefix quirk)
+    corrected_ds_overhead: bool = False
 
 
 class Applier:
@@ -394,6 +406,7 @@ class Applier:
                 progress=progress,
                 bulk=self.opts.bulk,
                 sched_config=self._sched_config(),
+                corrected_ds_overhead=self.opts.corrected_ds_overhead,
             )
         timings["plan"] = _time.perf_counter() - t0
         plan.timings = timings

@@ -153,3 +153,44 @@ class TestMeetResourceRequests:
         assert not meet_resource_requests(simon_node, pod, [ds])
         light = make_fake_pod("p2", "default", "1", "1Gi")
         assert meet_resource_requests(simon_node, light, [ds])
+
+    def test_corrected_mode_accounts_ds_overhead_on_any_template(self):
+        """`corrected=True` pins the probe daemon pod to the template node's
+        own name, so DS overhead counts regardless of the template's name —
+        contrast with the reference-bug default above."""
+        from .fixtures import make_fake_daemon_set
+
+        ds = make_fake_daemon_set("heavy-ds", "kube-system", "3", "1Gi")
+        pod = make_fake_pod("p", "default", "2", "1Gi")
+        template = make_fake_node("worker-template", "4", "8Gi")
+        # reference-bug default: overhead ignored, the probe passes
+        assert meet_resource_requests(template, pod, [ds])
+        # corrected: 3 (ds) + 2 (pod) > 4 cpu → can never fit
+        assert not meet_resource_requests(template, pod, [ds], corrected=True)
+        light = make_fake_pod("p2", "default", "1", "1Gi")
+        assert meet_resource_requests(template, light, [ds], corrected=True)
+
+    def test_corrected_flag_changes_plan_diagnostic(self):
+        """End-to-end: a DS-heavy cluster where the default mode keeps adding
+        nodes forever (pod alone fits the template) but the corrected mode
+        diagnoses up front that adding nodes can never help."""
+        from .fixtures import make_fake_daemon_set
+
+        cluster = _small_cluster()
+        # the DS fits every node alone (3 <= 4 cpu) but crowds out the app
+        # pod: each added template clone schedules its DS pod first, leaving
+        # 1 cpu for the 2-cpu app pod
+        cluster.daemon_sets = [
+            make_fake_daemon_set("heavy-ds", "kube-system", "3", "1Gi")
+        ]
+        app = _app(1, "2", "4Gi")  # 3 (ds) + 2 (pod) > 4 cpu template
+        plan = plan_capacity(
+            cluster, [app], TEMPLATE, max_new_nodes=4, corrected_ds_overhead=True
+        )
+        assert not plan.success
+        assert "cannot meet resource requests" in plan.message
+        # reference-bug default: the diagnostic never fires; the plan walks
+        # to the cap and reports the max-iteration failure instead
+        plan = plan_capacity(cluster, [app], TEMPLATE, max_new_nodes=4)
+        assert not plan.success
+        assert "cannot meet resource requests" not in plan.message
