@@ -99,6 +99,7 @@ class Simulator:
         self._preempted: List[PreemptedPod] = []
         self._unscheduled: List[UnscheduledPod] = []
         self._storage_classes: List[dict] = []
+        self._pdbs: List[dict] = []
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -107,6 +108,10 @@ class Simulator:
         (`pkg/simulator/simulator.go:159-164,251-332`)."""
         self._nodes = [deep_copy(n) for n in cluster.nodes]
         self._storage_classes = list(cluster.storage_classes)
+        # cluster PDBs constrain preemption (syncClusterResourceList creates
+        # them, `pkg/simulator/simulator.go:253-258`; app PDBs are never
+        # created — GenerateValidPodsFromAppResources generates pods only)
+        self._pdbs = [deep_copy(p) for p in cluster.pod_disruption_budgets]
         self._tensorizer = Tensorizer(
             self._nodes,
             self._extra_resources,
@@ -215,17 +220,26 @@ class Simulator:
         """Evict lower-priority placed pods to make room, then retry.
 
         Mirrors the DefaultPreemption flow: find candidate nodes where
-        removing victims (lowest priority first, most recent first on ties)
-        plausibly fits the pod, pick the node minimizing (highest victim
-        priority, summed priorities, victim count) —
-        `defaultpreemption/default_preemption.go` pickOneNodeForPreemption —
-        evict, and re-run the real filter pipeline; the eviction is undone if
-        the retry still fails, so the cheap host-side victim model only needs
-        to *propose* sets, never to be exact. PDB-violation counting is not
-        modeled (the simulation has no live disruption controller). Victims
-        are reported in `SimulateResult.preempted_pods`, not re-queued.
+        removing victims plausibly fits the pod, pick the node minimizing
+        (PDB violations, highest victim priority, summed priorities, victim
+        count) — `defaultpreemption/default_preemption.go`
+        pickOneNodeForPreemption — evict, and re-run the real filter
+        pipeline; the eviction is undone if the retry still fails, so the
+        cheap host-side victim model only needs to *propose* sets, never to
+        be exact. Victim greed prefers PDB-free pods (lowest priority first,
+        most recent first on ties) the way the reference reprieves
+        PDB-violating victims preferentially (selectVictimsOnNode,
+        default_preemption.go:639-668), and the violation count follows
+        filterPodsWithPDBViolation's budget accounting: each matching victim
+        decrements the PDB's disruptionsAllowed, violating once it goes
+        negative. The simulation runs no disruption controller, so the
+        budget is `status.disruptionsAllowed` as ingested (absent = 0, like
+        the reference's fake cluster). Victims are reported in
+        `SimulateResult.preempted_pods`, not re-queued.
         """
         import numpy as np
+
+        from .core.objects import labels_of
 
         if reason not in _PREEMPTIBLE_REASONS or not self._engine.placed_node:
             return False
@@ -269,6 +283,51 @@ class Simulator:
         )
         lvm_need = float(np.sum(probe.ext["lvm_size"][0]))
 
+        # PDB bookkeeping (filterPodsWithPDBViolation semantics): a PDB with
+        # a nil or EMPTY selector matches nothing here — unlike the general
+        # LabelSelector rule — and unlabeled pods match no PDB
+        pdb_list = [
+            (
+                namespace_of(p),
+                (p.get("spec") or {}).get("selector"),
+                int(((p.get("status") or {}).get("disruptionsAllowed")) or 0),
+            )
+            for p in self._pdbs
+        ]
+        _pdb_cache: dict = {}
+
+        def pdbs_matching(i: int) -> tuple:
+            got = _pdb_cache.get(i)
+            if got is None:
+                from .core.match import match_label_selector
+
+                victim = self._scheduled[i]
+                labels = labels_of(victim)
+                got = tuple(
+                    j
+                    for j, (ns, sel, _) in enumerate(pdb_list)
+                    if labels
+                    and ns == namespace_of(victim)
+                    and sel
+                    and (sel.get("matchLabels") or sel.get("matchExpressions"))
+                    and match_label_selector(sel, labels)
+                )
+                _pdb_cache[i] = got
+            return got
+
+        def pdb_violations(victim_idx) -> int:
+            """How many victims push a matching PDB's budget negative."""
+            allowed = [a for (_, _, a) in pdb_list]
+            count = 0
+            for i in victim_idx:
+                violated = False
+                for j in pdbs_matching(i):
+                    allowed[j] -= 1
+                    if allowed[j] < 0:
+                        violated = True
+                count += violated
+            return count
+
         def victim_helps(i: int) -> bool:
             vg = placed_groups[i]
             if reason == FAIL_PORTS:
@@ -310,8 +369,23 @@ class Simulator:
             cand = [int(i) for i in cand if victim_helps(int(i))]
             if not cand:
                 continue
-            # lowest priority first, later placements first on ties
-            cand.sort(key=lambda i: (prios[i], -i))
+            # budget-aware reprieve split (filterPodsWithPDBViolation over
+            # the node's potential victims in MoreImportantPod order): a
+            # victim whose PDB budget still absorbs the eviction is
+            # NON-violating and ranks purely by priority; then greedy order =
+            # non-violating first, lowest priority first, later placements
+            # first on ties
+            allowed_n = [a for (_, _, a) in pdb_list]
+            violating = set()
+            for i in sorted(cand, key=lambda i: (-prios[i], i)):
+                viol = False
+                for j in pdbs_matching(i):
+                    allowed_n[j] -= 1
+                    if allowed_n[j] < 0:
+                        viol = True
+                if viol:
+                    violating.add(i)
+            cand.sort(key=lambda i: (i in violating, prios[i], -i))
             on_node = np.flatnonzero(placed_nodes == n)
             gpu_free = float(np.sum(tz.ext.gpu_dev_total[n])) - sum(
                 float(np.sum(ext_log["gpu_shares"][i])) * ext_log["gpu_mem"][i]
@@ -348,6 +422,7 @@ class Simulator:
                 continue
             varr = np.asarray(victims)
             key = (
+                pdb_violations(victims),  # pickOneNode criterion 1
                 float(prios[varr].max()),
                 float(prios[varr].sum()),
                 len(victims),

@@ -177,6 +177,7 @@ def plan_capacity_batched(
     mesh=None,
     progress=None,
     sched_config=None,
+    corrected_ds_overhead: bool = False,
 ):
     """Batched replacement for the serial min-node-add search.
 
@@ -212,6 +213,7 @@ def plan_capacity_batched(
             search="binary",
             progress=progress,
             sched_config=sched_config,
+            corrected_ds_overhead=corrected_ds_overhead,
         )
     from ..plan.capacity import new_fake_nodes
 

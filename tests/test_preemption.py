@@ -128,3 +128,133 @@ def test_static_failures_never_preempt():
     result = simulate(ResourceTypes(nodes=[node], pods=[filler, vip]))
     assert not result.preempted_pods
     assert len(result.unscheduled_pods) == 1
+
+
+def _with_labels(pod, labels):
+    pod["metadata"]["labels"] = dict(labels)
+    return pod
+
+
+def _pdb(name, ns, match_labels, allowed=0):
+    return {
+        "apiVersion": "policy/v1beta1",
+        "kind": "PodDisruptionBudget",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"selector": {"matchLabels": dict(match_labels)}},
+        "status": {"disruptionsAllowed": allowed},
+    }
+
+
+def test_pdb_flips_the_chosen_victim_node():
+    """pickOneNode criterion 1: a node whose victim set violates no PDB wins
+    over an otherwise-identical node whose victim is PDB-covered
+    (`default_preemption.go` pickOneNodeForPreemption + 
+    filterPodsWithPDBViolation)."""
+    n0 = make_fake_node("n0", "4", "16Gi")
+    n1 = make_fake_node("n1", "4", "16Gi")
+    covered = _with_labels(
+        _prio(make_fake_pod("covered", "default", "4", "1Gi"), 0),
+        {"app": "critical-db"},
+    )
+    covered["spec"]["nodeName"] = "n0"
+    free = _prio(make_fake_pod("free", "default", "4", "1Gi"), 0)
+    free["spec"]["nodeName"] = "n1"
+    vip = _prio(make_fake_pod("vip", "default", "3", "1Gi"), 100)
+    pdb = _pdb("db-pdb", "default", {"app": "critical-db"}, allowed=0)
+    cluster = ResourceTypes(nodes=[n0, n1], pods=[covered, free, vip])
+    cluster.pod_disruption_budgets = [pdb]
+    result = simulate(cluster)
+    placed = _placements(result)
+    # without the PDB, the tie-break key is identical for both nodes and the
+    # lowest node index (n0) would win; the PDB flips the choice to n1
+    assert placed.get("vip") == "n1"
+    assert [p.pod["metadata"]["name"] for p in result.preempted_pods] == ["free"]
+    assert placed.get("covered") == "n0"
+
+
+def test_pdb_budget_permits_disruption():
+    """A PDB with disruptionsAllowed >= victims does not penalize the node."""
+    n0 = make_fake_node("n0", "4", "16Gi")
+    n1 = make_fake_node("n1", "4", "16Gi")
+    covered = _with_labels(
+        _prio(make_fake_pod("covered", "default", "4", "1Gi"), 0),
+        {"app": "web"},
+    )
+    covered["spec"]["nodeName"] = "n0"
+    # n1's victim has HIGHER priority, so n0 wins on criterion 2 once its
+    # budgeted PDB contributes zero violations
+    pricey = _prio(make_fake_pod("pricey", "default", "4", "1Gi"), 50)
+    pricey["spec"]["nodeName"] = "n1"
+    vip = _prio(make_fake_pod("vip", "default", "3", "1Gi"), 100)
+    cluster = ResourceTypes(nodes=[n0, n1], pods=[covered, pricey, vip])
+    cluster.pod_disruption_budgets = [_pdb("web-pdb", "default", {"app": "web"}, allowed=1)]
+    result = simulate(cluster)
+    placed = _placements(result)
+    assert placed.get("vip") == "n0"
+    assert [p.pod["metadata"]["name"] for p in result.preempted_pods] == ["covered"]
+
+
+def test_pdb_prefers_uncovered_victim_within_node():
+    """Victim greed keeps PDB-covered pods placed when an uncovered victim
+    suffices (the reference reprieves violating victims preferentially)."""
+    node = make_fake_node("n0", "6", "16Gi")
+    covered = _with_labels(
+        _prio(make_fake_pod("covered", "default", "2", "1Gi"), 0),
+        {"app": "db"},
+    )
+    free = _prio(make_fake_pod("free", "default", "2", "1Gi"), 0)
+    vip = _prio(make_fake_pod("vip", "default", "4", "1Gi"), 100)
+    cluster = ResourceTypes(nodes=[node], pods=[covered, free, vip])
+    cluster.pod_disruption_budgets = [_pdb("db-pdb", "default", {"app": "db"}, allowed=0)]
+    result = simulate(cluster)
+    placed = _placements(result)
+    assert placed.get("vip") == "n0"
+    assert [p.pod["metadata"]["name"] for p in result.preempted_pods] == ["free"]
+    assert placed.get("covered") == "n0"
+
+
+def test_empty_pdb_selector_matches_nothing():
+    """filterPodsWithPDBViolation: a PDB with a nil or empty selector
+    matches nothing (unlike the general LabelSelector empty-matches-all)."""
+    n0 = make_fake_node("n0", "4", "16Gi")
+    n1 = make_fake_node("n1", "4", "16Gi")
+    a = _with_labels(_prio(make_fake_pod("a", "default", "4", "1Gi"), 0), {"x": "1"})
+    a["spec"]["nodeName"] = "n0"
+    b = _with_labels(_prio(make_fake_pod("b", "default", "4", "1Gi"), 0), {"x": "2"})
+    b["spec"]["nodeName"] = "n1"
+    vip = _prio(make_fake_pod("vip", "default", "3", "1Gi"), 100)
+    cluster = ResourceTypes(nodes=[n0, n1], pods=[a, b, vip])
+    empty = {
+        "apiVersion": "policy/v1beta1",
+        "kind": "PodDisruptionBudget",
+        "metadata": {"name": "catch-all", "namespace": "default"},
+        "spec": {"selector": {}},
+        "status": {"disruptionsAllowed": 0},
+    }
+    cluster.pod_disruption_budgets = [empty]
+    result = simulate(cluster)
+    placed = _placements(result)
+    # no PDB matches: plain tie-break picks the lowest node index
+    assert placed.get("vip") == "n0"
+    assert [p.pod["metadata"]["name"] for p in result.preempted_pods] == ["a"]
+
+
+def test_pdb_with_budget_does_not_penalize_covered_victim():
+    """Budget-aware reprieve split: a victim whose PDB still absorbs the
+    eviction (disruptionsAllowed=1) is NON-violating and ranks purely by
+    priority — the priority-0 covered pod is evicted, not the priority-50
+    uncovered one."""
+    node = make_fake_node("n0", "6", "16Gi")
+    covered = _with_labels(
+        _prio(make_fake_pod("covered", "default", "2", "1Gi"), 0),
+        {"app": "web"},
+    )
+    pricey = _prio(make_fake_pod("pricey", "default", "2", "1Gi"), 50)
+    vip = _prio(make_fake_pod("vip", "default", "4", "1Gi"), 100)
+    cluster = ResourceTypes(nodes=[node], pods=[covered, pricey, vip])
+    cluster.pod_disruption_budgets = [_pdb("web-pdb", "default", {"app": "web"}, allowed=1)]
+    result = simulate(cluster)
+    placed = _placements(result)
+    assert placed.get("vip") == "n0"
+    assert [p.pod["metadata"]["name"] for p in result.preempted_pods] == ["covered"]
+    assert placed.get("pricey") == "n0"
