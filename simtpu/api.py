@@ -285,7 +285,10 @@ class Simulator:
 
         # PDB bookkeeping (filterPodsWithPDBViolation semantics): a PDB with
         # a nil or EMPTY selector matches nothing here — unlike the general
-        # LabelSelector rule — and unlabeled pods match no PDB
+        # LabelSelector rule — and unlabeled pods match no PDB (upstream
+        # short-circuits on `len(pod.Labels) != 0`,
+        # default_preemption.go:745-746, even though a DoesNotExist selector
+        # would otherwise match them; parity kept deliberately)
         pdb_list = [
             (
                 namespace_of(p),

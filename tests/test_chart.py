@@ -33,8 +33,11 @@ class TestTemplateEngine:
         assert render_template('{{ .Values.missing | default "d" }}', {"Values": {}}) == "d"
 
     def test_unsupported_construct_raises(self):
+        # loud failure outside the subset: unknown functions never render
         with pytest.raises(ChartRenderError):
-            render_template("{{ range .Values.x }}{{ end }}", {}, where="t.yaml")
+            render_template("{{ derivePassword .Values.x }}", {}, where="t.yaml")
+        with pytest.raises(ChartRenderError):
+            render_template("{{ if .x }}no end", {}, where="t.yaml")
 
     def test_missing_value_formats_like_go(self):
         assert render_template("{{ .Values.nope }}", {"Values": {}}) == "<no value>"
@@ -63,3 +66,199 @@ class TestProcessChart:
         docs_a = process_chart("alpha", YODA)
         docs_b = process_chart("yoda", YODA)
         assert len(docs_a) == len(docs_b)
+
+
+class TestControlStructures:
+    """range / with / variables / define-include-template / parens — the
+    full-engine semantics VERDICT r1 task 6 asked for (`pkg/chart/chart.go`
+    links the real Helm v3 engine; this is the offline subset grown to it)."""
+
+    def test_range_list(self):
+        tpl = "{{ range .Values.items }}[{{ . }}]{{ end }}"
+        assert render_template(tpl, {"Values": {"items": ["a", "b"]}}) == "[a][b]"
+
+    def test_range_with_index_and_value_vars(self):
+        tpl = "{{ range $i, $v := .Values.items }}{{ $i }}={{ $v }};{{ end }}"
+        assert render_template(tpl, {"Values": {"items": ["x", "y"]}}) == "0=x;1=y;"
+
+    def test_range_single_var_binds_value(self):
+        tpl = "{{ range $v := .Values.items }}{{ $v }}{{ end }}"
+        assert render_template(tpl, {"Values": {"items": [1, 2, 3]}}) == "123"
+
+    def test_range_map_sorted_keys(self):
+        tpl = "{{ range $k, $v := .Values.m }}{{ $k }}:{{ $v }} {{ end }}"
+        out = render_template(tpl, {"Values": {"m": {"b": 2, "a": 1}}})
+        assert out == "a:1 b:2 "
+
+    def test_range_else_on_empty(self):
+        tpl = "{{ range .Values.items }}x{{ else }}none{{ end }}"
+        assert render_template(tpl, {"Values": {"items": []}}) == "none"
+
+    def test_range_dollar_is_root(self):
+        tpl = "{{ range .Values.items }}{{ $.Release.Name }}-{{ . }} {{ end }}"
+        ctx = {"Values": {"items": ["a"]}, "Release": {"Name": "rel"}}
+        assert render_template(tpl, ctx) == "rel-a "
+
+    def test_with_rebinds_dot(self):
+        tpl = "{{ with .Values.img }}{{ .repo }}:{{ .tag }}{{ end }}"
+        ctx = {"Values": {"img": {"repo": "r", "tag": "t"}}}
+        assert render_template(tpl, ctx) == "r:t"
+
+    def test_with_else_on_falsy(self):
+        tpl = "{{ with .Values.none }}x{{ else }}fallback{{ end }}"
+        assert render_template(tpl, {"Values": {}}) == "fallback"
+
+    def test_variables_declare_assign_scope(self):
+        tpl = (
+            "{{ $x := 1 }}{{ $x }}"
+            "{{ if true }}{{ $x = 2 }}{{ end }}{{ $x }}"
+        )
+        assert render_template(tpl, {}) == "12"
+
+    def test_parenthesized_pipeline(self):
+        tpl = '{{ if and (eq .Values.a "x") (not .Values.b) }}yes{{ end }}'
+        assert render_template(tpl, {"Values": {"a": "x", "b": False}}) == "yes"
+
+    def test_define_include_nindent(self):
+        tpl = (
+            '{{- define "labels" }}app: {{ .Chart.Name }}{{ end -}}'
+            'labels:{{ include "labels" . | nindent 2 }}'
+        )
+        out = render_template(tpl, {"Chart": {"Name": "c"}})
+        assert out == "labels:\n  app: c"
+
+    def test_template_statement(self):
+        tpl = '{{ define "t" }}[{{ . }}]{{ end }}{{ template "t" .Values.x }}'
+        assert render_template(tpl, {"Values": {"x": "v"}}) == "[v]"
+
+    def test_sprig_functions(self):
+        assert render_template('{{ "hello-world" | trunc 5 }}', {}) == "hello"
+        assert render_template('{{ printf "%s-%d" "a" 3 }}', {}) == "a-3"
+        assert render_template('{{ add 1 2 3 }}', {}) == "6"
+        assert render_template('{{ ternary "y" "n" true }}', {}) == "y"
+        assert (
+            render_template('{{ list "a" "b" | join "," }}', {}) == "a,b"
+        )
+        assert render_template('{{ trimSuffix "-x" "name-x" }}', {}) == "name"
+
+    def test_required_raises_on_missing(self):
+        with pytest.raises(ChartRenderError):
+            render_template(
+                '{{ required "a.b is required" .Values.a }}', {"Values": {}}
+            )
+
+    def test_tpl_renders_string(self):
+        tpl = '{{ tpl .Values.t . }}'
+        ctx = {"Values": {"t": "{{ .Release.Name }}"}, "Release": {"Name": "r"}}
+        assert render_template(tpl, ctx) == "r"
+
+
+class TestHelperChart:
+    """A chart exercising `_helpers.tpl` includes + a range loop end-to-end
+    (the VERDICT r1 task 6 'done' bar)."""
+
+    def _write_chart(self, root):
+        (root / "Chart.yaml").write_text(
+            "apiVersion: v2\nname: helper-demo\nversion: 0.1.0\n"
+        )
+        (root / "values.yaml").write_text(
+            "replicas: 2\nports: [8080, 9090]\nlabels:\n  tier: web\n"
+        )
+        tdir = root / "templates"
+        tdir.mkdir()
+        (tdir / "_helpers.tpl").write_text(
+            '{{- define "demo.fullname" -}}\n'
+            '{{- printf "%s-%s" .Release.Name .Chart.Name | trunc 63 | trimSuffix "-" -}}\n'
+            "{{- end -}}\n"
+            '{{- define "demo.labels" -}}\n'
+            "app: {{ .Chart.Name }}\n"
+            "release: {{ .Release.Name }}\n"
+            "{{- range $k, $v := .Values.labels }}\n"
+            "{{ $k }}: {{ $v }}\n"
+            "{{- end }}\n"
+            "{{- end -}}\n"
+        )
+        (tdir / "deployment.yaml").write_text(
+            "apiVersion: apps/v1\n"
+            "kind: Deployment\n"
+            "metadata:\n"
+            '  name: {{ include "demo.fullname" . }}\n'
+            "  labels:\n"
+            '    {{- include "demo.labels" . | nindent 4 }}\n'
+            "spec:\n"
+            "  replicas: {{ .Values.replicas }}\n"
+            "  template:\n"
+            "    spec:\n"
+            "      containers:\n"
+            "        - name: app\n"
+            "          ports:\n"
+            "            {{- range .Values.ports }}\n"
+            "            - containerPort: {{ . }}\n"
+            "            {{- end }}\n"
+        )
+        (tdir / "service.yaml").write_text(
+            "apiVersion: v1\n"
+            "kind: Service\n"
+            "metadata:\n"
+            '  name: {{ include "demo.fullname" . }}\n'
+            "spec:\n"
+            "  ports:\n"
+            "    {{- range $i, $p := .Values.ports }}\n"
+            "    - name: port-{{ $i }}\n"
+            "      port: {{ $p }}\n"
+            "    {{- end }}\n"
+        )
+
+    def test_renders_with_helpers_and_range(self, tmp_path):
+        self._write_chart(tmp_path)
+        docs = [yaml.safe_load(d) for d in process_chart("myapp", str(tmp_path))]
+        assert [d["kind"] for d in docs] == ["Service", "Deployment"]  # InstallOrder
+        svc, dep = docs
+        # chart.go:24 overrides the chart name with the app name, so
+        # .Chart.Name == .Release.Name == "myapp"
+        assert dep["metadata"]["name"] == "myapp-myapp"
+        assert dep["metadata"]["labels"] == {
+            "app": "myapp",
+            "release": "myapp",
+            "tier": "web",
+        }
+        assert dep["spec"]["replicas"] == 2
+        ports = dep["spec"]["template"]["spec"]["containers"][0]["ports"]
+        assert [p["containerPort"] for p in ports] == [8080, 9090]
+        assert [p["port"] for p in svc["spec"]["ports"]] == [8080, 9090]
+        assert [p["name"] for p in svc["spec"]["ports"]] == ["port-0", "port-1"]
+
+    def test_block_renders_with_argument(self):
+        tpl = '{{ block "b" .Values.img }}{{ .repo }}{{ end }}'
+        out = render_template(tpl, {"Values": {"img": {"repo": "r"}}})
+        assert out == "r"
+
+    def test_duplicate_else_rejected(self):
+        with pytest.raises(ChartRenderError):
+            render_template(
+                "{{ range .Values.x }}a{{ else }}b{{ else }}c{{ end }}",
+                {"Values": {"x": []}},
+            )
+        with pytest.raises(ChartRenderError):
+            render_template("{{ if .x }}a{{ else }}b{{ else }}c{{ end }}", {})
+
+    def test_trim_suffix_empty_is_identity(self):
+        assert render_template('{{ trimSuffix "" "abc" }}', {}) == "abc"
+
+    def test_merge_is_deep(self):
+        ctx = {
+            "Values": {
+                "common": {"labels": {"a": "1"}, "x": "keep"},
+                "overrides": {"labels": {"b": "2"}, "x": "lose", "y": "new"},
+            }
+        }
+        out = render_template(
+            "{{ merge .Values.common .Values.overrides | toJson }}", ctx
+        )
+        import json as _json
+
+        assert _json.loads(out) == {
+            "labels": {"a": "1", "b": "2"},
+            "x": "keep",
+            "y": "new",
+        }
