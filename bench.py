@@ -1,6 +1,10 @@
-"""Headline benchmark: bulk placement throughput (the rounds engine, end to
-end on a fresh engine) vs a serial per-pod baseline with the reference's
-algorithmic shape; the serial scan's pods/s is reported alongside on stderr.
+"""Headline benchmark: the north-star configuration — a 100k-node x 1M-pod
+placement with topology spread, inter-pod anti-affinity, and Open-Local
+storage demand (BASELINE.md north-star row) — through the bulk rounds
+engine, end to end on a fresh engine. A 20k-node x 100k-pod run of the same
+constraint mix is timed alongside (stderr) for round-over-round continuity,
+as are the serial-scan rate and a serial per-pod numpy baseline with the
+reference's algorithmic shape.
 
 The reference publishes no numbers (BASELINE.md); its cost model is a strictly
 serial pod loop doing an O(nodes) filter+score per pod
@@ -10,13 +14,16 @@ loop shape host-side with vectorized numpy per pod — a *generous* stand-in
 (numpy's C loops beat the Go plugin chain per node).
 
 Prints ONE JSON line:
-  {"metric": "bulk_pods_per_sec_20k_nodes", "value": N, "unit": "pods/s",
-   "vs_baseline": ours/baseline}
+  {"metric": "north_star_place_1m_pods_100k_nodes", "value": <seconds>,
+   "unit": "s", "vs_baseline": 60/value}
+vs_baseline > 1 means the < 60 s BASELINE.json target is met on this chip
+alone (the target names a v5e-8; the sharded engine splits the node axis
+over chips, so single-chip < 60 s is the conservative bound).
 
-Env knobs: SIMTPU_BENCH_NODES (default 20000), SIMTPU_BENCH_PODS (default
-100000), SIMTPU_BENCH_SCAN_PODS (scan-rate slice, default 5000),
+Env knobs: SIMTPU_BENCH_NODES (default 100000), SIMTPU_BENCH_PODS (default
+1000000), SIMTPU_BENCH_SCAN_PODS (scan-rate slice, default 5000),
 SIMTPU_BENCH_BASELINE_PODS (default 300 — the baseline is timed on a slice
-and expressed as pods/s).
+and expressed as pods/s), SIMTPU_BENCH_SMALL=0 to skip the 20k point.
 """
 
 from __future__ import annotations
@@ -38,16 +45,31 @@ def build_problem(n_nodes: int, n_pods: int):
     from simtpu.synth import synth_apps, synth_cluster
     from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
 
+    def note(msg):
+        print(f"# {msg}", file=sys.stderr, flush=True)
+
     t0 = time.perf_counter()
-    cluster = synth_cluster(n_nodes, seed=3, zones=16, taint_frac=0.1)
+    note(f"generating {n_nodes} nodes x {n_pods} pods")
+    # the north-star constraint mix: zone spread constraints, preferred
+    # inter-pod anti-affinity, node selectors/tolerations, and Open-Local
+    # storage demand against storage-annotated nodes
+    cluster = synth_cluster(
+        n_nodes, seed=3, zones=16, taint_frac=0.1, storage_frac=0.3
+    )
     apps = synth_apps(
         n_pods,
         seed=4,
         zones=16,
-        pods_per_deployment=200,
+        # 1000-replica deployments: realistic shape for a 1M-pod app list,
+        # and the [T, N] topology-count planes scale with the number of
+        # groups — ~2.5 terms/group keeps state within single-chip HBM at
+        # 100k nodes (int(os.environ.get(...)) for experiments)
+        pods_per_deployment=int(os.environ.get("SIMTPU_BENCH_PODS_PER_DEP", 1000)),
         selector_frac=0.2,
         toleration_frac=0.1,
         anti_affinity_frac=0.2,
+        spread_frac=0.3,
+        storage_frac=0.2,
     )
     pods = []
     for app in apps:
@@ -56,12 +78,14 @@ def build_problem(n_nodes: int, n_pods: int):
             set_label(pod, C.LABEL_APP_NAME, app.name)
         pods.extend(expanded)
     gen_s = time.perf_counter() - t0
+    note(f"generated in {gen_s:.1f}s; tensorizing")
 
     t0 = time.perf_counter()
-    tensorizer = Tensorizer(cluster.nodes)
+    tensorizer = Tensorizer(cluster.nodes, storage_classes=cluster.storage_classes)
     batch = tensorizer.add_pods(pods)
     tensors = tensorizer.freeze()
     tensorize_s = time.perf_counter() - t0
+    note(f"tensorized in {tensorize_s:.1f}s")
 
     statics = statics_from(tensors)
     r = tensors.alloc.shape[1]
@@ -142,21 +166,38 @@ def time_bulk(tensors, batch):
             return tensors
 
     nodes, best = None, float("inf")
-    for _ in range(2):
+    for i in range(2):
         eng = RoundsEngine(_TZ())
         t0 = time.perf_counter()
         nodes, _, _ = eng.place(batch)
-        best = min(best, time.perf_counter() - t0)
+        run_s = time.perf_counter() - t0
+        print(f"# bulk run {i}: {run_s:.1f}s", file=sys.stderr, flush=True)
+        best = min(best, run_s)
     return best, nodes
 
 
 def main() -> int:
-    n_nodes = int(os.environ.get("SIMTPU_BENCH_NODES", 20_000))
-    n_pods = int(os.environ.get("SIMTPU_BENCH_PODS", 100_000))
+    n_nodes = int(os.environ.get("SIMTPU_BENCH_NODES", 100_000))
+    n_pods = int(os.environ.get("SIMTPU_BENCH_PODS", 1_000_000))
     scan_pods = int(os.environ.get("SIMTPU_BENCH_SCAN_PODS", 5_000))
     base_pods = int(os.environ.get("SIMTPU_BENCH_BASELINE_PODS", 300))
 
     import jax
+
+    if (
+        os.environ.get("SIMTPU_BENCH_SMALL", "1") != "0"
+        and (n_nodes, n_pods) == (100_000, 1_000_000)
+    ):
+        # the r01-continuity point: same constraint mix at 20k x 100k
+        s_tensors, s_batch = build_problem(20_000, 100_000)[:2]
+        small_s, s_nodes_out = time_bulk(s_tensors, s_batch)
+        print(
+            f"# small-point nodes=20000 pods=100000 bulk-wall={small_s:.2f}s "
+            f"rate={len(s_batch.group) / small_s:.0f} pods/s "
+            f"placed={int((s_nodes_out >= 0).sum())}",
+            file=sys.stderr,
+        )
+        del s_tensors, s_batch, s_nodes_out
 
     (
         tensors,
@@ -171,11 +212,13 @@ def main() -> int:
 
     from simtpu.engine.scan import flags_from
 
+    print(f"# problem built; timing scan slice", file=sys.stderr, flush=True)
     scan_slice = tuple(arr[:scan_pods] for arr in pod_arrays)
     engine_s, _ = time_engine(
         statics, state, scan_slice, flags_from(tensors, batch.ext)
     )
     scan_rate = scan_pods / engine_s
+    print(f"# scan={scan_rate:.0f} pods/s; timing bulk", file=sys.stderr, flush=True)
 
     bulk_s, placed_nodes = time_bulk(tensors, batch)
     placed = int((placed_nodes >= 0).sum())
@@ -188,6 +231,7 @@ def main() -> int:
         f"# nodes={n_nodes} pods={n_pods} placed={placed} "
         f"gen={gen_s:.1f}s tensorize={tensorize_s:.1f}s "
         f"scan={scan_rate:.0f} pods/s bulk={pods_per_sec:.0f} pods/s "
+        f"bulk-wall={bulk_s:.1f}s "
         f"serial-baseline={base_pods_per_sec:.0f} pods/s "
         f"backend={jax.default_backend()}",
         file=sys.stderr,
@@ -195,10 +239,14 @@ def main() -> int:
     print(
         json.dumps(
             {
-                "metric": f"bulk_pods_per_sec_{n_nodes//1000}k_nodes",
-                "value": round(pods_per_sec, 1),
-                "unit": "pods/s",
-                "vs_baseline": round(pods_per_sec / base_pods_per_sec, 2),
+                "metric": (
+                    "north_star_place_1m_pods_100k_nodes"
+                    if (n_nodes, n_pods) == (100_000, 1_000_000)
+                    else f"bulk_place_{n_pods//1000}k_pods_{n_nodes//1000}k_nodes"
+                ),
+                "value": round(bulk_s, 2),
+                "unit": "s",
+                "vs_baseline": round(60.0 / bulk_s, 2),
             }
         )
     )

@@ -311,12 +311,14 @@ class _Env:
     def __init__(self, defines: Optional[Dict[str, List[_Node]]] = None):
         self.defines: Dict[str, List[_Node]] = defines if defines is not None else {}
 
-    def include(self, name, dot, root, where):
+    def include(self, name, dot, where):
         body = self.defines.get(name)
         if body is None:
             raise ChartRenderError(f"{where}: include of undefined template '{name}'")
         out: List[str] = []
-        scope = _Scope(dot, root)
+        # Go text/template rebinds $ to each execution's data argument, so
+        # inside an included template $ IS the passed dot, not the chart root
+        scope = _Scope(dot, dot)
         _render_nodes(body, scope, self, out, where)
         return "".join(out)
 
@@ -571,13 +573,14 @@ def _eval_stage(ops, piped, scope: _Scope, env: _Env, where: str):
             args.append(piped)
         try:
             if head == "include":
-                return env.include(str(args[0]), args[1] if len(args) > 1 else None,
-                                   scope.root, where)
+                return env.include(
+                    str(args[0]), args[1] if len(args) > 1 else None, where
+                )
             if head == "tpl":
                 # render a string as a template against the given context
                 tpl_src, dot = str(args[0]), args[1] if len(args) > 1 else None
                 out: List[str] = []
-                _render_nodes(_parse(tpl_src, where), _Scope(dot, scope.root), env, out, where)
+                _render_nodes(_parse(tpl_src, where), _Scope(dot, dot), env, out, where)
                 return "".join(out)
             if head == "template":
                 raise ChartRenderError(
@@ -677,14 +680,14 @@ def _render_nodes(nodes: List[_Node], scope: _Scope, env: _Env, out: List[str], 
                     if node.arg_src is not None
                     else scope.dot
                 )
-                out.append(env.include(node.name, dot, scope.root, where))
+                out.append(env.include(node.name, dot, where))
         elif isinstance(node, _TemplateCall):
             dot = (
                 _eval_expr(node.arg_src, scope, env, where)
                 if node.arg_src is not None
                 else None
             )
-            out.append(env.include(node.name, dot, scope.root, where))
+            out.append(env.include(node.name, dot, where))
 
 
 def collect_defines(template: str, where: str, env: _Env) -> List[_Node]:

@@ -138,7 +138,7 @@ def _round_core(
         terms_g = statics.g_terms[g]
         tvalid = terms_g >= 0
         tsafe = jnp.clip(terms_g, 0)
-        dom_sub = statics.dom_tn[tsafe]  # [Tc, N]
+        dom_sub = statics.node_dom[statics.term_topo[tsafe]]  # [Tc, N]
         valid_sub = (dom_sub >= 0) & tvalid[:, None]
 
     ev = filter_and_score(statics, state, pod, flags)
@@ -212,12 +212,26 @@ def _round_core(
 
     # -- score slope: re-score after one hypothetical pod per node --------
     # score-only: the filter cascade need not rerun — the round keeps its
-    # start-of-round masks (m_all) and the caps carry the hard constraints
-    hyp = state._replace(free=state.free - req[None, :])
+    # start-of-round masks (m_all) and the caps carry the hard constraints.
+    # The hypothetical state is expressed as score_pod overrides (free and
+    # the group's [Tc, N] cnt_match rows) — bumping a copy of the full
+    # [T, N] count plane would copy T/Tc times the touched data every round
+    cnt_sub1 = None
     if t_cap:
         bump1 = jnp.where(valid_sub, statics.s_match[g][:, None], 0.0)
-        hyp = hyp._replace(cnt_match=state.cnt_match.at[tsafe].add(bump1))
-    score1 = score_pod(statics, hyp, g, req, ev.m_all, flags)
+        cnt_sub1 = (
+            jnp.where(tvalid[:, None], state.cnt_match[tsafe], 0.0) + bump1
+        )
+    score1 = score_pod(
+        statics,
+        state,
+        g,
+        req,
+        ev.m_all,
+        flags,
+        free=state.free - req[None, :],
+        cnt_sub=cnt_sub1,
+    )
     # slope clamped >= 0: the threshold search needs non-increasing
     # sequences; a genuinely increasing score (rare: balanced_allocation
     # improving) fills one node until capacity under serial semantics, which
@@ -300,16 +314,27 @@ def _round_core(
         updates["cnt_total"] = state.cnt_total.at[tsafe].add(
             s_match_g * (jnp.where(valid_sub, 1.0, 0.0) @ m_n)
         )
+        if f.interpod_req or f.interpod_pref:
+            # own planes live on the compacted interpod axis (scan.py
+            # schedule_step has the same mapping); zeroed vals make the
+            # clipped row-0 scatters of non-interpod terms no-ops
+            ip_g = statics.ip_of[tsafe]
+            ipsafe = jnp.clip(ip_g, 0)
+            ip_w = jnp.where(ip_g >= 0, 1.0, 0.0)
+
+            def bump_ip(arr, vals):
+                return arr.at[ipsafe].add((vals * ip_w)[:, None] * add_n)
+
         if f.interpod_req:
-            updates["cnt_own_anti"] = bump(
+            updates["cnt_own_anti"] = bump_ip(
                 state.cnt_own_anti, statics.a_anti_req[g].astype(jnp.float32)
             )
-            updates["cnt_own_aff"] = bump(
+            updates["cnt_own_aff"] = bump_ip(
                 state.cnt_own_aff, statics.a_aff_req[g].astype(jnp.float32)
             )
         if f.interpod_pref:
-            updates["w_own_aff_pref"] = bump(state.w_own_aff_pref, statics.w_aff_pref[g])
-            updates["w_own_anti_pref"] = bump(
+            updates["w_own_aff_pref"] = bump_ip(state.w_own_aff_pref, statics.w_aff_pref[g])
+            updates["w_own_anti_pref"] = bump_ip(
                 state.w_own_anti_pref, statics.w_anti_pref[g]
             )
     if f.storage:
@@ -567,7 +592,10 @@ class RoundsEngine(Engine):
             k_cap = self._pow2(int(ks.max()))
             firsts = np.pad(firsts, (0, s_pad - s_real), constant_values=firsts[-1])
             ks = np.pad(ks, (0, s_pad - s_real))  # k=0 rounds are no-ops
-            seg_pods = tuple(jnp.asarray(np.asarray(arr)[firsts]) for arr in pods)
+            # pods stay host-side (build_pod_arrays): the gather is a cheap
+            # numpy fancy-index and _bulk_call's jit transfers the [S, ...]
+            # representatives — never the full batch
+            seg_pods = tuple(arr[firsts] for arr in pods)
             state, (assign_sk, vg_sk, dev_sk, gpu_sk) = self._bulk_call(
                 statics,
                 state,
@@ -609,14 +637,43 @@ class RoundsEngine(Engine):
             # leftovers re-check through the serial step, which yields the
             # exact failure reason; they run after the whole bulk batch, so
             # their reasons reflect a (more-constrained) later state
+            # Leftover pods of one run are IDENTICAL, and a failed serial
+            # step leaves the state untouched — so probe them one at a time
+            # and stamp the first failure's reason onto the whole remainder
+            # (identical pod + unchanged state ⇒ identical outcome). A probe
+            # that PLACES (e.g. a cross-group spread constraint relaxed by
+            # intervening placements) keeps walking pod-by-pod, exactly like
+            # the serial engine. This keeps the all-fail case O(1) probes per
+            # run instead of O(leftover) full scan steps — at 1M-pod scale
+            # the per-pod re-check was the single largest cost.
             for a2, b2 in leftovers:
                 state, outs = self._run_scan_segment(
-                    statics, state, pods, a2, b2, flags
+                    statics, state, pods, a2, a2 + 1, flags
                 )
-                nodes[a2:b2], reasons[a2:b2] = outs[0], outs[1]
-                # a leftover CAN still place (e.g. a cross-group spread
-                # constraint relaxed by intervening placements) — keep its
-                # extended-resource plans for the host-side logs/annotations
-                lvm_alloc[a2:b2], dev_take[a2:b2], gpu_shares[a2:b2] = outs[2:5]
+                nodes[a2], reasons[a2] = outs[0][0], outs[1][0]
+                lvm_alloc[a2], dev_take[a2], gpu_shares[a2] = (
+                    outs[2][0],
+                    outs[3][0],
+                    outs[4][0],
+                )
+                if nodes[a2] < 0:
+                    # a failed probe leaves the state untouched, and the
+                    # run's pods are identical — the remainder shares its
+                    # failure without running (the all-fail case is O(1)
+                    # probes per run; at 1M-pod scale the per-pod re-check
+                    # was the single largest cost)
+                    nodes[a2 + 1 : b2] = -1
+                    reasons[a2 + 1 : b2] = reasons[a2]
+                elif a2 + 1 < b2:
+                    # the probe placed (e.g. a cross-group spread constraint
+                    # relaxed by intervening placements) — run the remainder
+                    # as one serial segment, exactly like the serial engine
+                    state, outs = self._run_scan_segment(
+                        statics, state, pods, a2 + 1, b2, flags
+                    )
+                    nodes[a2 + 1 : b2], reasons[a2 + 1 : b2] = outs[0], outs[1]
+                    lvm_alloc[a2 + 1 : b2], dev_take[a2 + 1 : b2], gpu_shares[
+                        a2 + 1 : b2
+                    ] = outs[2:5]
         return state, (nodes, reasons, lvm_alloc, dev_take, gpu_shares)
 

@@ -45,7 +45,7 @@ from ..kernels.scores import (
     topology_spread_score,
 )
 from ..kernels.storage import device_plan, lvm_plan, open_local_score
-from .state import SchedState, build_state
+from .state import SchedState, build_state, interpod_term_index
 
 # Failure-reason codes (host maps to messages mirroring the scheduler's
 # "0/N nodes are available: ..." status strings, scheduler.go:500)
@@ -90,7 +90,17 @@ class StaticArrays(NamedTuple):
     taint_intol: jnp.ndarray  # [G, N]
     static_score: jnp.ndarray  # [G, N] ImageLocality score
     avoid_pen: jnp.ndarray  # [G, N] NodePreferAvoidPods penalty (pre-weighted)
-    dom_tn: jnp.ndarray  # [T, N] node n's domain for term t's topo key (-1 absent)
+    # Node domains are stored per TOPOLOGY KEY, not per term: node_dom[k, n]
+    # is node n's domain id for key k (-1 absent), and term_topo[t] maps a
+    # term to its key. The step's [Tc, N] domain rows are a two-level gather
+    # (node_dom[term_topo[tsafe]]) — a [T, N] materialization would cost
+    # T/K x the memory (T grows with the number of workloads, K is ~2-3).
+    node_dom: jnp.ndarray  # [K, N] node domain per topology key (-1 absent)
+    term_topo: jnp.ndarray  # [T] topology-key index per term
+    # The four interpod "own" count planes in SchedState live on a compacted
+    # axis of terms that actually appear in some group's (anti-)affinity:
+    # ip_of[t] is a term's row there (-1 for spread/selector-spread terms).
+    ip_of: jnp.ndarray  # [T] interpod-plane row per term (-1 none)
     # Term incidence is compacted per group: g_terms[g] lists the <= Tc term
     # indices relevant to group g (-1 pad), and every [G, Tc] matrix below is
     # aligned to those columns. The scan step row-gathers just those rows
@@ -136,24 +146,28 @@ def build_pod_arrays(batch: PodBatch, n_resources: int):
 
     The single source of truth for the scan's pod-tuple layout — used by
     Engine.place, the batched sweep, the bench, and the graft entry.
-    Returns (padded_req, pods_tuple).
+    Returns (padded_req, pods_tuple). The tuple stays HOST-side (numpy): the
+    rounds engine gathers run representatives and slices segments from it,
+    and a device round-trip of million-pod arrays costs far more than the
+    per-dispatch transfer of what is actually dispatched (jit transfers its
+    own arguments).
     """
     req = batch.req
     if req.shape[1] < n_resources:
         req = np.pad(req, ((0, 0), (0, n_resources - req.shape[1])))
     ext = batch.ext
     pods = (
-        jnp.asarray(batch.group),
-        jnp.asarray(req, jnp.float32),
-        jnp.asarray(batch.pin, jnp.int32),
-        jnp.asarray(batch.forced),
-        jnp.asarray(ext["lvm_size"]),
-        jnp.asarray(ext["lvm_vg"]),
-        jnp.asarray(ext["dev_size"]),
-        jnp.asarray(ext["dev_media"]),
-        jnp.asarray(ext["gpu_mem"]),
-        jnp.asarray(ext["gpu_count"]),
-        jnp.asarray(ext["gpu_preset"]),
+        np.asarray(batch.group, np.int32),
+        np.asarray(req, np.float32),
+        np.asarray(batch.pin, np.int32),
+        np.asarray(batch.forced),
+        np.asarray(ext["lvm_size"], np.float32),
+        np.asarray(ext["lvm_vg"], np.int32),
+        np.asarray(ext["dev_size"], np.float32),
+        np.asarray(ext["dev_media"], np.int32),
+        np.asarray(ext["gpu_mem"], np.float32),
+        np.asarray(ext["gpu_count"], np.int32),
+        np.asarray(ext["gpu_preset"], np.int32),
     )
     return req, pods
 
@@ -204,9 +218,13 @@ def statics_from(tensors: ClusterTensors, sched_config=None) -> StaticArrays:
         taint_intol=jnp.asarray(tensors.taint_intolerable),
         static_score=jnp.asarray(tensors.static_score, jnp.float32),
         avoid_pen=jnp.asarray(tensors.avoid_pen, jnp.float32),
-        # the per-term domain gather node_dom[term_topo] is hoisted out of the
-        # scan body: it is the single most-reused index structure of the step
-        dom_tn=jnp.asarray(tensors.dom_tn(), jnp.int32),
+        node_dom=jnp.asarray(
+            tensors.node_dom if tensors.node_dom.shape[0] else
+            np.zeros((1, tensors.alloc.shape[0]), np.int32),
+            jnp.int32,
+        ),
+        term_topo=jnp.asarray(tensors.term_topo_key, jnp.int32),
+        ip_of=jnp.asarray(interpod_term_index(tensors), jnp.int32),
         g_terms=jnp.asarray(g_terms),
         s_match=jnp.asarray(compact(tensors.s_match)),
         a_aff_req=jnp.asarray(compact(tensors.a_aff_req)),
@@ -343,6 +361,8 @@ def score_pod(
     req,
     m_all,
     flags: StepFlags = StepFlags(),
+    free=None,
+    cnt_sub=None,
 ) -> jnp.ndarray:
     """The combined score sum for one pod spec over all nodes, -inf outside
     `m_all` (weights: registry.go:101-145 + Simon extension, overridable via
@@ -355,6 +375,11 @@ def score_pod(
     `StepEval.score`, keeping the storage-free base (`score_nostorage`)
     available to the bulk rounds engine's slope re-score (`engine/rounds.py`)
     without a second full pass.
+
+    `free` / `cnt_sub` override `state.free` and the group's [Tc, N]
+    cnt_match rows: the rounds engine scores a hypothetical
+    one-pod-per-node state without materializing a bumped copy of the full
+    [T, N] count plane (a copy is T/Tc times the touched data).
     """
     f = flags
     t_cap = statics.g_terms.shape[1]
@@ -362,10 +387,12 @@ def score_pod(
         terms_g = statics.g_terms[g]
         tvalid = terms_g >= 0
         tsafe = jnp.clip(terms_g, 0)
-        cnt_sub = jnp.where(tvalid[:, None], state.cnt_match[tsafe], 0.0)
+        if cnt_sub is None:
+            cnt_sub = jnp.where(tvalid[:, None], state.cnt_match[tsafe], 0.0)
+    fr = state.free if free is None else free
     w_ = statics.score_w
-    score = w_[0] * least_allocated(state.free, statics.alloc, req)
-    score += w_[1] * balanced_allocation(state.free, statics.alloc, req)
+    score = w_[0] * least_allocated(fr, statics.alloc, req)
+    score += w_[1] * balanced_allocation(fr, statics.alloc, req)
     # Simon score + the GPU-share score, which is the same dominant-share
     # formula (open-gpu-share.go:84-110): computed once, counted twice
     score += (w_[2] + w_[3]) * minmax_normalize(simon_share(statics.alloc, req), m_all)
@@ -374,12 +401,14 @@ def score_pod(
     if f.taint_pref:
         score += w_[5] * taint_toleration_score(statics.taint_intol[g], m_all)
     if (f.interpod_pref or f.interpod_req) and t_cap:
-        tmask = tvalid[:, None]
+        ip_g = statics.ip_of[tsafe]  # [Tc] rows in the compacted own planes
+        ip_ok = (tvalid & (ip_g >= 0))[:, None]
+        ipsafe = jnp.clip(ip_g, 0)
         raw_ipa = interpod_score(
             cnt_sub,
-            jnp.where(tmask, state.cnt_own_aff[tsafe], 0.0),
-            jnp.where(tmask, state.w_own_aff_pref[tsafe], 0.0),
-            jnp.where(tmask, state.w_own_anti_pref[tsafe], 0.0),
+            jnp.where(ip_ok, state.cnt_own_aff[ipsafe], 0.0),
+            jnp.where(ip_ok, state.w_own_aff_pref[ipsafe], 0.0),
+            jnp.where(ip_ok, state.w_own_anti_pref[ipsafe], 0.0),
             statics.s_match[g],
             statics.w_aff_pref[g],
             statics.w_anti_pref[g],
@@ -427,7 +456,7 @@ def filter_and_score(
         terms_g = statics.g_terms[g]  # [Tc]
         tvalid = terms_g >= 0
         tsafe = jnp.clip(terms_g, 0)
-        dom_sub = statics.dom_tn[tsafe]
+        dom_sub = statics.node_dom[statics.term_topo[tsafe]]
         valid_sub = (dom_sub >= 0) & tvalid[:, None]
         cnt_sub = jnp.where(tvalid[:, None], state.cnt_match[tsafe], 0.0)
 
@@ -507,9 +536,11 @@ def filter_and_score(
 
     m_all = m_spread
     if f.interpod_req and t_cap:
+        ip_g = statics.ip_of[tsafe]
+        ip_ok = (tvalid & (ip_g >= 0))[:, None]
         m_all = m_spread & interpod_filter(
             cnt_sub,
-            jnp.where(tvalid[:, None], state.cnt_own_anti[tsafe], 0.0),
+            jnp.where(ip_ok, state.cnt_own_anti[jnp.clip(ip_g, 0)], 0.0),
             valid_sub,
             jnp.where(tvalid, state.cnt_total[tsafe], 0.0),
             statics.s_match[g],
@@ -625,7 +656,7 @@ def schedule_step(
         terms_g = statics.g_terms[g]
         tvalid = terms_g >= 0
         tsafe = jnp.clip(terms_g, 0)
-        dom_sub = statics.dom_tn[tsafe]  # [Tc, N]
+        dom_sub = statics.node_dom[statics.term_topo[tsafe]]  # [Tc, N]
         valid_sub = (dom_sub >= 0) & tvalid[:, None]
         dom_chosen = dom_sub[:, safe]  # [Tc]
         valid_chosen = (dom_chosen >= 0) & tvalid & placed  # [Tc]
@@ -643,14 +674,24 @@ def schedule_step(
         updates["cnt_total"] = state.cnt_total.at[tsafe].add(
             statics.s_match[g] * jnp.where(valid_chosen, 1.0, 0.0)
         )
+        if f.interpod_req or f.interpod_pref:
+            # the own planes live on the compacted interpod axis; vals are 0
+            # for non-interpod terms, so clipped row-0 scatters add nothing
+            ip_g = statics.ip_of[tsafe]
+            ipsafe = jnp.clip(ip_g, 0)
+            ip_w = jnp.where(ip_g >= 0, 1.0, 0.0)
+
+            def bump_ip(arr, vals):
+                return arr.at[ipsafe].add((vals * ip_w)[:, None] * inc)
+
         if f.interpod_req:
-            updates["cnt_own_anti"] = bump(state.cnt_own_anti, statics.a_anti_req[g])
-            updates["cnt_own_aff"] = bump(state.cnt_own_aff, statics.a_aff_req[g])
+            updates["cnt_own_anti"] = bump_ip(state.cnt_own_anti, statics.a_anti_req[g])
+            updates["cnt_own_aff"] = bump_ip(state.cnt_own_aff, statics.a_aff_req[g])
         if f.interpod_pref:
-            updates["w_own_aff_pref"] = bump(
+            updates["w_own_aff_pref"] = bump_ip(
                 state.w_own_aff_pref, statics.w_aff_pref[g]
             )
-            updates["w_own_anti_pref"] = bump(
+            updates["w_own_anti_pref"] = bump_ip(
                 state.w_own_anti_pref, statics.w_anti_pref[g]
             )
     new_state = state._replace(**updates)
@@ -713,8 +754,17 @@ class Engine:
         req, pods = build_pod_arrays(batch, r)
         # carry the previous batch's final state forward when nothing that
         # shapes it changed; a grown vocabulary (new groups may retro-match
-        # new terms) or log surgery (preemption) forces the full rebuild
-        vocab = (r, tensors.n_terms, tensors.n_ports, tensors.n_vols)
+        # new terms) or log surgery (preemption) forces the full rebuild.
+        # The interpod-plane count participates: a new group can mark an
+        # ALREADY-interned term as interpod-used without growing n_terms,
+        # which reshapes the compacted own planes.
+        vocab = (
+            r,
+            tensors.n_terms,
+            tensors.n_ports,
+            tensors.n_vols,
+            int((interpod_term_index(tensors) >= 0).sum()),
+        )
         if (
             self.last_state is not None
             and not self._state_dirty

@@ -52,7 +52,7 @@ def pad_statics(statics: StaticArrays, multiple: int) -> Tuple[StaticArrays, int
             taint_intol=_pad_axis(statics.taint_intol, 1, pad, 0.0),
             static_score=_pad_axis(statics.static_score, 1, pad, 0.0),
             avoid_pen=_pad_axis(statics.avoid_pen, 1, pad, 0.0),
-            dom_tn=_pad_axis(statics.dom_tn, 1, pad, -1),
+            node_dom=_pad_axis(statics.node_dom, 1, pad, -1),
             has_storage=_pad_axis(statics.has_storage, 0, pad, False),
             vg_cap=_pad_axis(statics.vg_cap, 0, pad, 0.0),
             vg_name_id=_pad_axis(statics.vg_name_id, 0, pad, -1),
@@ -100,7 +100,9 @@ def statics_sharding(mesh: Mesh) -> StaticArrays:
         taint_intol=trail,
         static_score=trail,
         avoid_pen=trail,
-        dom_tn=trail,
+        node_dom=trail,
+        term_topo=rep,
+        ip_of=rep,
         g_terms=rep,
         s_match=rep,
         a_aff_req=rep,

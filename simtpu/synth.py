@@ -77,6 +77,8 @@ def make_deployment(
     node_selector=None,
     tolerations=None,
     anti_affinity_topo: str = None,
+    spread_topo: str = None,  # topologySpreadConstraints topology key
+    spread_hard: bool = False,  # DoNotSchedule vs ScheduleAnyway
     gpu_mem_mib: int = 0,
     lvm_gib: int = 0,
     device_gib: int = 0,  # exclusive-SSD claim size
@@ -106,6 +108,17 @@ def make_deployment(
                 ]
             }
         }
+    if spread_topo:
+        spec["topologySpreadConstraints"] = [
+            {
+                "maxSkew": 1,
+                "topologyKey": spread_topo,
+                "whenUnsatisfiable": (
+                    "DoNotSchedule" if spread_hard else "ScheduleAnyway"
+                ),
+                "labelSelector": {"matchLabels": labels},
+            }
+        ]
     # pod labels/annotations come from the OWNER's metadata, not the
     # template's (SetObjectMetaFromObject copies owner.GetLabels()/
     # GetAnnotations(), utils.go:336-346; the gpushare example carries its
@@ -215,6 +228,8 @@ def synth_apps(
     selector_frac: float = 0.2,
     toleration_frac: float = 0.1,
     anti_affinity_frac: float = 0.2,
+    spread_frac: float = 0.0,
+    spread_hard_frac: float = 0.0,  # fraction OF spread workloads DoNotSchedule
     gpu_frac: float = 0.0,
     storage_frac: float = 0.0,
 ) -> List[AppResource]:
@@ -246,6 +261,11 @@ def synth_apps(
             ]
         if rng.random() < anti_affinity_frac:
             kw["anti_affinity_topo"] = "kubernetes.io/hostname"
+        # draw only when enabled so pre-existing seeds' random streams (and
+        # the scenarios fuzz tests pinned to them) are unchanged
+        if spread_frac and rng.random() < spread_frac:
+            kw["spread_topo"] = "topology.kubernetes.io/zone"
+            kw["spread_hard"] = bool(spread_hard_frac) and rng.random() < spread_hard_frac
         resources.deployments.append(
             make_deployment(
                 f"dep-{d:05d}",

@@ -262,3 +262,12 @@ class TestHelperChart:
             "x": "keep",
             "y": "new",
         }
+
+    def test_dollar_rebinds_in_include(self):
+        # Go rebinds $ to each execution's data argument
+        tpl = (
+            '{{ define "t" }}{{ $.name }}{{ end }}'
+            '{{ include "t" .Values.img }}'
+        )
+        out = render_template(tpl, {"Values": {"img": {"name": "n1"}}})
+        assert out == "n1"

@@ -642,3 +642,55 @@ class TestSchedulerNameGating:
         result = simulate(ResourceTypes(nodes=nodes, pods=[pod]), [])
         placed = {name_of(p) for st in result.node_status for p in st.pods}
         assert placed == {"p0"}
+
+
+def test_state_reuse_rebuilds_when_term_becomes_interpod():
+    """A second batch can mark an ALREADY-interned term as interpod-used
+    (same topologyKey/namespace/selector in a required podAntiAffinity);
+    n_terms is unchanged but the compacted own planes reshape, so the carried
+    state must be rebuilt, not reused."""
+    from simtpu.core.tensorize import Tensorizer
+    from simtpu.engine.scan import Engine
+    from .fixtures import make_fake_node, make_fake_pod, with_node_labels
+
+    nodes = [
+        make_fake_node(
+            f"n{i}",
+            "8",
+            "16Gi",
+            with_node_labels({"topology.kubernetes.io/zone": f"z{i}"}),
+        )
+        for i in range(2)
+    ]
+    tz = Tensorizer(nodes)
+    eng = Engine(tz)
+
+    spread_pod = make_fake_pod("sp", "default", "1", "1Gi")
+    spread_pod["metadata"]["labels"] = {"app": "web"}
+    spread_pod["spec"]["topologySpreadConstraints"] = [
+        {
+            "maxSkew": 1,
+            "topologyKey": "topology.kubernetes.io/zone",
+            "whenUnsatisfiable": "ScheduleAnyway",
+            "labelSelector": {"matchLabels": {"app": "web"}},
+        }
+    ]
+    nodes_out, _, _ = eng.place(tz.add_pods([spread_pod]))
+    assert nodes_out[0] >= 0
+
+    anti_pod = make_fake_pod("ap", "default", "1", "1Gi")
+    anti_pod["metadata"]["labels"] = {"app": "web"}
+    anti_pod["spec"]["affinity"] = {
+        "podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {
+                    "labelSelector": {"matchLabels": {"app": "web"}},
+                    "topologyKey": "topology.kubernetes.io/zone",
+                }
+            ]
+        }
+    }
+    nodes_out, _, _ = eng.place(tz.add_pods([anti_pod]))
+    # the anti pod must land in the OTHER zone (the spread pod's zone is
+    # excluded by its own required anti-affinity against app=web)
+    assert nodes_out[0] >= 0
