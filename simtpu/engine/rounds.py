@@ -78,6 +78,13 @@ def _floor_slots(free: jnp.ndarray, size) -> jnp.ndarray:
     return jnp.where(c * size > free, c - 1.0, c)
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_rows(plane: jnp.ndarray, rows: jnp.ndarray, values: jnp.ndarray):
+    """plane[rows] = values, in place (the full plane is donated — an eager
+    .at[].set would copy the whole plane per chunk)."""
+    return plane.at[rows].set(values)
+
+
 def _fill_order(cap_x: jnp.ndarray, free_x: jnp.ndarray):
     """Greedy fill sequence over a node's containers (VGs / GPU devices):
     tightest-first means containers are visited in ascending initial free
@@ -553,7 +560,137 @@ class RoundsEngine(Engine):
             tuple(arr[a:b] for arr in pods), self._pow2(b - a)
         )
         state, outs = self._scan_call(statics, state, seg, flags)
+        # one batched device→host transfer: per-array np.asarray syncs pay a
+        # full tunnel round-trip each
+        outs = jax.device_get(outs)
         return state, tuple(np.asarray(o)[: b - a] for o in outs)
+
+    #: carried-row budget per bulk chunk (padded to the next power of two):
+    #: each chunk's scan carries only these many cnt-plane rows, so per-round
+    #: state traffic is bounded regardless of how many workloads exist
+    ROW_BUDGET = 224
+
+    def _host_term_maps(self, tensors):
+        from .scan import _compact_terms
+        from .state import interpod_term_index
+
+        g_terms, _ = _compact_terms(tensors)
+        return g_terms, tensors.term_topo_key, interpod_term_index(tensors)
+
+    def _chunk_runs(self, run, batch, tensors):
+        """Split a stretch of bulk runs into chunks whose union of relevant
+        count-plane terms stays within ROW_BUDGET; yields (chunk, rows_p)
+        where rows_p is the padded term-row list the chunk's scan carries
+        (None = carry the full plane, for small term vocabularies)."""
+        t = tensors.n_terms
+        if t <= self.ROW_BUDGET:
+            yield run, None
+            return
+        g_terms, _, _ = self._host_term_maps(tensors)
+        group = np.asarray(batch.group)
+        chunk, rows = [], set()
+        for seg in run:
+            seg_terms = {
+                int(x) for x in g_terms[group[seg[1]]] if x >= 0
+            }
+            if chunk and len(rows | seg_terms) > self.ROW_BUDGET:
+                yield chunk, self._pad_rows(sorted(rows), t)
+                chunk, rows = [], set()
+            chunk.append(seg)
+            rows |= seg_terms
+        if chunk:
+            yield chunk, self._pad_rows(sorted(rows), t)
+
+    def _pad_rows(self, rows, t):
+        """Pad the row list to a power of two with DISTINCT unused term ids
+        (their gathered values pass through the scan unchanged, so the
+        scatter-back is a no-op for them; duplicate indices in a scatter
+        would let a stale copy overwrite the updated row)."""
+        rows = np.asarray(rows, np.int32)
+        u_pad = self._pow2(len(rows))
+        pad = min(u_pad, t) - len(rows)
+        if pad > 0:
+            unused = np.setdiff1d(
+                np.arange(t, dtype=np.int32), rows, assume_unique=False
+            )[:pad]
+            rows = np.concatenate([rows, unused])
+        return rows
+
+    def _bulk_chunk(self, statics, state, chunk, rows_p, pods, tensors, flags):
+        """Run one chunk of bulk runs through _bulk_call, carrying only the
+        chunk's cnt-plane rows when rows_p is given."""
+        s_real = len(chunk)
+        s_pad = self._pow2(s_real)
+        firsts = np.array([i0 for _, i0, _ in chunk], np.int32)
+        ks = np.array([j0 - i0 for _, i0, j0 in chunk], np.int32)
+        k_cap = self._pow2(int(ks.max()))
+        firsts = np.pad(firsts, (0, s_pad - s_real), constant_values=firsts[-1])
+        ks = np.pad(ks, (0, s_pad - s_real))  # k=0 rounds are no-ops
+        # pods stay host-side (build_pod_arrays): the gather is a cheap
+        # numpy fancy-index and _bulk_call's jit transfers the [S, ...]
+        # representatives — never the full batch
+        seg_pods = tuple(arr[firsts] for arr in pods)
+
+        if rows_p is None:
+            state, outs = self._bulk_call(
+                statics, state, seg_pods, jnp.asarray(ks),
+                tensors.n_domains, k_cap, flags,
+            )
+        else:
+            g_terms, term_topo, ip_of = self._host_term_maps(tensors)
+            inv = np.zeros(tensors.n_terms, np.int32)
+            inv[rows_p] = np.arange(len(rows_p), dtype=np.int32)
+            g_terms_chunk = np.where(
+                g_terms >= 0, inv[np.clip(g_terms, 0, None)], -1
+            ).astype(np.int32)
+            rows_dev = jnp.asarray(rows_p)
+            st_chunk = statics._replace(
+                g_terms=jnp.asarray(g_terms_chunk),
+                term_topo=jnp.asarray(term_topo[rows_p]),
+                ip_of=jnp.asarray(ip_of[rows_p]),
+            )
+            state_chunk = state._replace(
+                cnt_match=state.cnt_match[rows_dev],
+                cnt_total=state.cnt_total[rows_dev],
+            )
+            full_match, full_total = state.cnt_match, state.cnt_total
+            state_chunk, outs = self._bulk_call(
+                st_chunk, state_chunk, seg_pods, jnp.asarray(ks),
+                tensors.n_domains, k_cap, flags,
+            )
+            state = state_chunk._replace(
+                cnt_match=_scatter_rows(full_match, rows_dev, state_chunk.cnt_match),
+                cnt_total=_scatter_rows(full_total, rows_dev, state_chunk.cnt_total),
+            )
+        return state, tuple(np.asarray(o) for o in jax.device_get(outs))
+
+    @staticmethod
+    def _record_chunk(
+        chunk, hosts, nodes, reasons, lvm_alloc, dev_take, gpu_shares,
+        gpu_mem, lvm_sizes, dev_sizes, leftovers,
+    ):
+        assign_host, vg_host, dev_host, gpu_host = hosts
+        for s, (_, i0, j0) in enumerate(chunk):
+            row = assign_host[s]
+            placed = int((row >= 0).sum())
+            nodes[i0 : i0 + placed] = row[:placed]
+            reasons[i0 : i0 + placed] = 0
+            if placed:
+                sel = np.arange(i0, i0 + placed)
+                if lvm_sizes.shape[1] and lvm_sizes[i0].max() > 0:
+                    vgs = vg_host[s, :placed]
+                    ok_v = vgs >= 0
+                    lvm_alloc[sel[ok_v], vgs[ok_v]] = lvm_sizes[i0].max()
+                if dev_sizes.shape[1] and dev_sizes[i0].max() > 0:
+                    devs = dev_host[s, :placed]
+                    ok_d = devs >= 0
+                    dev_take[sel[ok_d], devs[ok_d]] = True
+                if gpu_mem[i0] > 0:
+                    gpus = gpu_host[s, :placed]
+                    ok_g = gpus >= 0
+                    gpu_shares[sel[ok_g], gpus[ok_g]] = 1.0
+            if placed < j0 - i0:
+                leftovers.append((i0 + placed, j0))
 
     def _dispatch(self, statics: StaticArrays, state: SchedState, pods, flags):
         batch = self._current_batch
@@ -580,63 +717,31 @@ class RoundsEngine(Engine):
                 lvm_alloc[a:b], dev_take[a:b], gpu_shares[a:b] = outs[2:5]
                 idx += 1
                 continue
-            # batch ALL consecutive bulk runs into one compiled multi-round
+            # batch consecutive bulk runs into compiled multi-round calls,
+            # CHUNKED so each call's scan carries only the count-plane rows
+            # its runs reference: a round's state update scatters into the
+            # carried cnt planes, and carrying the full [T, N] plane makes
+            # every round pay traffic proportional to the number of
+            # workloads in the whole simulation — the dominant device cost
+            # at 100k nodes. Rows are gathered before and scattered back
+            # after each chunk (in place, donated).
             run = []
             while idx < len(segments) and segments[idx][0] == "bulk":
                 run.append(segments[idx])
                 idx += 1
-            s_real = len(run)
-            s_pad = self._pow2(s_real)
-            firsts = np.array([i0 for _, i0, _ in run], np.int32)
-            ks = np.array([j0 - i0 for _, i0, j0 in run], np.int32)
-            k_cap = self._pow2(int(ks.max()))
-            firsts = np.pad(firsts, (0, s_pad - s_real), constant_values=firsts[-1])
-            ks = np.pad(ks, (0, s_pad - s_real))  # k=0 rounds are no-ops
-            # pods stay host-side (build_pod_arrays): the gather is a cheap
-            # numpy fancy-index and _bulk_call's jit transfers the [S, ...]
-            # representatives — never the full batch
-            seg_pods = tuple(arr[firsts] for arr in pods)
-            state, (assign_sk, vg_sk, dev_sk, gpu_sk) = self._bulk_call(
-                statics,
-                state,
-                seg_pods,
-                jnp.asarray(ks),
-                tensors.n_domains,
-                k_cap,
-                flags,
-            )
-            # [S, k_cap] each — one compact transfer per output
-            assign_host = np.asarray(assign_sk)
-            vg_host = np.asarray(vg_sk)
-            dev_host = np.asarray(dev_sk)
-            gpu_host = np.asarray(gpu_sk)
+            leftovers = []
             lvm_sizes = np.asarray(ext["lvm_size"])
             dev_sizes = np.asarray(ext["dev_size"])
-            leftovers = []
-            for s, (_, i0, j0) in enumerate(run):
-                row = assign_host[s]
-                placed = int((row >= 0).sum())
-                nodes[i0 : i0 + placed] = row[:placed]
-                reasons[i0 : i0 + placed] = 0
-                if placed:
-                    sel = np.arange(i0, i0 + placed)
-                    if lvm_sizes.shape[1] and lvm_sizes[i0].max() > 0:
-                        vgs = vg_host[s, :placed]
-                        ok_v = vgs >= 0
-                        lvm_alloc[sel[ok_v], vgs[ok_v]] = lvm_sizes[i0].max()
-                    if dev_sizes.shape[1] and dev_sizes[i0].max() > 0:
-                        devs = dev_host[s, :placed]
-                        ok_d = devs >= 0
-                        dev_take[sel[ok_d], devs[ok_d]] = True
-                    if gpu_mem[i0] > 0:
-                        gpus = gpu_host[s, :placed]
-                        ok_g = gpus >= 0
-                        gpu_shares[sel[ok_g], gpus[ok_g]] = 1.0
-                if placed < j0 - i0:
-                    leftovers.append((i0 + placed, j0))
-            # leftovers re-check through the serial step, which yields the
-            # exact failure reason; they run after the whole bulk batch, so
-            # their reasons reflect a (more-constrained) later state
+            for chunk, rows_p in self._chunk_runs(run, batch, tensors):
+                state, hosts = self._bulk_chunk(
+                    statics, state, chunk, rows_p, pods, tensors, flags
+                )
+                self._record_chunk(
+                    chunk, hosts, nodes, reasons, lvm_alloc, dev_take,
+                    gpu_shares, gpu_mem, lvm_sizes, dev_sizes, leftovers,
+                )
+            # Leftovers re-check after the whole bulk stretch, so their
+            # reasons reflect the (more-constrained) final state.
             # Leftover pods of one run are IDENTICAL, and a failed serial
             # step leaves the state untouched — so probe them one at a time
             # and stamp the first failure's reason onto the whole remainder

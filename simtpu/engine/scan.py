@@ -173,7 +173,12 @@ def build_pod_arrays(batch: PodBatch, n_resources: int):
 
 
 def _compact_terms(tensors: ClusterTensors):
-    """Per-group relevant-term compaction (see StaticArrays.g_terms)."""
+    """Per-group relevant-term compaction (see StaticArrays.g_terms).
+    Memoized on the tensors object — statics_from and the rounds engine's
+    chunked dispatch both need it per place()."""
+    cached = getattr(tensors, "_compact_cache", None)
+    if cached is not None:
+        return cached
     g_n, t_n = tensors.s_match.shape
     relevant = (
         tensors.s_match
@@ -199,18 +204,28 @@ def _compact_terms(tensors: ClusterTensors):
             out[gi, : len(ids)] = mat[gi, ids]
         return out
 
+    object.__setattr__(tensors, "_compact_cache", (g_terms, compact))
     return g_terms, compact
 
 
 def statics_from(tensors: ClusterTensors, sched_config=None) -> StaticArrays:
+    """Device-resident per-simulation constants. Memoized on the tensors
+    object: a fresh engine over the same frozen tensors (capacity probes,
+    best-of-N benching) must not re-transfer ~GBs of [G, N] planes — on a
+    tunneled TPU the transfer alone costs tens of seconds."""
     from ..schedconfig import DEFAULT_WEIGHTS
 
+    cached = getattr(tensors, "_statics_cache", None)
+    # the cached config is held by reference and compared with `is`: an id()
+    # key would silently alias a recycled object address
+    if cached is not None and cached[0] is sched_config:
+        return cached[1]
     ext = tensors.ext
     g_terms, compact = _compact_terms(tensors)
     score_w = (
         sched_config.score_weights if sched_config is not None else DEFAULT_WEIGHTS
     )
-    return StaticArrays(
+    statics = StaticArrays(
         alloc=jnp.asarray(tensors.alloc, jnp.float32),
         static_mask=jnp.asarray(tensors.static_mask),
         vol_mask=jnp.asarray(tensors.vol_mask),
@@ -251,6 +266,8 @@ def statics_from(tensors: ClusterTensors, sched_config=None) -> StaticArrays:
         score_w=jnp.asarray(score_w, jnp.float32),
         node_valid=jnp.ones(tensors.alloc.shape[0], bool),
     )
+    object.__setattr__(tensors, "_statics_cache", (sched_config, statics))
+    return statics
 
 
 class StepFlags(NamedTuple):

@@ -20,18 +20,25 @@ from ..native import scatter_add_flat, scatter_add_rows
 def interpod_term_index(tensors) -> np.ndarray:
     """[T] → row in the compacted interpod ("own") count planes, -1 when the
     term appears in no group's required/preferred (anti-)affinity. Ascending
-    term order; shared by statics_from and build_state so plane rows agree."""
+    term order; shared by statics_from and build_state so plane rows agree.
+    Memoized on the tensors object — the rounds engine's chunked dispatch
+    asks per chunk."""
+    cached = getattr(tensors, "_ip_of_cache", None)
+    if cached is not None:
+        return cached
     t = tensors.n_terms
     if not t:
-        return np.zeros(0, np.int32)
-    used = (
-        tensors.a_aff_req.any(axis=0)
-        | tensors.a_anti_req.any(axis=0)
-        | (tensors.w_aff_pref != 0).any(axis=0)
-        | (tensors.w_anti_pref != 0).any(axis=0)
-    )
-    ip_of = np.full(t, -1, np.int32)
-    ip_of[used] = np.arange(int(used.sum()), dtype=np.int32)
+        ip_of = np.zeros(0, np.int32)
+    else:
+        used = (
+            tensors.a_aff_req.any(axis=0)
+            | tensors.a_anti_req.any(axis=0)
+            | (tensors.w_aff_pref != 0).any(axis=0)
+            | (tensors.w_anti_pref != 0).any(axis=0)
+        )
+        ip_of = np.full(t, -1, np.int32)
+        ip_of[used] = np.arange(int(used.sum()), dtype=np.int32)
+    object.__setattr__(tensors, "_ip_of_cache", ip_of)
     return ip_of
 
 

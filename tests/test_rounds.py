@@ -163,3 +163,53 @@ class TestBulkEquivalence:
         # 10 cpu total: pre(1) + 9 filler = full; "post" must fail
         assert len(bulk.unscheduled_pods) == 1
         assert bulk.unscheduled_pods[0].pod["metadata"]["name"].startswith("post")
+
+
+def test_chunked_rows_equivalent_to_whole_plane(monkeypatch):
+    """Forcing a tiny ROW_BUDGET must not change placements: chunked bulk
+    calls carry only each chunk's cnt-plane rows and scatter them back."""
+    import numpy as np
+
+    from simtpu import simulate
+    from simtpu.engine.rounds import RoundsEngine
+    from simtpu.synth import synth_apps, synth_cluster
+    from simtpu.workloads.expand import seed_name_hashes
+
+    cluster = synth_cluster(24, seed=5, zones=3, taint_frac=0.1)
+    apps = synth_apps(
+        160,
+        seed=6,
+        zones=3,
+        pods_per_deployment=16,
+        selector_frac=0.2,
+        anti_affinity_frac=0.3,
+        spread_frac=0.3,
+    )
+    seed_name_hashes(5)
+    whole = simulate(cluster, apps, engine_factory=RoundsEngine)
+
+    class Chunked(RoundsEngine):
+        ROW_BUDGET = 4
+
+    chunk_counts = []
+    orig = Chunked._chunk_runs
+
+    def spy(self, run, batch, tensors):
+        out = list(orig(self, run, batch, tensors))
+        chunk_counts.append(len(out))
+        return iter(out)
+
+    monkeypatch.setattr(Chunked, "_chunk_runs", spy)
+    seed_name_hashes(5)
+    chunked = simulate(cluster, apps, engine_factory=Chunked)
+    assert sum(chunk_counts) > 1, "the chunked path never engaged"
+
+    def placements(res):
+        return {
+            p["metadata"]["name"]: st.node["metadata"]["name"]
+            for st in res.node_status
+            for p in st.pods
+        }
+
+    assert placements(whole) == placements(chunked)
+    assert len(whole.unscheduled_pods) == len(chunked.unscheduled_pods)
