@@ -1111,8 +1111,29 @@ class Tensorizer:
         )
 
     def freeze(self) -> ClusterTensors:
-        """Materialize the dense arrays for the current vocabularies."""
+        """Materialize the dense arrays for the current vocabularies.
+
+        Memoized on the vocabulary sizes: Engine.place freezes per batch,
+        and re-stacking the [G, N] planes for an unchanged vocabulary costs
+        seconds at 100k nodes (the frozen object also carries the memoized
+        statics/compaction caches, so reuse preserves those too). Any growth
+        in groups/terms/ports/vols/resources/attach classes — the only
+        mutations add_pods can make — changes the key and rebuilds.
+        """
         n, g_n, t_n = len(self.nodes), len(self.groups), len(self.terms)
+        key = (
+            n,
+            g_n,
+            t_n,
+            len(self.ports),
+            len(self.vols),
+            len(self.resources),
+            len(self.attach_classes),
+            len(self.domains),
+        )
+        cached = getattr(self, "_freeze_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
 
         def dense(rows: List[Dict[int, float]], dtype) -> np.ndarray:
             out = np.zeros((g_n, t_n), dtype)
@@ -1145,7 +1166,7 @@ class Tensorizer:
         vol_class_mask = np.zeros((len(self.attach_classes), w_n), bool)
         for w, cls in self._vol_class.items():
             vol_class_mask[cls, w] = True
-        return ClusterTensors(
+        tensors = ClusterTensors(
             node_names=list(self.label_index.names),
             resource_names=[str(r) for r in self.resources.items()],
             alloc=self.alloc.copy(),
@@ -1193,3 +1214,5 @@ class Tensorizer:
             ext=self.ext,
             label_index=self.label_index,
         )
+        self._freeze_cache = (key, tensors)
+        return tensors

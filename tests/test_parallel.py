@@ -165,3 +165,36 @@ class TestGraftEntry:
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         mod.dryrun_multichip(8)
+
+
+class TestShardedChunkedRounds:
+    def test_chunked_rows_identical_under_gspmd(self):
+        """The chunked row-carry path (ROW_BUDGET) must also be placement-
+        identical when the node axis is sharded over the mesh."""
+        from simtpu.engine.rounds import RoundsEngine
+        from simtpu.parallel import ShardedRoundsEngine
+
+        cluster = synth_cluster(16, seed=41, zones=3, taint_frac=0.1)
+        apps = synth_apps(
+            96,
+            seed=42,
+            zones=3,
+            pods_per_deployment=12,
+            selector_frac=0.2,
+            anti_affinity_frac=0.3,
+            spread_frac=0.4,
+        )
+        seed_name_hashes(3)
+        base = simulate(cluster, apps, engine_factory=RoundsEngine)
+
+        mesh = make_mesh(sweep=1)
+
+        class Chunked(ShardedRoundsEngine):
+            ROW_BUDGET = 4
+
+        seed_name_hashes(3)
+        sharded = simulate(
+            cluster, apps, engine_factory=lambda t: Chunked(t, mesh)
+        )
+        assert _placements(base) == _placements(sharded)
+        assert len(base.unscheduled_pods) == len(sharded.unscheduled_pods)
