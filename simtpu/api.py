@@ -27,6 +27,7 @@ from .core.objects import (
     pod_priority,
     set_annotation,
     set_label,
+    shallow_pod_copy,
 )
 from .core.quantity import parse_quantity
 from .core.tensorize import Tensorizer, _group_of_pod
@@ -150,7 +151,7 @@ class Simulator:
     # -- internals ---------------------------------------------------------
 
     def _record_placed(self, pod: dict, node_idx: int, gpu_shares) -> None:
-        placed = deep_copy(pod)
+        placed = shallow_pod_copy(pod)
         placed["spec"]["nodeName"] = self._nodes[node_idx]["metadata"]["name"]
         placed.setdefault("status", {})["phase"] = "Running"
         # GPU device assignment annotation (GpuSharePlugin.Bind applies
@@ -481,7 +482,7 @@ class Simulator:
     def _result(self) -> SimulateResult:
         by_node = {name_of(n): [] for n in self._nodes}
         for pod in self._scheduled:
-            by_node[pod["spec"]["nodeName"]].append(deep_copy(pod))
+            by_node[pod["spec"]["nodeName"]].append(shallow_pod_copy(pod))
         nodes = [deep_copy(n) for n in self._nodes]
         self._write_extended_annotations(nodes)
         statuses = [NodeStatus(node=n, pods=by_node[name_of(n)]) for n in nodes]

@@ -93,6 +93,24 @@ def deep_copy(obj: dict) -> dict:
     return copy.deepcopy(obj)
 
 
+def shallow_pod_copy(pod: dict) -> dict:
+    """A pod copy isolated exactly where the simulator mutates: top level,
+    metadata (+labels/annotations), spec, status. Deep sub-structures
+    (containers, volumes, affinity, ...) are shared read-only — at
+    million-pod scale `copy.deepcopy` per placed pod (and again per
+    `_result()` call) dominated the whole facade."""
+    placed = dict(pod)
+    meta = dict(pod.get("metadata") or {})
+    if "annotations" in meta:
+        meta["annotations"] = dict(meta["annotations"])
+    if "labels" in meta:
+        meta["labels"] = dict(meta["labels"])
+    placed["metadata"] = meta
+    placed["spec"] = dict(pod.get("spec") or {})
+    placed["status"] = dict(pod.get("status") or {})
+    return placed
+
+
 # ---------------------------------------------------------------------------
 # Pod helpers
 # ---------------------------------------------------------------------------

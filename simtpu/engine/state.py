@@ -14,7 +14,7 @@ from typing import NamedTuple
 import jax.numpy as jnp
 import numpy as np
 
-from ..native import scatter_add_flat, scatter_add_rows
+from ..native import scatter_add_rows
 
 
 def interpod_term_index(tensors) -> np.ndarray:
@@ -173,56 +173,67 @@ def build_state(
         present = rw | tensors.vol_ro[placed_group] | tensors.vol_att[placed_group]
         _add_at_rows(vols_any, placed_node, present.astype(np.float32))
         _add_at_rows(vols_rw, placed_node, rw.astype(np.float32))
-    cnt = np.zeros((5, max(t, 0), d), np.float32)
     if len(placed_group):
         req = placed_req
         if req.shape[1] < r:  # resource vocab grew after this pod was logged
             req = np.pad(req, ((0, 0), (0, r - req.shape[1])))
         _add_at_rows(free, placed_node, -req)
-        if t:
-            # domain of each placement for each term's topology key: [P, T]
-            dom_pt = tensors.node_dom[tensors.term_topo_key][:, placed_node].T
-            valid = dom_pt >= 0
-            incid = np.stack(
-                [
-                    tensors.s_match[placed_group],
-                    tensors.a_anti_req[placed_group],
-                    tensors.a_aff_req[placed_group],
-                    tensors.w_aff_pref[placed_group],
-                    tensors.w_anti_pref[placed_group],
-                ]
-            ).astype(np.float32)  # [5, P, T]
-            t_idx = np.broadcast_to(np.arange(t), dom_pt.shape)
-            flat = (t_idx[valid].astype(np.int64) * d + dom_pt[valid]).ravel()
-            for s in range(5):
-                vals = incid[s][valid]
-                if not scatter_add_flat(cnt[s], flat, vals):
-                    np.add.at(
-                        cnt[s],
-                        (t_idx[valid], dom_pt[valid]),
-                        vals,
-                    )
-    # per-domain counts → per-node counts (the scan-state layout, SchedState);
-    # the own planes are expanded only over their compacted interpod rows
-    if t:
-        dom_tn = tensors.dom_tn()  # [T, N]
-        valid_tn = dom_tn >= 0
-        safe_tn = np.where(valid_tn, dom_tn, 0)
-        t_col = np.arange(t)[:, None]
-        cnt_match = np.where(
-            valid_tn, cnt[0][t_col, safe_tn], 0.0
-        ).astype(np.float32)
-        ip_terms = np.flatnonzero(ip_of >= 0)  # ascending = plane row order
-        own_n = np.where(
-            valid_tn[ip_terms][None],
-            cnt[1:][:, ip_terms[:, None], safe_tn[ip_terms]],
-            0.0,
-        ).astype(np.float32)  # [4, Ti, N]
-        cnt_total = cnt[0].sum(axis=1)
-    else:
-        cnt_match = np.zeros((0, n), np.float32)
-        own_n = np.zeros((4, 0, n), np.float32)
-        cnt_total = np.zeros(0, np.float32)
+    # Topology counts rebuild via group-level aggregation — the count of
+    # term t in node n's domain is Σ_g incid[g, t] · (placements of group g
+    # in that domain), so ONE [P]-length (group, node) scatter plus a
+    # per-domain segment sum per topology key replaces any per-placement
+    # per-term work (the previous [P, T] formulation allocated tens of GB
+    # at million-pod log sizes). Per-term rows then accumulate over the
+    # sparse (group, term) incidence pairs.
+    ip_terms = np.flatnonzero(ip_of >= 0)  # ascending = plane row order
+    cnt_match = np.zeros((t, n), np.float32)
+    own_n = np.zeros((4, len(ip_terms), n), np.float32)
+    cnt_total = np.zeros(t, np.float32)
+    if t and len(placed_group):
+        g_n = len(tensors.groups)
+        term_topo = tensors.term_topo_key
+        key_valid = tensors.node_dom >= 0  # [K, N]
+        # one [P]-length scatter via bincount (np.add.at's buffered path is
+        # ~10x slower at million-entry logs)
+        flat = placed_group.astype(np.int64) * n + placed_node
+        cnt_gn = (
+            np.bincount(flat, minlength=g_n * n)
+            .reshape(g_n, n)
+            .astype(np.float32)
+        )
+        # per-key [D, G] domain aggregates and cached safe domain indices
+        # (rows without the key carry 0)
+        cnt_dg, safe_k = {}, {}
+        for k in {int(x) for x in term_topo[:t]}:
+            safe_k[k] = np.where(key_valid[k], tensors.node_dom[k], 0)
+            src = np.where(key_valid[k][None, :], cnt_gn, 0.0).T.copy()  # [N, G]
+            buf = np.zeros((d, g_n), np.float32)
+            _add_at_rows(buf, safe_k[k], src)
+            cnt_dg[k] = buf
+        tot_kg = {k: buf.sum(axis=0) for k, buf in cnt_dg.items()}
+
+        def fill_rows(dst, term_ids, incid, totals=None):
+            """dst[i] += Σ_g incid[g, term_ids[i]] · domain-count row of g;
+            `totals` accumulates the per-term cluster-wide sum in the same
+            pass over the sparse incidence pairs."""
+            sub = np.asarray(incid[:, term_ids], np.float32)
+            for g_i, t_i in zip(*np.nonzero(sub)):
+                k = int(term_topo[term_ids[t_i]])
+                row = np.where(key_valid[k], cnt_dg[k][safe_k[k], g_i], 0.0)
+                dst[t_i] += sub[g_i, t_i] * row
+                if totals is not None:
+                    totals[term_ids[t_i]] += sub[g_i, t_i] * tot_kg[k][g_i]
+
+        fill_rows(cnt_match, np.arange(t), tensors.s_match, totals=cnt_total)
+        for s_i, mat in enumerate(
+            (
+                tensors.a_anti_req,
+                tensors.a_aff_req,
+                tensors.w_aff_pref,
+                tensors.w_anti_pref,
+            )
+        ):
+            fill_rows(own_n[s_i], ip_terms, mat)
     return SchedState(
         free=jnp.asarray(free),
         cnt_match=jnp.asarray(cnt_match),
