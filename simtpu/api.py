@@ -576,7 +576,14 @@ def simulate(
     node axis sharded over a device mesh (simtpu/parallel), or `bulk=True`
     to place same-spec pod runs in bulk rounds (engine/rounds.py —
     feasibility-exact, tie-breaking may differ from the serial scan). The two
-    are mutually exclusive."""
+    are mutually exclusive.
+
+    Result pods are copied at the levels the simulation wrote (top level,
+    metadata incl. labels/annotations, spec, status); deeper sub-structures
+    (containers, volumes, affinity, ...) are shared READ-ONLY with the input
+    objects — treat returned pods as immutable below those layers, or
+    deep-copy before mutating (at million-pod scale a full deep copy per
+    placed pod costs more than the placement itself)."""
     if bulk:
         if engine_factory is not None:
             raise ValueError("bulk=True and engine_factory are mutually exclusive")

@@ -436,22 +436,6 @@ class ClusterTensors:
 
     label_index: NodeLabelIndex = field(repr=False, default=None)
 
-    def dom_tn(self) -> np.ndarray:
-        """[T, N] node n's domain id for term t's topology key (-1 absent).
-
-        Cached: both the engine statics and the state rebuild consume it, and
-        it is O(T·N) to materialize.
-        """
-        cached = getattr(self, "_dom_tn_cache", None)
-        if cached is None:
-            cached = (
-                self.node_dom[self.term_topo_key]
-                if self.n_terms
-                else np.zeros((0, len(self.node_names)), np.int32)
-            )
-            object.__setattr__(self, "_dom_tn_cache", cached)
-        return cached
-
     @property
     def n_nodes(self) -> int:
         return len(self.node_names)

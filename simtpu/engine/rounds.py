@@ -662,7 +662,7 @@ class RoundsEngine(Engine):
                 cnt_match=_scatter_rows(full_match, rows_dev, state_chunk.cnt_match),
                 cnt_total=_scatter_rows(full_total, rows_dev, state_chunk.cnt_total),
             )
-        return state, tuple(np.asarray(o) for o in jax.device_get(outs))
+        return state, outs
 
     @staticmethod
     def _record_chunk(
@@ -732,10 +732,19 @@ class RoundsEngine(Engine):
             leftovers = []
             lvm_sizes = np.asarray(ext["lvm_size"])
             dev_sizes = np.asarray(ext["dev_size"])
+            # dispatch every chunk first — jit calls are async and the
+            # inter-chunk state dependency stays device-side, so the tunnel
+            # pipelines all rounds; outputs materialize afterwards, and the
+            # host record work overlaps the device queue instead of
+            # synchronizing once per chunk
+            pending = []
             for chunk, rows_p in self._chunk_runs(run, batch, tensors):
-                state, hosts = self._bulk_chunk(
+                state, outs_dev = self._bulk_chunk(
                     statics, state, chunk, rows_p, pods, tensors, flags
                 )
+                pending.append((chunk, outs_dev))
+            for chunk, outs_dev in pending:
+                hosts = tuple(np.asarray(o) for o in jax.device_get(outs_dev))
                 self._record_chunk(
                     chunk, hosts, nodes, reasons, lvm_alloc, dev_take,
                     gpu_shares, gpu_mem, lvm_sizes, dev_sizes, leftovers,

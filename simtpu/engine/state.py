@@ -57,7 +57,7 @@ class SchedState(NamedTuple):
     Topology counts are stored **per node**, not per domain: `cnt_*[t, n]` is
     the count in node n's domain for term t's topology key (0 where the node
     misses the key). Placing a pod updates them with one vectorized
-    same-domain compare (`dom_tn == dom_tn[:, chosen]`) — no gather or
+    same-domain compare (`dom_sub == dom_sub[:, chosen]`) — no gather or
     scatter appears anywhere in the scan step, which is what keeps the step
     fast on TPU (gathers over the domain axis were the dominant cost), and
     the [T, N] layout shards over the node axis with everything else.
@@ -212,19 +212,31 @@ def build_state(
             cnt_dg[k] = buf
         tot_kg = {k: buf.sum(axis=0) for k, buf in cnt_dg.items()}
 
+        row_cache = {}  # (key, group) → expanded [N] domain-count row
+
+        def group_row(k, g_i):
+            got = row_cache.get((k, g_i))
+            if got is None:
+                got = np.where(key_valid[k], cnt_dg[k][safe_k[k], g_i], 0.0)
+                row_cache[(k, g_i)] = got
+            return got
+
         def fill_rows(dst, term_ids, incid, totals=None):
             """dst[i] += Σ_g incid[g, term_ids[i]] · domain-count row of g;
             `totals` accumulates the per-term cluster-wide sum in the same
-            pass over the sparse incidence pairs."""
-            sub = np.asarray(incid[:, term_ids], np.float32)
+            pass over the sparse incidence pairs. Rows are cached per
+            (topology key, group) — one group commonly matches many terms
+            sharing a key (SelectorSpread interns several per controller)."""
+            sub = incid if term_ids is None else incid[:, term_ids]
             for g_i, t_i in zip(*np.nonzero(sub)):
-                k = int(term_topo[term_ids[t_i]])
-                row = np.where(key_valid[k], cnt_dg[k][safe_k[k], g_i], 0.0)
-                dst[t_i] += sub[g_i, t_i] * row
+                tid = t_i if term_ids is None else term_ids[t_i]
+                k = int(term_topo[tid])
+                w = float(sub[g_i, t_i])
+                dst[t_i] += w * group_row(k, g_i)
                 if totals is not None:
-                    totals[term_ids[t_i]] += sub[g_i, t_i] * tot_kg[k][g_i]
+                    totals[tid] += w * tot_kg[k][g_i]
 
-        fill_rows(cnt_match, np.arange(t), tensors.s_match, totals=cnt_total)
+        fill_rows(cnt_match, None, tensors.s_match, totals=cnt_total)
         for s_i, mat in enumerate(
             (
                 tensors.a_anti_req,
