@@ -371,6 +371,28 @@ def _deep_merge(dst, *srcs):
     return out
 
 
+def _int_strict(x):
+    """Integer operand for sprig arithmetic: non-integral or non-numeric
+    operands raise rather than silently truncating (the fail-loud contract —
+    Go/sprig would coerce through int64, changing the value). Ints pass
+    through exactly (never via float, which rounds above 2^53); integral
+    floats and numeric strings are accepted."""
+    if isinstance(x, int) and not isinstance(x, bool):
+        return x
+    if isinstance(x, str):
+        try:
+            return int(x)  # exact for arbitrarily large integer strings
+        except ValueError:
+            pass  # "3.0" falls through to the float path
+    try:
+        f = float(x)
+    except (TypeError, ValueError):
+        raise ChartRenderError(f"non-numeric operand {x!r} to integer arithmetic")
+    if f != int(f):
+        raise ChartRenderError(f"non-integral operand {x!r} to integer arithmetic")
+    return int(f)
+
+
 _FUNCS = {
     "int": lambda a: int(float(a)) if a not in (None, "") else 0,
     "int64": lambda a: int(float(a)) if a not in (None, "") else 0,
@@ -415,14 +437,14 @@ _FUNCS = {
     "ge": lambda a, b: a >= b,
     "and": lambda *a: next((x for x in a if not _truthy(x)), a[-1] if a else None),
     "or": lambda *a: next((x for x in a if _truthy(x)), a[-1] if a else None),
-    "add": lambda *a: sum(int(x) for x in a),
-    "add1": lambda a: int(a) + 1,
-    "sub": lambda a, b: int(a) - int(b),
-    "mul": lambda *a: __import__("math").prod(int(x) for x in a),
-    "div": lambda a, b: int(a) // int(b),
-    "mod": lambda a, b: int(a) % int(b),
-    "max": lambda *a: max(int(x) for x in a),
-    "min": lambda *a: min(int(x) for x in a),
+    "add": lambda *a: sum(_int_strict(x) for x in a),
+    "add1": lambda a: _int_strict(a) + 1,
+    "sub": lambda a, b: _int_strict(a) - _int_strict(b),
+    "mul": lambda *a: __import__("math").prod(_int_strict(x) for x in a),
+    "div": lambda a, b: _int_strict(a) // _int_strict(b),
+    "mod": lambda a, b: _int_strict(a) % _int_strict(b),
+    "max": lambda *a: max(_int_strict(x) for x in a),
+    "min": lambda *a: min(_int_strict(x) for x in a),
     "len": lambda a: len(a) if a is not None else 0,
     "list": lambda *a: list(a),
     "dict": _sprig_dict,
@@ -472,7 +494,14 @@ def _go_printf(fmt, *args):
                 out.append("%")
             elif spec == "q":
                 out.append('"%s"' % _format(next(it, "")))
-            elif spec in "sdvf":
+            elif spec == "d":
+                # integral floats and numeric strings render as the integer;
+                # a non-integral operand raises (Go would emit an
+                # %!d(float64=...) error marker — fail loud instead)
+                out.append(str(_int_strict(next(it, 0))))
+            elif spec == "f":
+                out.append("%f" % float(next(it, 0.0)))  # Go's 6-decimal default
+            elif spec in "sv":
                 out.append(_format(next(it, "")))
             else:
                 raise ChartRenderError(f"printf: unsupported verb %{spec}")

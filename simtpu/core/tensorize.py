@@ -608,6 +608,11 @@ class Tensorizer:
         # CSI defaults to no limit — upstream enforces only a published limit
         self.attach_classes: List[tuple] = list(ATTACH_CLASSES)
         self._csi_class: Dict[str, int] = {}  # driver → class index
+        # content fingerprint for the freeze() memo: every mutation today
+        # grows a vocabulary (already part of the cache key), but any FUTURE
+        # mutator that edits array contents in place (node allocatable, a
+        # group row) MUST bump this counter or freeze() returns stale tensors
+        self._content_version = 0
 
     # -- topology ----------------------------------------------------------
 
@@ -1114,6 +1119,7 @@ class Tensorizer:
             len(self.resources),
             len(self.attach_classes),
             len(self.domains),
+            self._content_version,
         )
         cached = getattr(self, "_freeze_cache", None)
         if cached is not None and cached[0] == key:

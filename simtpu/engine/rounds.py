@@ -583,7 +583,10 @@ class RoundsEngine(Engine):
         where rows_p is the padded term-row list the chunk's scan carries
         (None = carry the full plane, for small term vocabularies)."""
         t = tensors.n_terms
-        if t <= self.ROW_BUDGET:
+        # chunking only pays when a budget-sized chunk pads to FEWER rows
+        # than the full plane; otherwise every chunk would carry the plane
+        # anyway and the split just multiplies dispatches
+        if self._pow2(min(t, self.ROW_BUDGET)) >= t:
             yield run, None
             return
         g_terms, _, _ = self._host_term_maps(tensors)
@@ -593,7 +596,13 @@ class RoundsEngine(Engine):
             seg_terms = {
                 int(x) for x in g_terms[group[seg[1]]] if x >= 0
             }
-            if chunk and len(rows | seg_terms) > self.ROW_BUDGET:
+            # never split off a chunk that would carry the full plane anyway
+            # (rows already past the pow2-under-t point): keep extending it
+            if (
+                chunk
+                and len(rows | seg_terms) > self.ROW_BUDGET
+                and self._pow2(len(rows)) < t
+            ):
                 yield chunk, self._pad_rows(sorted(rows), t)
                 chunk, rows = [], set()
             chunk.append(seg)
@@ -605,10 +614,15 @@ class RoundsEngine(Engine):
         """Pad the row list to a power of two with DISTINCT unused term ids
         (their gathered values pass through the scan unchanged, so the
         scatter-back is a no-op for them; duplicate indices in a scatter
-        would let a stale copy overwrite the updated row)."""
+        would let a stale copy overwrite the updated row). Returns None when
+        the next power of two cannot fit in t: a clamped, non-pow2 row count
+        would defeat the shape bucketing and recompile per chunk — carrying
+        the full plane keeps the compiled-shape set bounded."""
         rows = np.asarray(rows, np.int32)
         u_pad = self._pow2(len(rows))
-        pad = min(u_pad, t) - len(rows)
+        if u_pad >= t:  # padding to >= the full plane = just carry the plane
+            return None
+        pad = u_pad - len(rows)
         if pad > 0:
             unused = np.setdiff1d(
                 np.arange(t, dtype=np.int32), rows, assume_unique=False
