@@ -243,32 +243,3 @@ def pod_extended_demand(
     )
 
 
-def stack_demands(demands: List[PodExtendedDemand], n_gpu_devices: int = 1) -> dict:
-    """Pad per-pod ragged demand lists into dense arrays for the scan."""
-    p = len(demands)
-    l_max = max([len(d.lvm_sizes) for d in demands] + [1])
-    k_max = max([len(d.dev_sizes) for d in demands] + [1])
-    gd = max(n_gpu_devices, 1)
-    out = {
-        "lvm_size": np.zeros((p, l_max), np.float32),
-        "lvm_vg": np.full((p, l_max), -1, np.int32),
-        "dev_size": np.zeros((p, k_max), np.float32),
-        "dev_media": np.zeros((p, k_max), np.int32),
-        "gpu_mem": np.zeros(p, np.float32),
-        "gpu_count": np.zeros(p, np.int32),
-        "gpu_preset": np.zeros((p, gd), np.float32),
-    }
-    for i, d in enumerate(demands):
-        out["lvm_size"][i, : len(d.lvm_sizes)] = d.lvm_sizes
-        out["lvm_vg"][i, : len(d.lvm_vg_ids)] = d.lvm_vg_ids
-        out["dev_size"][i, : len(d.dev_sizes)] = d.dev_sizes
-        out["dev_media"][i, : len(d.dev_medias)] = d.dev_medias
-        out["gpu_mem"][i] = d.gpu_mem
-        out["gpu_count"][i] = d.gpu_count
-        for dev_id in d.gpu_preset:
-            # device ids beyond the cluster's device table are silently
-            # ignored, exactly like the reference's guarded map lookup
-            # (`gpunodeinfo.go:108-110` `if dev, found := n.devs[idx]; found`)
-            if 0 <= dev_id < gd:
-                out["gpu_preset"][i, dev_id] += 1.0
-    return out

@@ -36,7 +36,6 @@ from .extended import (
     ExtendedNodeArrays,
     StorageClassCatalog,
     pod_extended_demand,
-    stack_demands,
     tensorize_node_storage,
 )
 from .match import (
@@ -344,6 +343,9 @@ def _group_of_pod(pod: dict) -> Tuple[PodGroup, Optional[str]]:
 # ---------------------------------------------------------------------------
 
 
+_UNPARSED = object()
+
+
 @dataclass(frozen=True)
 class Term:
     topology_key: str
@@ -352,7 +354,17 @@ class Term:
 
     @property
     def selector(self) -> dict:
-        return json.loads(self.selector_json)
+        """Parsed labelSelector, cached on the instance — s_match refresh
+        touches terms repeatedly and a per-call json.loads dominated it at
+        scale. The cache lives and dies with the Term (no process-global
+        growth); callers treat the returned dict as read-only. eq/hash use
+        the declared fields only, so the cache slot does not affect
+        interning."""
+        got = getattr(self, "_parsed", _UNPARSED)
+        if got is _UNPARSED:
+            got = json.loads(self.selector_json)
+            object.__setattr__(self, "_parsed", got)
+        return got
 
 
 def _terms_of(spec_terms: list, default_ns: str) -> List[Tuple[Term, float]]:
@@ -378,6 +390,56 @@ def _terms_of(spec_terms: list, default_ns: str) -> List[Tuple[Term, float]]:
             )
         )
     return out
+
+
+class _RowTable:
+    """Growing [G, N] plane with capacity doubling.
+
+    Replaces per-group Python lists of [N] rows: freeze() used to np.stack
+    ~2 GB of them at 1000 groups × 100k nodes (seconds per plane); here rows
+    land in place and freeze() returns a zero-copy view. `append(None)`
+    leaves the row at the fill value without touching memory — most planes
+    (ImageLocality, preferred affinity, avoid penalties, volume masks) are
+    all-fill for most groups.
+    """
+
+    def __init__(self, n: int, dtype, fill=0):
+        self.n = n
+        self.dtype = np.dtype(dtype)
+        self.fill = fill
+        self.rows = 0
+        self.buf = self._alloc(16)
+
+    def _alloc(self, cap: int) -> np.ndarray:
+        if self.fill == 0 or self.fill is False:
+            return np.zeros((cap, self.n), self.dtype)
+        out = np.empty((cap, self.n), self.dtype)
+        out.fill(self.fill)
+        return out
+
+    def append(self, row: Optional[np.ndarray]) -> None:
+        if self.rows == self.buf.shape[0]:
+            new = self._alloc(self.buf.shape[0] * 2)
+            new[: self.rows] = self.buf
+            self.buf = new
+        if row is not None:
+            self.buf[self.rows] = row
+        self.rows += 1
+
+    def view(self) -> np.ndarray:
+        """[rows, N] zero-copy view. Later appends only write rows beyond it
+        (or reallocate), so a frozen view's contents never change."""
+        return self.buf[: self.rows]
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        # bound-check against rows, not capacity: an index into the grown
+        # tail would silently return a fill row and mask an off-by-one
+        if not 0 <= i < self.rows:
+            raise IndexError(i)
+        return self.buf[i]
+
+    def __len__(self) -> int:
+        return self.rows
 
 
 # ---------------------------------------------------------------------------
@@ -458,7 +520,7 @@ class PodBatch:
     req: np.ndarray  # [P, R] f32 (includes the synthetic `pods`=1 resource)
     pin: np.ndarray  # [P] i32 node index or -1
     forced: np.ndarray  # [P] bool — pre-assigned via spec.nodeName
-    ext: dict = None  # stacked extended demand (see extended.stack_demands)
+    ext: dict = None  # stacked extended demand arrays (built by add_pods)
 
 
 class Tensorizer:
@@ -492,19 +554,21 @@ class Tensorizer:
         self._pv_mask_cache: Dict[str, np.ndarray] = {}  # PVs are immutable
 
         # resource vocabulary: base + everything any node allocates
+        # (allocatable maps parse once and are reused by _attach_limits)
+        self._alloc_maps = [node_allocatable(node) for node in self.nodes]
         self.resources = Interner()
         for r in _BASE_RESOURCES:
             self.resources.intern(r)
-        for node in self.nodes:
-            for r in node_allocatable(node):
+        for am in self._alloc_maps:
+            for r in am:
                 self.resources.intern(r)
         for r in extra_resources:
             self.resources.intern(r)
 
         n, r = len(self.nodes), len(self.resources)
         self.alloc = np.zeros((n, r), np.float32)
-        for i, node in enumerate(self.nodes):
-            for rname, val in node_allocatable(node).items():
+        for i, am in enumerate(self._alloc_maps):
+            for rname, val in am.items():
                 self.alloc[i, self.resources.intern(rname)] = val
 
         self.taints: List[List[dict]] = [list(node_taints(nd)) for nd in self.nodes]
@@ -573,15 +637,21 @@ class Tensorizer:
         self.term_interner = Interner()
         self.terms: List[Term] = []
         self._term_topo: List[int] = []
+        # inverted term-selector index for s_match refresh: matchLabels-only
+        # selectors register under ONE (key, value) pair, so a group's
+        # candidate terms come from its own label pairs instead of a G×T scan
+        self._term_sel_index: Dict[Tuple[str, str], List[int]] = {}
+        self._term_general: List[int] = []  # terms needing full evaluation
 
         self.groups: List[PodGroup] = []
         self._group_ids: Dict[str, int] = {}
-        self._static_mask: List[np.ndarray] = []
-        self._vol_mask: List[np.ndarray] = []
-        self._node_pref: List[np.ndarray] = []
-        self._taint_intol: List[np.ndarray] = []
-        self._static_score: List[np.ndarray] = []
-        self._avoid_pen: List[np.ndarray] = []
+        self._smatch_done: List[int] = []  # per-group s_match term watermark
+        self._static_mask = _RowTable(n, bool)
+        self._vol_mask = _RowTable(n, bool, fill=True)
+        self._node_pref = _RowTable(n, np.float32)
+        self._taint_intol = _RowTable(n, np.float32)
+        self._static_score = _RowTable(n, np.float32)
+        self._avoid_pen = _RowTable(n, np.float32)
         # group×term incidence, grown row-wise (lists of dict[t]=val)
         self._s_match: List[Dict[int, bool]] = []
         self._a_aff: List[Dict[int, bool]] = []
@@ -621,11 +691,20 @@ class Tensorizer:
         if k >= 0:
             return k
         k = self.topo_keys.intern(key)
-        row = np.full(len(self.nodes), -1, np.int32)
-        for i, node in enumerate(self.nodes):
-            val = labels_of(node).get(key)
-            if val is not None:
-                row[i] = self.domains.intern((key, str(val)))
+        li = self.label_index
+        vid = li._vid.get(key)
+        if vid is None:
+            row = np.full(len(self.nodes), -1, np.int32)
+        else:
+            # domain id per label-value id, then one vectorized gather (a
+            # 100k-node Python loop per new topology key was measurable);
+            # vid -1 (key absent) indexes the -1 sentinel slot
+            vmap = li._vmap[key]
+            dom_of = np.empty(len(vmap) + 1, np.int32)
+            dom_of[-1] = -1
+            for v, j in vmap.items():
+                dom_of[j] = self.domains.intern((key, v))
+            row = dom_of[vid]
         self._node_dom_rows.append(row)
         return k
 
@@ -636,6 +715,19 @@ class Tensorizer:
         t = self.term_interner.intern(term)
         self.terms.append(term)
         self._term_topo.append(self._intern_topo_key(term.topology_key))
+        # register for the s_match candidate index: a matchLabels-only
+        # selector is findable through any one of its pairs; everything else
+        # (matchExpressions, empty selector) is evaluated for every group.
+        # A nil selector never matches and registers nowhere.
+        sel = term.selector
+        ml = (sel or {}).get("matchLabels") if isinstance(sel, dict) else None
+        me = (sel or {}).get("matchExpressions") if isinstance(sel, dict) else None
+        if sel is not None:
+            if ml and not me:
+                k_, v_ = min(ml.items())
+                self._term_sel_index.setdefault((k_, str(v_)), []).append(t)
+            else:
+                self._term_general.append(t)
         return t
 
     # -- groups ------------------------------------------------------------
@@ -698,8 +790,10 @@ class Tensorizer:
         self._pv_mask_cache[name_of(pv)] = mask
         return mask
 
-    def _volume_mask_for(self, g: PodGroup) -> np.ndarray:
+    def _volume_mask_for(self, g: PodGroup) -> Optional[np.ndarray]:
         """VolumeBinding + VolumeZone feasibility over nodes.
+        Returns None (= unconstrained, the row table's all-True fill) for
+        groups referencing no claims — the overwhelmingly common case.
 
         Mirrors `plugins/volumebinding/volume_binding.go` PreFilter/Filter and
         `plugins/volumezone/volume_zone.go`:
@@ -718,6 +812,8 @@ class Tensorizer:
           are scheduled by the storage kernels (`kernels/storage.py`) from the
           pod's local-storage annotation instead.
         """
+        if not g.pvc_refs:
+            return None
         li = self.label_index
         mask = np.ones(li.n, bool)
         open_local = set(C.SC_LVM) | set(C.SC_DEVICE_SSD) | set(C.SC_DEVICE_HDD)
@@ -777,9 +873,11 @@ class Tensorizer:
                 mask &= candidates
         return mask
 
-    def _node_pref_for(self, g: PodGroup) -> np.ndarray:
+    def _node_pref_for(self, g: PodGroup) -> Optional[np.ndarray]:
         """NodeAffinity preferred raw score (sum of matching term weights),
-        mirroring `plugins/nodeaffinity` Score."""
+        mirroring `plugins/nodeaffinity` Score. None = all-zero."""
+        if not g.affinity_preferred:
+            return None
         score = np.zeros(self.label_index.n, np.float32)
         for item in g.affinity_preferred:
             w = float(item.get("weight", 0))
@@ -787,12 +885,15 @@ class Tensorizer:
             score += w * self.label_index.match_term(pref).astype(np.float32)
         return score
 
-    def _taint_intol_for(self, g: PodGroup) -> np.ndarray:
+    def _taint_intol_for(self, g: PodGroup) -> Optional[np.ndarray]:
         """Count of PreferNoSchedule taints the group does not tolerate
-        (`plugins/tainttoleration` Score)."""
-        out = np.zeros(self.label_index.n, np.float32)
+        (`plugins/tainttoleration` Score). None = all-zero (no
+        PreferNoSchedule taints in the cluster, or all tolerated)."""
+        out = None
         for t, taint in enumerate(self._pref_taints):
             if not any(toleration_tolerates_taint(tol, taint) for tol in g.tolerations):
+                if out is None:
+                    out = np.zeros(self.label_index.n, np.float32)
                 out += self._pref_taint_incid[t]
         return out
 
@@ -800,20 +901,21 @@ class Tensorizer:
     _IMG_MIN = 23 * 1024 * 1024
     _IMG_MAX = 1000 * 1024 * 1024
 
-    def _static_score_for(self, g: PodGroup) -> np.ndarray:
+    def _static_score_for(self, g: PodGroup) -> Optional[np.ndarray]:
         """ImageLocality score, which depends only on (group, node specs)
-        (`plugins/imagelocality/image_locality.go`; no NormalizeScore)."""
+        (`plugins/imagelocality/image_locality.go`; no NormalizeScore).
+        None = all-zero (no group image resides on any node — sub-threshold
+        sums score 0 anyway)."""
         n = self.label_index.n
+        imgs = [im for im in set(g.images) if im in self.image_index]
+        if not imgs or not n:
+            return None
         # sum of node-resident image sizes scaled by spread
         sum_scores = np.zeros(n, np.float64)
-        if n:
-            for img in set(g.images):
-                entry = self.image_index.get(img)
-                if entry is None:
-                    continue
-                have, size = entry
-                spread = have.sum() / n
-                sum_scores += np.where(have, size * spread, 0.0)
+        for img in imgs:
+            have, size = self.image_index[img]
+            spread = have.sum() / n
+            sum_scores += np.where(have, size * spread, 0.0)
         img_score = np.clip(
             (sum_scores - self._IMG_MIN) * 100.0 / (self._IMG_MAX - self._IMG_MIN),
             0.0,
@@ -822,15 +924,15 @@ class Tensorizer:
         img_score[sum_scores < self._IMG_MIN] = 0.0
         return img_score.astype(np.float32)
 
-    def _avoid_penalty_for(self, g: PodGroup) -> np.ndarray:
+    def _avoid_penalty_for(self, g: PodGroup) -> Optional[np.ndarray]:
         """NodePreferAvoidPods for RC/RS-owned pods: upstream adds
         weight·score = 10000·100 on non-avoid nodes and 0 on avoid nodes.
         Adding ~1e6 uniformly would erase sub-0.0625 deltas from the other
         plugins in float32, so keep the argmax-equivalent penalty form:
-        0 baseline, -1e6 only on avoid-annotated nodes."""
-        if g.owner_kind in (C.KIND_RC, C.KIND_RS):
+        0 baseline, -1e6 only on avoid-annotated nodes. None = all-zero."""
+        if g.owner_kind in (C.KIND_RC, C.KIND_RS) and self.prefer_avoid.any():
             return -10000.0 * 100.0 * self.prefer_avoid.astype(np.float32)
-        return np.zeros(self.label_index.n, np.float32)
+        return None
 
     def _spread_selectors_for(self, g: PodGroup) -> List[dict]:
         """LabelSelectors the SelectorSpread score counts against: services
@@ -991,28 +1093,69 @@ class Tensorizer:
     def _attach_limits(self) -> np.ndarray:
         """[N, C] per-node attach limits: the published `attachable-volumes-*`
         allocatable, or the in-tree default when the key is absent (a
-        published 0 stays 0 — upstream only falls back when unset)."""
-        out = np.zeros((len(self.nodes), len(self.attach_classes)), np.float32)
-        for i, node in enumerate(self.nodes):
-            allocatable = node_allocatable(node)
-            for c, (res, default) in enumerate(self.attach_classes):
-                out[i, c] = allocatable.get(res, default)
+        published 0 stays 0 — upstream only falls back when unset).
+        Columns are cached per class count: classes only append (new CSI
+        drivers), and re-walking 100k parsed allocatable maps per freeze was
+        measurable."""
+        c_n = len(self.attach_classes)
+        cached = getattr(self, "_attach_cache", None)
+        if cached is not None and cached.shape[1] == c_n:
+            return cached
+        start = 0 if cached is None else cached.shape[1]
+        out = np.zeros((len(self.nodes), c_n), np.float32)
+        if cached is not None:
+            out[:, :start] = cached
+        for c in range(start, c_n):
+            res, default = self.attach_classes[c]
+            col = out[:, c]
+            for i, am in enumerate(self._alloc_maps):
+                col[i] = am.get(res, default)
+        self._attach_cache = out
         return out
 
     def _refresh_s_match(self) -> None:
         """(Re)evaluate group-labels × term-selector incidence.
 
-        Cheap (G×T host-side selector matches) and done once per batch build so
-        terms interned by later apps see earlier groups too.
+        Done once per batch build so terms interned by later apps see earlier
+        groups too. Each group carries a watermark (terms already evaluated —
+        the interners only append), only True entries are stored (readers
+        .get() with a falsy default, and freeze()'s dense pass walks stored
+        items), and candidates come from the inverted selector index rather
+        than a full G×T scan (each candidate still gets its own
+        match_label_selector call; only the selector parse is shared).
         """
+        t_n = len(self.terms)
+        while len(self._smatch_done) < len(self.groups):
+            self._smatch_done.append(0)
+        general = self._term_general
+        idx = self._term_sel_index
         for gid, g in enumerate(self.groups):
+            start = self._smatch_done[gid]
+            if start >= t_n:
+                continue
+            labels, ns = g.labels, g.namespace
+            # candidate terms: the general pool plus every indexed term
+            # reachable through one of the group's own label pairs (the
+            # index key is a necessary condition for a matchLabels match)
+            cands = [t for t in general if t >= start]
+            for k, v in labels.items():
+                lst = idx.get((k, str(v)))
+                if lst:
+                    cands.extend(t for t in lst if t >= start)
+            if not cands:
+                self._smatch_done[gid] = t_n
+                continue
             row = self._s_match[gid]
-            for t, term in enumerate(self.terms):
-                if t in row:
-                    continue
-                ns_ok = g.namespace in term.namespaces
+            for t in set(cands):
+                term = self.terms[t]
                 sel = term.selector
-                row[t] = bool(ns_ok and sel is not None and match_label_selector(sel, g.labels))
+                if (
+                    ns in term.namespaces
+                    and sel is not None
+                    and match_label_selector(sel, labels)
+                ):
+                    row[t] = True
+            self._smatch_done[gid] = t_n
 
     # -- batches -----------------------------------------------------------
 
@@ -1046,57 +1189,146 @@ class Tensorizer:
         )
 
     def add_pods(self, pods: Sequence[dict]) -> PodBatch:
-        """Intern a batch of pods, growing group/term vocabularies."""
+        """Intern a batch of pods, growing group/term vocabularies.
+
+        Replica runs collapse: workload expansion clones replicas from one
+        normalized prototype (`workloads/expand.py` _clone_pod), so
+        consecutive replicas share their nested spec objects. Pass 1 detects
+        run boundaries with identity/equality compares only; everything
+        per-spec (grouping, requests, extended demand) then runs once per RUN
+        and broadcasts over the run's slice — at million-pod batches the old
+        per-pod path was the single largest host cost.
+        """
         p = len(pods)
         group = np.zeros(p, np.int32)
         pin = np.full(p, -1, np.int32)
         forced = np.zeros(p, bool)
-        reqs: List[Dict[str, float]] = []
-        demands = []
-        cache = {}
+
+        # -- pass 1: adjacent-run detection (no hashing of value fields) ----
+        starts: List[int] = []
+        prev_key: object = None
+        prev_labels = prev_annos = None
         for i, pod in enumerate(pods):
+            spec = pod.get("spec") or {}
+            meta = pod.get("metadata") or {}
+            key = (
+                id(spec.get("containers")),
+                id(spec.get("initContainers")),
+                id(spec.get("affinity")),
+                id(spec.get("tolerations")),
+                id(spec.get("nodeSelector")),
+                id(spec.get("topologySpreadConstraints")),
+                id(spec.get("volumes")),
+                id(spec.get("overhead")),
+                id(meta.get("ownerReferences")),
+                meta.get("namespace") or "",
+                spec.get("nodeName") or "",
+            )
+            labels = meta.get("labels") or {}
+            annos = meta.get("annotations") or {}
+            if (
+                not starts
+                or key != prev_key
+                or labels != prev_labels
+                or annos != prev_annos
+            ):
+                starts.append(i)
+                prev_key, prev_labels, prev_annos = key, labels, annos
+        stops = starts[1:] + [p]
+
+        # -- pass 2: one grouping/request/demand evaluation per run ---------
+        # (the fingerprint cache still dedupes non-adjacent repeats)
+        run_info: List[tuple] = []  # (start, stop, req_dict, demand)
+        cache = {}
+        for start, stop in zip(starts, stops):
+            pod = pods[start]
             fp = self._pod_fingerprint(pod)
             hit = cache.get(fp)
-            if hit is not None:
-                group[i], pin[i], forced[i], r, demand = hit
-                reqs.append(r)
-                demands.append(demand)
-                continue
-            g, pin_name = _group_of_pod(pod)
-            group[i] = self._intern_group(g)
-            node_name = pod_node_name(pod)
-            if node_name:
-                pin[i] = self.node_idx.get(node_name, -1)
-                forced[i] = True
-            elif pin_name is not None:
-                # -2 = pinned to a node that does not exist → unschedulable
-                # everywhere (the NodeAffinity filter would match no node)
-                pin[i] = self.node_idx.get(pin_name, -2)
-            reqs.append(pod_requests(pod))
-            demands.append(pod_extended_demand(pod, self.catalog, self.vg_names))
-            cache[fp] = (group[i], pin[i], forced[i], reqs[-1], demands[-1])
+            if hit is None:
+                g, pin_name = _group_of_pod(pod)
+                gid = self._intern_group(g)
+                pin_v, forced_v = -1, False
+                node_name = pod_node_name(pod)
+                if node_name:
+                    pin_v = self.node_idx.get(node_name, -1)
+                    forced_v = True
+                elif pin_name is not None:
+                    # -2 = pinned to a node that does not exist →
+                    # unschedulable everywhere (the NodeAffinity filter
+                    # would match no node)
+                    pin_v = self.node_idx.get(pin_name, -2)
+                hit = (
+                    gid,
+                    pin_v,
+                    forced_v,
+                    pod_requests(pod),
+                    pod_extended_demand(pod, self.catalog, self.vg_names),
+                )
+                cache[fp] = hit
+            gid, pin_v, forced_v, r, demand = hit
+            group[start:stop] = gid
+            pin[start:stop] = pin_v
+            forced[start:stop] = forced_v
+            run_info.append((start, stop, r, demand))
         self._refresh_s_match()
-        req = np.zeros((p, len(self.resources)), np.float32)
-        for i, r in enumerate(reqs):
-            req[i, RES_PODS] = 1.0
-            for rname, val in r.items():
-                ridx = self.resources.get(rname)
-                if ridx >= 0:
-                    req[i, ridx] = val
-                # a resource no node allocates can never fit; map it to the
-                # `pods` column? no — grow the vocabulary so fit fails cleanly
-                else:
-                    ridx = self.resources.intern(rname)
+
+        # -- request matrix: grow the vocabulary first, then one row per run
+        for _, _, r, _ in run_info:
+            for rname in r:
+                if self.resources.get(rname) < 0:
+                    # a resource no node allocates can never fit; grow the
+                    # vocabulary so fit fails cleanly
+                    self.resources.intern(rname)
                     self.alloc = np.pad(self.alloc, ((0, 0), (0, 1)))
-                    req = np.pad(req, ((0, 0), (0, 1)))
-                    req[i, ridx] = val
+        n_res = len(self.resources)
+        req = np.zeros((p, n_res), np.float32)
+        if p:
+            req[:, RES_PODS] = 1.0
+        row = np.zeros(n_res, np.float32)
+        for start, stop, r, _ in run_info:
+            row[:] = 0.0
+            row[RES_PODS] = 1.0
+            for rname, val in r.items():
+                row[self.resources.get(rname)] = val
+            req[start:stop] = row
+
+        # -- extended demand arrays, filled per run ------------------------
+        l_max = max([len(d.lvm_sizes) for _, _, _, d in run_info] + [1])
+        k_max = max([len(d.dev_sizes) for _, _, _, d in run_info] + [1])
+        gd = max(self.ext.gpu_dev_total.shape[1], 1)
+        ext = {
+            "lvm_size": np.zeros((p, l_max), np.float32),
+            "lvm_vg": np.full((p, l_max), -1, np.int32),
+            "dev_size": np.zeros((p, k_max), np.float32),
+            "dev_media": np.zeros((p, k_max), np.int32),
+            "gpu_mem": np.zeros(p, np.float32),
+            "gpu_count": np.zeros(p, np.int32),
+            "gpu_preset": np.zeros((p, gd), np.float32),
+        }
+        for start, stop, _, d in run_info:
+            if d.lvm_sizes:
+                ext["lvm_size"][start:stop, : len(d.lvm_sizes)] = d.lvm_sizes
+                ext["lvm_vg"][start:stop, : len(d.lvm_vg_ids)] = d.lvm_vg_ids
+            if d.dev_sizes:
+                ext["dev_size"][start:stop, : len(d.dev_sizes)] = d.dev_sizes
+                ext["dev_media"][start:stop, : len(d.dev_medias)] = d.dev_medias
+            if d.gpu_mem:
+                ext["gpu_mem"][start:stop] = d.gpu_mem
+            if d.gpu_count:
+                ext["gpu_count"][start:stop] = d.gpu_count
+            for dev_id in d.gpu_preset:
+                # device ids beyond the cluster's device table are silently
+                # ignored, like the reference's guarded map lookup
+                # (`gpunodeinfo.go:108-110`)
+                if 0 <= dev_id < gd:
+                    ext["gpu_preset"][start:stop, dev_id] += 1.0
         return PodBatch(
             pods=list(pods),
             group=group,
             req=req,
             pin=pin,
             forced=forced,
-            ext=stack_demands(demands, self.ext.gpu_dev_total.shape[1]),
+            ext=ext,
         )
 
     def freeze(self) -> ClusterTensors:
@@ -1164,21 +1396,11 @@ class Tensorizer:
             n_domains=max(len(self.domains), 1),
             topo_keys=[str(k) for k in self.topo_keys.items()],
             groups=list(self.groups),
-            static_mask=(
-                np.stack(self._static_mask) if g_n else np.zeros((0, n), bool)
-            ),
-            node_pref_score=(
-                np.stack(self._node_pref) if g_n else np.zeros((0, n), np.float32)
-            ),
-            taint_intolerable=(
-                np.stack(self._taint_intol) if g_n else np.zeros((0, n), np.float32)
-            ),
-            static_score=(
-                np.stack(self._static_score) if g_n else np.zeros((0, n), np.float32)
-            ),
-            avoid_pen=(
-                np.stack(self._avoid_pen) if g_n else np.zeros((0, n), np.float32)
-            ),
+            static_mask=self._static_mask.view(),
+            node_pref_score=self._node_pref.view(),
+            taint_intolerable=self._taint_intol.view(),
+            static_score=self._static_score.view(),
+            avoid_pen=self._avoid_pen.view(),
             terms=list(self.terms),
             term_topo_key=np.asarray(self._term_topo, np.int32),
             s_match=dense(self._s_match, bool),
@@ -1192,9 +1414,7 @@ class Tensorizer:
             ss_zone=dense(self._ss_zone, bool),
             ports=ports,
             n_ports=p_n,
-            vol_mask=(
-                np.stack(self._vol_mask) if g_n else np.zeros((0, n), bool)
-            ),
+            vol_mask=self._vol_mask.view(),
             vol_rw=vol_rw,
             vol_ro=vol_ro,
             vol_att=vol_att,
