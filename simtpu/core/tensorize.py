@@ -1160,15 +1160,18 @@ class Tensorizer:
     # -- batches -----------------------------------------------------------
 
     @staticmethod
-    def _pod_fingerprint(pod: dict):
-        """Identity-based fingerprint of everything `_group_of_pod`,
-        `pod_requests` and `pod_extended_demand` read.
+    def _pod_identity_key(pod: dict):
+        """Identity-based key over every nested structure `_group_of_pod`,
+        `pod_requests` and `pod_extended_demand` read, plus the scalar value
+        fields. Shared by run detection (adjacent compare, together with
+        labels/annotations dict equality) and `_pod_fingerprint` — a field
+        added to one but not the other would silently mis-collapse runs.
 
         Workload expansion clones replicas from one normalized prototype
         (`workloads/expand.py` _clone_pod), so replicas *share* their nested
-        spec objects — id() equality over those plus the per-pod value fields
-        lets a batch of identical pods tensorize once. ids are stable for the
-        duration of the call (the pods list keeps everything alive).
+        spec objects — id() equality over those lets a batch of identical
+        pods tensorize once. ids are stable for the duration of the call
+        (the pods list keeps everything alive).
         """
         spec = pod.get("spec") or {}
         meta = pod.get("metadata") or {}
@@ -1184,6 +1187,14 @@ class Tensorizer:
             id(meta.get("ownerReferences")),
             meta.get("namespace") or "",
             spec.get("nodeName") or "",
+        )
+
+    @classmethod
+    def _pod_fingerprint(cls, pod: dict):
+        """The identity key plus order-insensitive label/annotation values —
+        the cache key deduping non-adjacent identical pods."""
+        meta = pod.get("metadata") or {}
+        return cls._pod_identity_key(pod) + (
             tuple(sorted((meta.get("labels") or {}).items())),
             tuple(sorted((meta.get("annotations") or {}).items())),
         )
@@ -1208,22 +1219,10 @@ class Tensorizer:
         starts: List[int] = []
         prev_key: object = None
         prev_labels = prev_annos = None
+        identity_key = self._pod_identity_key
         for i, pod in enumerate(pods):
-            spec = pod.get("spec") or {}
             meta = pod.get("metadata") or {}
-            key = (
-                id(spec.get("containers")),
-                id(spec.get("initContainers")),
-                id(spec.get("affinity")),
-                id(spec.get("tolerations")),
-                id(spec.get("nodeSelector")),
-                id(spec.get("topologySpreadConstraints")),
-                id(spec.get("volumes")),
-                id(spec.get("overhead")),
-                id(meta.get("ownerReferences")),
-                meta.get("namespace") or "",
-                spec.get("nodeName") or "",
-            )
+            key = identity_key(pod)
             labels = meta.get("labels") or {}
             annos = meta.get("annotations") or {}
             if (

@@ -33,12 +33,16 @@ pod consumes one slot of one container: a single LVM claim (named or
 binpack), a single exclusive-device claim, or gpu_count == 1 without a
 preset gpu-index — per-node intake caps are then sums of per-container slot
 counts, and the greedy fill visits containers tightest-first like the serial
-kernels. Runs whose pods interact through hard constraints (their labels
-match their own required (anti-)affinity or DoNotSchedule spread
-constraints), carry multi-claim / multi-GPU / preset-index demands, or are
-forced/pinned fall back to the serial scan pod-by-pod, so correctness never
-rests on the bulk path. Pods a round cannot place are retried through the
-serial step, which also produces their exact failure reason.
+kernels. Runs whose pods interact with each other through exactly one
+self-matching hard constraint term (DoNotSchedule topology spread and/or
+required anti-affinity selecting the run's own labels) ride a DOMAIN-QUOTA
+round variant: a per-domain water-fill reproduces the serial maxSkew /
+one-per-domain semantics (`_quota_fill`). Runs with self-matching required
+AFFINITY (colocate-with-self), multiple self-matching hard terms,
+multi-claim / multi-GPU / preset-index demands, or forced/pinned pods fall
+back to the serial scan pod-by-pod, so correctness never rests on the bulk
+path. Pods a round cannot place are retried through the serial step, which
+also produces their exact failure reason.
 
 The reference has no analog — it schedules strictly pod-at-a-time
 (`pkg/simulator/simulator.go:219-244`); this is the TPU-shaped replacement
@@ -53,7 +57,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..kernels.filters import _RES_EPS
+from ..kernels.filters import _RES_EPS, interpod_filter, topology_spread_filter
 from .scan import (
     Engine,
     SchedState,
@@ -106,6 +110,145 @@ def _unsort_take(m_n, order, c_sorted, cum_sorted):
     return jnp.zeros_like(c_sorted).at[jnp.arange(n)[:, None], order].set(take_sorted)
 
 
+def _quota_fill(
+    statics: StaticArrays,
+    state: SchedState,
+    ev,
+    g,
+    cap: jnp.ndarray,  # [N] per-node resource/exclusive/extended intake caps
+    k,  # i32 run length
+    tsafe,
+    tvalid,
+    dom_sub,  # [Tc, N] node domain per relevant term
+    valid_sub,  # [Tc, N]
+    n_domains: int,
+    flags: StepFlags,
+) -> jnp.ndarray:
+    """Per-node intake m_n for a run with ONE self-matching hard term t*.
+
+    Serial semantics being reproduced (`kernels/filters.py`):
+    - DoNotSchedule spread: each placement needs count(dom)+1-min_elig ≤
+      maxSkew, with the eligible-domain minimum RISING as the run fills —
+      a level ladder pours every domain up to (current min + maxSkew) per
+      iteration, which is always legal (the min never decreases), and stops
+      exactly where the serial filter would strand the remainder.
+    - Required self-anti-affinity: at most one pod per domain, none where a
+      matching pod or an anti-owner already sits; nodes missing the topology
+      key are unconstrained (the serial filter treats them as conflict-free).
+    The run's OTHER constraint terms are round-constant (no self-match) and
+    stay enforced through the start-of-round masks; t*'s own filter is
+    lifted and owned by the quota. Total intake is provably order-invariant
+    (each placement consumes exactly one unit of its domain's capacity), so
+    placed counts track the serial engine; node choice within a level is
+    index-ordered, not score-ordered (documented divergence).
+    """
+    t_cap = statics.g_terms.shape[1]
+    f = flags
+    # locate the single self-matching hard term on the compacted axis
+    self_hard = statics.s_match[g] & (
+        statics.a_anti_req[g] | (statics.spread_hard[g] > 0)
+    ) & tvalid
+    t_star = jnp.argmax(self_hard).astype(jnp.int32)
+    onehot = jnp.arange(t_cap) == t_star
+    skew = statics.spread_hard[g][t_star]
+    use_skew = skew > 0
+    anti = statics.a_anti_req[g][t_star]
+    dom_t = dom_sub[t_star]  # [N] global domain id for t*'s key (-1 absent)
+    valid_t = valid_sub[t_star]
+    cnt_sub = jnp.where(tvalid[:, None], state.cnt_match[tsafe], 0.0)
+    cnt_t = cnt_sub[t_star]
+    ip_g = statics.ip_of[tsafe]
+    ip_star = ip_g[t_star]
+    own_t = jnp.where(
+        ip_star >= 0,
+        state.cnt_own_anti[jnp.clip(ip_star, 0)],
+        jnp.zeros_like(cnt_t),
+    )
+
+    # -- base feasibility: every constraint EXCEPT t*'s own filter --------
+    base = ev.m_gpu
+    if f.spread_hard:
+        sh_excl = statics.spread_hard[g] * (~onehot)
+        base = base & topology_spread_filter(
+            cnt_sub, valid_sub, sh_excl, ev.m_static
+        )
+        # t*'s missing-key infeasibility survives the lift for spread terms
+        base = base & (valid_t | ~use_skew)
+    if f.interpod_req:
+        ip_ok = (tvalid & (ip_g >= 0))[:, None]
+        base = base & interpod_filter(
+            cnt_sub,
+            jnp.where(ip_ok, state.cnt_own_anti[jnp.clip(ip_g, 0)], 0.0),
+            valid_sub,
+            jnp.where(tvalid, state.cnt_total[tsafe], 0.0),
+            statics.s_match[g] & ~onehot,  # t*'s symmetry moves to the quota
+            statics.a_aff_req[g],
+            statics.a_anti_req[g] & ~onehot,
+        )
+    cap = jnp.where(base, cap, 0.0)
+
+    # -- domain aggregates over t*'s key ----------------------------------
+    d_n = n_domains
+    safe_dom = jnp.where(valid_t, dom_t, 0)
+    on_key = jnp.where(valid_t, 1.0, 0.0)
+    k_dom = jnp.zeros(d_n, jnp.float32).at[safe_dom].add(cap * on_key)
+    c_dom = jnp.zeros(d_n, jnp.float32).at[safe_dom].max(cnt_t * on_key)
+    own_dom = jnp.zeros(d_n, jnp.float32).at[safe_dom].max(own_t * on_key)
+    elig_dom = jnp.zeros(d_n, bool).at[safe_dom].max(valid_t & ev.m_static)
+    # required anti: one pod per open domain (no matching pod, no anti-owner)
+    open_dom = (c_dom <= 0) & (own_dom <= 0)
+    k_dom = jnp.where(anti, jnp.minimum(k_dom, jnp.where(open_dom, 1.0, 0.0)), k_dom)
+
+    # -- level ladder: pour to (min + skew) until stuck or k exhausted ----
+    def cond(carry):
+        _, rem, go = carry
+        return go & (rem > 0)
+
+    def body(carry):
+        x, rem, _ = carry
+        cc = c_dom + x
+        level = jnp.min(jnp.where(elig_dom, cc, _BIG))
+        level = jnp.where(level >= _BIG, 0.0, level)
+        room = jnp.where(use_skew, jnp.clip(level + skew - cc, 0.0, _BIG), _BIG)
+        pour = jnp.minimum(room, k_dom - x)
+        # partial pour by ascending domain id when the run length limits
+        cum = jnp.cumsum(pour)
+        pour = jnp.clip(rem - (cum - pour), 0.0, pour)
+        tot = jnp.sum(pour)
+        return x + pour, rem - tot, tot > 0
+
+    x_dom, _, _ = jax.lax.while_loop(
+        cond, body, (jnp.zeros(d_n, jnp.float32), jnp.float32(k), jnp.bool_(True))
+    )
+
+    # -- split each domain's intake across its nodes in index order -------
+    n = cap.shape[0]
+    key_d = jnp.where(valid_t & (cap > 0), dom_t, d_n)  # keyless/capless last
+    order = jnp.argsort(key_d)  # stable: index order within a domain
+    key_o = key_d[order]
+    cap_o = jnp.where(key_o < d_n, cap[order], 0.0)
+    cum_o = jnp.cumsum(cap_o)
+    excl_o = cum_o - cap_o  # global exclusive prefix
+    is_start = jnp.concatenate(
+        [jnp.ones(1, bool), key_o[1:] != key_o[:-1]]
+    )
+    # per-segment base = the exclusive prefix at the segment's first node,
+    # propagated forward (prefixes are nondecreasing, so cummax carries the
+    # most recent segment start)
+    seg_base = jax.lax.cummax(jnp.where(is_start, excl_o, 0.0))
+    before_o = excl_o - seg_base
+    x_o = jnp.where(key_o < d_n, x_dom[jnp.clip(key_o, 0, d_n - 1)], 0.0)
+    allow_o = jnp.clip(x_o - before_o, 0.0, cap_o)
+    allow = jnp.zeros(n, jnp.float32).at[order].set(allow_o)
+    # nodes missing t*'s key: unconstrained by the quota (anti semantics);
+    # for spread terms `base` already zeroed their caps
+    m_n = jnp.where(valid_t, allow, cap)
+    # run-length clamp by ascending node index (keyless-node intake and the
+    # quota allowance may jointly exceed k)
+    cum_m = jnp.cumsum(m_n)
+    return jnp.clip(jnp.float32(k) - (cum_m - m_n), 0.0, m_n)
+
+
 def _round_core(
     statics: StaticArrays,
     state: SchedState,
@@ -114,6 +257,7 @@ def _round_core(
     slots,  # [k_cap] f32 iota — virtual slot ids for the assignment expansion
     n_domains: int,
     flags: StepFlags = StepFlags(),
+    quota: bool = False,
 ):
     """Place up to k identical pods in one round.
 
@@ -122,6 +266,21 @@ def _round_core(
     pod (-1 past the placed count) and, for runs with extended-resource
     demands, the VG / storage-device / GPU-device index the pod's single
     claim landed on (-1 when the pod has no such demand).
+
+    `quota=True` compiles the DOMAIN-QUOTA variant for runs whose pods
+    interact with each other through exactly one self-matching hard
+    constraint term (DoNotSchedule topology spread and/or required
+    anti-affinity whose selector matches the run's own labels — the host
+    classifier `_group_bulk_kind` guarantees exactly one such term). The
+    per-node score-threshold intake is replaced by a per-domain water-fill:
+    a level ladder pours pods domain by domain exactly as far as the serial
+    maxSkew / ≤1-per-domain semantics allow (the constraint's own start-of-
+    round filter is lifted — the ladder supersedes it, re-raising the
+    eligible-domain minimum as it fills the way the serial filter would),
+    then each domain's intake is split across its nodes in index order.
+    Feasibility-exact like the plain round; within-run node choice is
+    level/index-ordered rather than score-ordered (documented divergence,
+    same class as the plain round's round-start normalizers).
     """
     (
         g,
@@ -215,80 +374,90 @@ def _round_core(
         )
         cap = jnp.where(is_gpu, jnp.minimum(cap, jnp.sum(c_gpu, axis=1)), cap)
         ord_gpu, cs_gpu, cum_gpu = _fill_order(c_gpu, free_g)
-    cap = jnp.where(ev.m_all, cap, 0.0)
 
-    # -- score slope: re-score after one hypothetical pod per node --------
-    # score-only: the filter cascade need not rerun — the round keeps its
-    # start-of-round masks (m_all) and the caps carry the hard constraints.
-    # The hypothetical state is expressed as score_pod overrides (free and
-    # the group's [Tc, N] cnt_match rows) — bumping a copy of the full
-    # [T, N] count plane would copy T/Tc times the touched data every round
-    cnt_sub1 = None
-    if t_cap:
-        bump1 = jnp.where(valid_sub, statics.s_match[g][:, None], 0.0)
-        cnt_sub1 = (
-            jnp.where(tvalid[:, None], state.cnt_match[tsafe], 0.0) + bump1
+    if quota and t_cap:
+        m_n = _quota_fill(
+            statics, state, ev, g, cap, k,
+            tsafe, tvalid, dom_sub, valid_sub, n_domains, flags,
         )
-    score1 = score_pod(
-        statics,
-        state,
-        g,
-        req,
-        ev.m_all,
-        flags,
-        free=state.free - req[None, :],
-        cnt_sub=cnt_sub1,
-    )
-    # slope clamped >= 0: the threshold search needs non-increasing
-    # sequences; a genuinely increasing score (rare: balanced_allocation
-    # improving) fills one node until capacity under serial semantics, which
-    # slope 0 reproduces up to ties. The 1e6 ceiling keeps pathological
-    # per-pod drops (free crossing zero) on a finite search range.
-    # the slope is taken storage-free (ev.score carries the per-node
-    # Open-Local binpack term that score1 lacks) so the within-round sequence
-    # stays arithmetic; the binpack term still ranks nodes through s0
-    slope = jnp.clip(jnp.where(ev.m_all, ev.score_nostorage - score1, 0.0), 0.0, 1e6)
-    s0 = jnp.where(ev.m_all, ev.score, _NEG)
+    else:
+        cap = jnp.where(ev.m_all, cap, 0.0)
 
-    # -- threshold search: pick the kf best virtual placements ------------
-    def counts(tau):
-        c = jnp.where(
-            s0 >= tau,
-            jnp.where(
-                slope > 0,
-                jnp.floor((s0 - tau) / jnp.maximum(slope, 1e-30)) + 1.0,
-                cap,  # flat sequence: every slot ties at s0
-            ),
-            0.0,
+        # -- score slope: re-score after one hypothetical pod per node ----
+        # score-only: the filter cascade need not rerun — the round keeps
+        # its start-of-round masks (m_all) and the caps carry the hard
+        # constraints. The hypothetical state is expressed as score_pod
+        # overrides (free and the group's [Tc, N] cnt_match rows) — bumping
+        # a copy of the full [T, N] count plane would copy T/Tc times the
+        # touched data every round
+        cnt_sub1 = None
+        if t_cap:
+            bump1 = jnp.where(valid_sub, statics.s_match[g][:, None], 0.0)
+            cnt_sub1 = (
+                jnp.where(tvalid[:, None], state.cnt_match[tsafe], 0.0) + bump1
+            )
+        score1 = score_pod(
+            statics,
+            state,
+            g,
+            req,
+            ev.m_all,
+            flags,
+            free=state.free - req[None, :],
+            cnt_sub=cnt_sub1,
         )
-        return jnp.minimum(c, cap)
+        # slope clamped >= 0: the threshold search needs non-increasing
+        # sequences; a genuinely increasing score (rare: balanced_allocation
+        # improving) fills one node until capacity under serial semantics,
+        # which slope 0 reproduces up to ties. The 1e6 ceiling keeps
+        # pathological per-pod drops (free crossing zero) on a finite range.
+        # the slope is taken storage-free (ev.score carries the per-node
+        # Open-Local binpack term that score1 lacks) so the within-round
+        # sequence stays arithmetic; the binpack term still ranks through s0
+        slope = jnp.clip(
+            jnp.where(ev.m_all, ev.score_nostorage - score1, 0.0), 0.0, 1e6
+        )
+        s0 = jnp.where(ev.m_all, ev.score, _NEG)
 
-    kf = jnp.minimum(jnp.float32(k), jnp.sum(cap))
-    hi = jnp.max(s0)
-    # every node's lowest usable virtual slot bounds the k-th best from
-    # below: count(lo) = sum(cap) >= kf holds by construction, and the range
-    # stays tight (score-scale, not worst-case slope x k), so 40 bisection
-    # steps resolve far below any real score delta
-    low_slot = s0 - slope * jnp.clip(cap - 1.0, 0.0, jnp.float32(k))
-    lo = jnp.min(jnp.where(ev.m_all, low_slot, _BIG)) - 1.0
+        # -- threshold search: pick the kf best virtual placements --------
+        def counts(tau):
+            c = jnp.where(
+                s0 >= tau,
+                jnp.where(
+                    slope > 0,
+                    jnp.floor((s0 - tau) / jnp.maximum(slope, 1e-30)) + 1.0,
+                    cap,  # flat sequence: every slot ties at s0
+                ),
+                0.0,
+            )
+            return jnp.minimum(c, cap)
 
-    def body(_, bounds):
-        lo, hi = bounds
-        mid = 0.5 * (lo + hi)
-        over = jnp.sum(counts(mid)) > kf
-        return jnp.where(over, mid, lo), jnp.where(over, hi, mid)
+        kf = jnp.minimum(jnp.float32(k), jnp.sum(cap))
+        hi = jnp.max(s0)
+        # every node's lowest usable virtual slot bounds the k-th best from
+        # below: count(lo) = sum(cap) >= kf holds by construction, and the
+        # range stays tight (score-scale, not worst-case slope x k), so 40
+        # bisection steps resolve far below any real score delta
+        low_slot = s0 - slope * jnp.clip(cap - 1.0, 0.0, jnp.float32(k))
+        lo = jnp.min(jnp.where(ev.m_all, low_slot, _BIG)) - 1.0
 
-    lo, hi = jax.lax.fori_loop(0, 40, body, (lo, hi))
-    m_n = counts(hi)  # ~kf placements, every slot scoring above hi
-    # clamp any overshoot (tie plateaus, k=0 padding) by ascending node index
-    cum_m = jnp.cumsum(m_n)
-    m_n = jnp.clip(kf - (cum_m - m_n), 0.0, m_n)
-    # distribute the remaining tied slots by ascending node index (the serial
-    # scan's lowest-index tie-break)
-    extra_room = jnp.clip(counts(lo) - m_n, 0.0, None)
-    cum = jnp.cumsum(extra_room)
-    extra = jnp.clip(kf - jnp.sum(m_n) - (cum - extra_room), 0.0, extra_room)
-    m_n = m_n + extra
+        def body(_, bounds):
+            lo, hi = bounds
+            mid = 0.5 * (lo + hi)
+            over = jnp.sum(counts(mid)) > kf
+            return jnp.where(over, mid, lo), jnp.where(over, hi, mid)
+
+        lo, hi = jax.lax.fori_loop(0, 40, body, (lo, hi))
+        m_n = counts(hi)  # ~kf placements, every slot scoring above hi
+        # clamp overshoot (tie plateaus, k=0 padding) by ascending node index
+        cum_m = jnp.cumsum(m_n)
+        m_n = jnp.clip(kf - (cum_m - m_n), 0.0, m_n)
+        # distribute the remaining tied slots by ascending node index (the
+        # serial scan's lowest-index tie-break)
+        extra_room = jnp.clip(counts(lo) - m_n, 0.0, None)
+        cum = jnp.cumsum(extra_room)
+        extra = jnp.clip(kf - jnp.sum(m_n) - (cum - extra_room), 0.0, extra_room)
+        m_n = m_n + extra
 
     # -- batched state update --------------------------------------------
     updates = {"free": state.free - m_n[:, None] * req[None, :]}
@@ -393,6 +562,7 @@ def rounds_scan(
     n_domains: int,
     k_cap: int,  # static max run length: bounds the per-segment output
     flags: StepFlags = StepFlags(),
+    quota: bool = False,
 ):
     """All consecutive bulk rounds as one lax.scan over the segment axis, so
     a batch of hundreds of deployment runs costs one dispatch and one
@@ -410,12 +580,12 @@ def rounds_scan(
 
     def body(state, xs):
         pod, k = xs
-        return _round_core(statics, state, pod, k, slots, n_domains, flags)
+        return _round_core(statics, state, pod, k, slots, n_domains, flags, quota)
 
     return jax.lax.scan(body, state, (seg_pods, ks))
 
 
-@partial(jax.jit, static_argnums=(4, 5, 6), donate_argnums=(1,))
+@partial(jax.jit, static_argnums=(4, 5, 6, 7), donate_argnums=(1,))
 def _round_place_many(
     statics: StaticArrays,
     state: SchedState,
@@ -424,8 +594,9 @@ def _round_place_many(
     n_domains: int,
     k_cap: int,
     flags: StepFlags = StepFlags(),
+    quota: bool = False,
 ):
-    return rounds_scan(statics, state, seg_pods, ks, n_domains, k_cap, flags)
+    return rounds_scan(statics, state, seg_pods, ks, n_domains, k_cap, flags, quota)
 
 
 class RoundsEngine(Engine):
@@ -442,17 +613,41 @@ class RoundsEngine(Engine):
     #: rounds (bounds the [S, k_cap] output and keeps score slopes fresh)
     MAX_RUN = 4096
 
-    def _group_bulk_eligible(self, tensors, gid: int) -> bool:
-        """A group's pods may interact with each other only through
-        resources/ports/volumes for the bulk model to hold: its own labels
-        must not match its required (anti-)affinity or hard-spread terms."""
+    # group bulk-path classification codes (`_group_bulk_kind`)
+    KIND_SERIAL = 0  # pod-by-pod serial scan only
+    KIND_PLAIN = 1  # plain bulk round (threshold search)
+    KIND_QUOTA = 2  # domain-quota bulk round (one self-matching hard term)
+
+    def _group_bulk_kind(self, tensors, gid: int) -> int:
+        """How a group's runs may be placed in bulk.
+
+        PLAIN requires that the run's pods interact with each other only
+        through resources/ports/volumes: no hard constraint term whose
+        selector matches the run's own labels. Non-self-matching required
+        (anti-)affinity and spread terms are round-CONSTANT — the run's own
+        placements never change those terms' counts — so they stay on the
+        bulk path, enforced by the start-of-round masks
+        (`interpodaffinity/filtering.go`, `podtopologyspread/filtering.go`
+        semantics; r2 conservatively serialized every required-affinity
+        group).
+
+        QUOTA handles exactly ONE self-matching hard term (DoNotSchedule
+        spread and/or required anti-affinity on the same interned term) via
+        the per-domain water-fill in `_quota_fill`. Self-matching required
+        AFFINITY (colocate-with-self) and multiple self-matching hard terms
+        over different domain partitions remain serial — a joint quota over
+        two partitions is a flow problem, not a fill.
+        """
         s = tensors.s_match[gid]
-        hard = (
-            tensors.a_anti_req[gid]
-            | tensors.a_aff_req[gid]
-            | (tensors.spread_hard[gid] > 0)
-        )
-        return not bool(np.any(s & hard)) and not bool(np.any(tensors.a_aff_req[gid]))
+        if np.any(s & tensors.a_aff_req[gid]):
+            return self.KIND_SERIAL
+        self_hard = s & (tensors.a_anti_req[gid] | (tensors.spread_hard[gid] > 0))
+        n_hard = int(np.count_nonzero(self_hard))
+        if n_hard == 0:
+            return self.KIND_PLAIN
+        if n_hard == 1:
+            return self.KIND_QUOTA
+        return self.KIND_SERIAL
 
     def _segments(self, batch, tensors):
         """Split the batch index space into ('bulk'|'scan', start, stop).
@@ -483,18 +678,18 @@ class RoundsEngine(Engine):
         if ext["gpu_preset"].shape[1]:
             gpu_ok &= np.asarray(ext["gpu_preset"]).sum(axis=1) <= 0
         eligible &= (gpu_mem <= 0) | gpu_ok
-        group_ok = np.array(
-            [self._group_bulk_eligible(tensors, gid) for gid in range(len(tensors.groups))],
-            bool,
+        group_kind = np.array(
+            [self._group_bulk_kind(tensors, gid) for gid in range(len(tensors.groups))],
+            np.int32,
         )
-        eligible &= group_ok[group]
+        kind = np.where(eligible, group_kind[group], self.KIND_SERIAL)
 
         change = np.zeros(p, bool)
         change[0] = True
         change[1:] = (
             (group[1:] != group[:-1])
             | np.any(batch.req[1:] != batch.req[:-1], axis=1)
-            | (eligible[1:] != eligible[:-1])
+            | (kind[1:] != kind[:-1])
         )
         # a run must be spec-homogeneous in its extended demands too (the
         # segment's first pod stands in for every pod of the run)
@@ -508,10 +703,11 @@ class RoundsEngine(Engine):
         starts = np.flatnonzero(change)
         stops = np.append(starts[1:], p)
         segments = []
+        names = {self.KIND_PLAIN: "bulk", self.KIND_QUOTA: "bulkq"}
         for a, b in zip(starts.tolist(), stops.tolist()):
-            if eligible[a] and b - a >= self.MIN_RUN:
+            if kind[a] != self.KIND_SERIAL and b - a >= self.MIN_RUN:
                 for c in range(a, b, self.MAX_RUN):
-                    segments.append(("bulk", c, min(c + self.MAX_RUN, b)))
+                    segments.append((names[kind[a]], c, min(c + self.MAX_RUN, b)))
             elif segments and segments[-1][0] == "scan":
                 segments[-1] = ("scan", segments[-1][1], b)
             else:
@@ -550,10 +746,14 @@ class RoundsEngine(Engine):
 
         return _run_scan(statics, state, seg, flags)
 
-    def _bulk_call(self, statics, state, seg_pods, ks, n_domains, k_cap, flags):
+    def _bulk_call(
+        self, statics, state, seg_pods, ks, n_domains, k_cap, flags, quota=False
+    ):
         """Dispatch one multi-round bulk call (overridden by the sharded
         subclass to run on a mesh)."""
-        return _round_place_many(statics, state, seg_pods, ks, n_domains, k_cap, flags)
+        return _round_place_many(
+            statics, state, seg_pods, ks, n_domains, k_cap, flags, quota
+        )
 
     def _run_scan_segment(self, statics, state, pods, a, b, flags):
         seg = self._pad_pods(
@@ -630,7 +830,9 @@ class RoundsEngine(Engine):
             rows = np.concatenate([rows, unused])
         return rows
 
-    def _bulk_chunk(self, statics, state, chunk, rows_p, pods, tensors, flags):
+    def _bulk_chunk(
+        self, statics, state, chunk, rows_p, pods, tensors, flags, quota=False
+    ):
         """Run one chunk of bulk runs through _bulk_call, carrying only the
         chunk's cnt-plane rows when rows_p is given."""
         s_real = len(chunk)
@@ -648,7 +850,7 @@ class RoundsEngine(Engine):
         if rows_p is None:
             state, outs = self._bulk_call(
                 statics, state, seg_pods, jnp.asarray(ks),
-                tensors.n_domains, k_cap, flags,
+                tensors.n_domains, k_cap, flags, quota,
             )
         else:
             g_terms, term_topo, ip_of = self._host_term_maps(tensors)
@@ -670,7 +872,7 @@ class RoundsEngine(Engine):
             full_match, full_total = state.cnt_match, state.cnt_total
             state_chunk, outs = self._bulk_call(
                 st_chunk, state_chunk, seg_pods, jnp.asarray(ks),
-                tensors.n_domains, k_cap, flags,
+                tensors.n_domains, k_cap, flags, quota,
             )
             state = state_chunk._replace(
                 cnt_match=_scatter_rows(full_match, rows_dev, state_chunk.cnt_match),
@@ -731,16 +933,20 @@ class RoundsEngine(Engine):
                 lvm_alloc[a:b], dev_take[a:b], gpu_shares[a:b] = outs[2:5]
                 idx += 1
                 continue
-            # batch consecutive bulk runs into compiled multi-round calls,
-            # CHUNKED so each call's scan carries only the count-plane rows
-            # its runs reference: a round's state update scatters into the
-            # carried cnt planes, and carrying the full [T, N] plane makes
-            # every round pay traffic proportional to the number of
-            # workloads in the whole simulation — the dominant device cost
-            # at 100k nodes. Rows are gathered before and scattered back
-            # after each chunk (in place, donated).
+            # batch consecutive same-kind bulk runs into compiled multi-round
+            # calls ("bulk" = threshold rounds, "bulkq" = domain-quota
+            # rounds — distinct compiled bodies), CHUNKED so each call's
+            # scan carries only the count-plane rows its runs reference: a
+            # round's state update scatters into the carried cnt planes, and
+            # carrying the full [T, N] plane makes every round pay traffic
+            # proportional to the number of workloads in the whole
+            # simulation — the dominant device cost at 100k nodes. Rows are
+            # gathered before and scattered back after each chunk (in
+            # place, donated).
+            bkind = kind
+            quota = bkind == "bulkq"
             run = []
-            while idx < len(segments) and segments[idx][0] == "bulk":
+            while idx < len(segments) and segments[idx][0] == bkind:
                 run.append(segments[idx])
                 idx += 1
             leftovers = []
@@ -754,7 +960,7 @@ class RoundsEngine(Engine):
             pending = []
             for chunk, rows_p in self._chunk_runs(run, batch, tensors):
                 state, outs_dev = self._bulk_chunk(
-                    statics, state, chunk, rows_p, pods, tensors, flags
+                    statics, state, chunk, rows_p, pods, tensors, flags, quota
                 )
                 pending.append((chunk, outs_dev))
             for chunk, outs_dev in pending:

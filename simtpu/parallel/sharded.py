@@ -212,7 +212,9 @@ class ShardedEngine(_MeshMixin, Engine):
         return final_state, out
 
 
-def build_sharded_rounds(mesh: Mesh, n_domains: int, k_cap: int, flags: StepFlags):
+def build_sharded_rounds(
+    mesh: Mesh, n_domains: int, k_cap: int, flags: StepFlags, quota: bool = False
+):
     """Compile the bulk multi-round scan with the node axis over `mesh`."""
     from ..engine.rounds import rounds_scan
 
@@ -221,7 +223,9 @@ def build_sharded_rounds(mesh: Mesh, n_domains: int, k_cap: int, flags: StepFlag
     rep = NamedSharding(mesh, P())
 
     def fn(statics, state, seg_pods, ks):
-        return rounds_scan(statics, state, seg_pods, ks, n_domains, k_cap, flags)
+        return rounds_scan(
+            statics, state, seg_pods, ks, n_domains, k_cap, flags, quota
+        )
 
     return jax.jit(
         fn,
@@ -252,11 +256,13 @@ class ShardedRoundsEngine(_MeshMixin, RoundsEngine):
     def _scan_call(self, statics, state, seg, flags):
         return self._sharded_scan_for(flags)(statics, state, seg)
 
-    def _bulk_call(self, statics, state, seg_pods, ks, n_domains, k_cap, flags):
-        key = (n_domains, k_cap, flags)
+    def _bulk_call(
+        self, statics, state, seg_pods, ks, n_domains, k_cap, flags, quota=False
+    ):
+        key = (n_domains, k_cap, flags, quota)
         fn = self._bulk_jits.get(key)
         if fn is None:
             fn = self._bulk_jits[key] = build_sharded_rounds(
-                self.mesh, n_domains, k_cap, flags
+                self.mesh, n_domains, k_cap, flags, quota
             )
         return fn(statics, state, seg_pods, ks)

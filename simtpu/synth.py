@@ -77,6 +77,7 @@ def make_deployment(
     node_selector=None,
     tolerations=None,
     anti_affinity_topo: str = None,
+    anti_affinity_required: bool = False,  # required vs preferred anti-affinity
     spread_topo: str = None,  # topologySpreadConstraints topology key
     spread_hard: bool = False,  # DoNotSchedule vs ScheduleAnyway
     gpu_mem_mib: int = 0,
@@ -95,19 +96,21 @@ def make_deployment(
     if tolerations:
         spec["tolerations"] = list(tolerations)
     if anti_affinity_topo:
-        spec["affinity"] = {
-            "podAntiAffinity": {
+        term = {
+            "labelSelector": {"matchLabels": labels},
+            "topologyKey": anti_affinity_topo,
+        }
+        if anti_affinity_required:
+            # hard self-anti: at most one replica per topology domain — the
+            # "one per node/zone" pattern (requiredDuringScheduling)
+            anti = {"requiredDuringSchedulingIgnoredDuringExecution": [term]}
+        else:
+            anti = {
                 "preferredDuringSchedulingIgnoredDuringExecution": [
-                    {
-                        "weight": 100,
-                        "podAffinityTerm": {
-                            "labelSelector": {"matchLabels": labels},
-                            "topologyKey": anti_affinity_topo,
-                        },
-                    }
+                    {"weight": 100, "podAffinityTerm": term}
                 ]
             }
-        }
+        spec["affinity"] = {"podAntiAffinity": anti}
     if spread_topo:
         spec["topologySpreadConstraints"] = [
             {
@@ -228,6 +231,7 @@ def synth_apps(
     selector_frac: float = 0.2,
     toleration_frac: float = 0.1,
     anti_affinity_frac: float = 0.2,
+    anti_affinity_hard_frac: float = 0.0,  # fraction OF anti workloads required
     spread_frac: float = 0.0,
     spread_hard_frac: float = 0.0,  # fraction OF spread workloads DoNotSchedule
     gpu_frac: float = 0.0,
@@ -261,6 +265,10 @@ def synth_apps(
             ]
         if rng.random() < anti_affinity_frac:
             kw["anti_affinity_topo"] = "kubernetes.io/hostname"
+            # draw only when enabled so pre-existing seeds' streams (and the
+            # fuzz scenarios pinned to them) are unchanged
+            if anti_affinity_hard_frac and rng.random() < anti_affinity_hard_frac:
+                kw["anti_affinity_required"] = True
         # draw only when enabled so pre-existing seeds' random streams (and
         # the scenarios fuzz tests pinned to them) are unchanged
         if spread_frac and rng.random() < spread_frac:
