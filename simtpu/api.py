@@ -151,19 +151,11 @@ class Simulator:
     # -- internals ---------------------------------------------------------
 
     def _record_placed(self, pod: dict, node_idx: int, gpu_shares) -> None:
-        placed = shallow_pod_copy(pod)
-        placed["spec"]["nodeName"] = self._nodes[node_idx]["metadata"]["name"]
-        placed.setdefault("status", {})["phase"] = "Running"
-        # GPU device assignment annotation (GpuSharePlugin.Bind applies
-        # the pod copy with the gpu-index annotation,
-        # open-gpu-share.go:221-241 + utils/pod.go:117-127)
-        already = annotations_of(placed).get(C.ANNO_POD_GPU_INDEX)
-        if gpu_shares.sum() > 0 and not already:
-            ids = []
-            for dev_id, cnt in enumerate(gpu_shares):
-                ids.extend([str(dev_id)] * int(round(float(cnt))))
-            set_annotation(placed, C.ANNO_POD_GPU_INDEX, "-".join(ids))
-        self._scheduled.append(placed)
+        self._scheduled.append(
+            record_placed_pod(
+                pod, self._nodes[node_idx]["metadata"]["name"], gpu_shares
+            )
+        )
         self._placed_prio.append(pod_priority(pod))
 
     def _record_failed(self, pod: dict, reason: int) -> None:
@@ -332,111 +324,225 @@ class Simulator:
                 count += violated
             return count
 
-        def victim_helps(i: int) -> bool:
-            vg = placed_groups[i]
-            if reason == FAIL_PORTS:
-                return bool(pod_ports & set(tz._port_rows[vg].keys()))
-            if reason == FAIL_GPU:
-                return ext_log["gpu_mem"][i] > 0
-            if reason == FAIL_STORAGE:
-                return (
-                    float(np.sum(ext_log["vg_alloc"][i])) > 0
-                    or bool(np.any(ext_log["sdev_take"][i]))
-                )
-            if reason == FAIL_INTERPOD:
-                return any(tz._s_match[vg].get(t) for t in anti_terms)
-            if reason == FAIL_SPREAD:
-                return any(tz._s_match[vg].get(t) for t in spread_terms)
-            if reason == FAIL_VOLUME:
-                # the victim must hold one of the conflicting volume
-                # identities via a rw/ro mount — attach-only usage (resolved
-                # PVC attachables) cannot cause a VolumeRestrictions conflict
-                victim_keys = set(tz._vol_rw_rows[vg]) | set(tz._vol_ro_rows[vg])
-                return bool(pod_conflict_keys & victim_keys)
-            if reason == FAIL_ATTACH:
-                # evicting any holder of a same-class attachable frees a slot
-                victim_classes = {
-                    tz._vol_class[w]
-                    for w in set(tz._vol_att_rows[vg]) | set(tz._vol_rw_rows[vg])
-                    if w in tz._vol_class
-                }
-                return bool(pod_att_classes & victim_classes)
-            return True  # FAIL_RESOURCES: any eviction frees resources
+        # ---- vectorized victim search -----------------------------------
+        # The per-node Python loop this replaces cost O(nodes × placed) per
+        # failed pod — unusable against 10^5-node clusters with 10^6-entry
+        # placement logs (VERDICT r2 task 5). Everything below is whole-log
+        # numpy: candidate relevance by reason, the PDB reprieve split, the
+        # greedy per-node eviction prefix, and the pickOneNode key all
+        # evaluate per placement-log ENTRY over sorted node segments.
+        n_nodes = len(self._nodes)
+        placed_groups_a = np.asarray(placed_groups, np.int32)
+        g_count = len(tz.groups)
 
-        best = None  # (key, node, victim_indices)
-        for n in range(len(self._nodes)):
-            if not static[n]:
-                continue
-            if pin_name is not None and name_of(self._nodes[n]) != pin_name:
-                continue
-            cand = np.flatnonzero((placed_nodes == n) & (prios < prio))
-            cand = [int(i) for i in cand if victim_helps(int(i))]
-            if not cand:
-                continue
-            # budget-aware reprieve split (filterPodsWithPDBViolation over
-            # the node's potential victims in MoreImportantPod order): a
-            # victim whose PDB budget still absorbs the eviction is
-            # NON-violating and ranks purely by priority; then greedy order =
-            # non-violating first, lowest priority first, later placements
-            # first on ties
-            allowed_n = [a for (_, _, a) in pdb_list]
-            violating = set()
-            for i in sorted(cand, key=lambda i: (-prios[i], i)):
-                viol = False
-                for j in pdbs_matching(i):
-                    allowed_n[j] -= 1
-                    if allowed_n[j] < 0:
-                        viol = True
-                if viol:
-                    violating.add(i)
-            cand.sort(key=lambda i: (i in violating, prios[i], -i))
-            on_node = np.flatnonzero(placed_nodes == n)
-            gpu_free = float(np.sum(tz.ext.gpu_dev_total[n])) - sum(
-                float(np.sum(ext_log["gpu_shares"][i])) * ext_log["gpu_mem"][i]
-                for i in on_node
+        # victim relevance per reason, at group granularity where possible
+        if reason == FAIL_PORTS:
+            rel_g = np.array(
+                [bool(pod_ports & set(tz._port_rows[vg].keys())) for vg in range(g_count)]
             )
-            vg_free = float(
-                np.sum(tz.ext.vg_cap[n]) - np.sum(tz.ext.vg_req0[n])
-            ) - sum(float(np.sum(ext_log["vg_alloc"][i])) for i in on_node)
-            free = alloc[n] - used[n]
-            victims: List[int] = []
-
-            def plausible() -> bool:
-                if not np.all(free >= pod_req - 1e-6):
-                    return False
-                if reason in (FAIL_PORTS, FAIL_INTERPOD, FAIL_SPREAD, FAIL_VOLUME, FAIL_ATTACH):
-                    # every relevant victim on this node must be gone (a
-                    # single eviction may leave another conflicting holder or
-                    # an attach-limit class still saturated)
-                    return all(i in victims for i in cand)
-                if reason == FAIL_GPU:
-                    return gpu_free >= gpu_need - 1e-6
-                if reason == FAIL_STORAGE:
-                    return vg_free >= lvm_need - 1e-6
-                return True
-
-            for i in cand:
-                if victims and plausible():
-                    break
-                free = free + placed_req[i]
-                gpu_free += float(np.sum(ext_log["gpu_shares"][i])) * ext_log["gpu_mem"][i]
-                vg_free += float(np.sum(ext_log["vg_alloc"][i]))
-                victims.append(i)
-            if not victims or not plausible():
-                continue
-            varr = np.asarray(victims)
-            key = (
-                pdb_violations(victims),  # pickOneNode criterion 1
-                float(prios[varr].max()),
-                float(prios[varr].sum()),
-                len(victims),
-                n,
+            relevant = rel_g[placed_groups_a]
+        elif reason == FAIL_INTERPOD:
+            rel_g = np.array(
+                [any(tz._s_match[vg].get(t) for t in anti_terms) for vg in range(g_count)]
             )
-            if best is None or key < best[0]:
-                best = (key, n, victims)
-        if best is None:
+            relevant = rel_g[placed_groups_a]
+        elif reason == FAIL_SPREAD:
+            rel_g = np.array(
+                [any(tz._s_match[vg].get(t) for t in spread_terms) for vg in range(g_count)]
+            )
+            relevant = rel_g[placed_groups_a]
+        elif reason == FAIL_VOLUME:
+            # the victim must hold one of the conflicting volume identities
+            # via a rw/ro mount — attach-only usage (resolved PVC
+            # attachables) cannot cause a VolumeRestrictions conflict
+            rel_g = np.array(
+                [
+                    bool(
+                        pod_conflict_keys
+                        & (set(tz._vol_rw_rows[vg]) | set(tz._vol_ro_rows[vg]))
+                    )
+                    for vg in range(g_count)
+                ]
+            )
+            relevant = rel_g[placed_groups_a]
+        elif reason == FAIL_ATTACH:
+            # evicting any holder of a same-class attachable frees a slot
+            rel_g = np.array(
+                [
+                    bool(
+                        pod_att_classes
+                        & {
+                            tz._vol_class[w]
+                            for w in set(tz._vol_att_rows[vg]) | set(tz._vol_rw_rows[vg])
+                            if w in tz._vol_class
+                        }
+                    )
+                    for vg in range(g_count)
+                ]
+            )
+            relevant = rel_g[placed_groups_a]
+        elif reason == FAIL_GPU:
+            relevant = np.asarray(ext_log["gpu_mem"], np.float32) > 0
+        elif reason == FAIL_STORAGE:
+            vg_sums = (
+                np.asarray(ext_log["vg_alloc"], np.float32).sum(axis=1)
+                if len(ext_log["vg_alloc"])
+                else np.zeros(0)
+            )
+            sd_any = (
+                np.asarray(ext_log["sdev_take"], bool).any(axis=1)
+                if len(ext_log["sdev_take"])
+                else np.zeros(0, bool)
+            )
+            relevant = (vg_sums > 0) | sd_any
+        else:  # FAIL_RESOURCES: any eviction frees resources
+            relevant = np.ones(len(placed_groups_a), bool)
+
+        node_ok = np.asarray(static, bool).copy()
+        if pin_name is not None:
+            # the pin restricts WITHIN the static mask (the serial loop
+            # checked static first): a pinned node the pod can never place
+            # on must not trigger a doomed evict/retry/restore round-trip
+            pin_idx = tz.node_idx.get(pin_name, -1)
+            keep = node_ok[pin_idx] if pin_idx >= 0 else False
+            node_ok[:] = False
+            if keep:
+                node_ok[pin_idx] = True
+        cand_mask = (prios < prio) & relevant & node_ok[placed_nodes]
+        cand = np.flatnonzero(cand_mask)
+        if not len(cand):
             return False
-        _, node, victims = best
+        c_nodes = placed_nodes[cand]
+        c_prios = prios[cand]
+
+        # PDB reprieve split (filterPodsWithPDBViolation): walk each node's
+        # candidates in MoreImportantPod order (priority desc, index asc)
+        # decrementing budgets; a victim is VIOLATING once a matching PDB's
+        # budget goes negative. Vectorized as per-(pdb, node) running counts
+        # along the sorted order.
+        j_pdbs = len(pdb_list)
+        violating1 = np.zeros(len(cand), bool)
+        pdb_match_c = None
+        if j_pdbs:
+            pdb_match_c = np.zeros((j_pdbs, len(cand)), bool)
+            for ci, i in enumerate(cand):
+                for j in pdbs_matching(int(i)):
+                    pdb_match_c[j, ci] = True
+            order1 = np.lexsort((cand, -c_prios, c_nodes))
+            n_sorted1 = c_nodes[order1]
+            seg_start1 = np.concatenate(
+                [[True], n_sorted1[1:] != n_sorted1[:-1]]
+            )
+            seg_id1 = np.cumsum(seg_start1) - 1
+            first_pos = np.flatnonzero(seg_start1)
+            for j in range(j_pdbs):
+                mj = pdb_match_c[j][order1].astype(np.int64)
+                cum = np.cumsum(mj)
+                base = (cum - mj)[first_pos]  # exclusive cum at segment start
+                rank = cum - base[seg_id1]  # inclusive count within segment
+                violating1[order1] |= (mj > 0) & (rank > pdb_list[j][2])
+
+        # greedy eviction order per node: non-violating first, lowest
+        # priority first, later placements first on ties
+        order2 = np.lexsort((-cand, c_prios, violating1, c_nodes))
+        n2 = c_nodes[order2]
+        seg_start2 = np.concatenate([[True], n2[1:] != n2[:-1]])
+        seg_id2 = np.cumsum(seg_start2) - 1
+        n_segs = int(seg_id2[-1]) + 1
+        seg_first = np.flatnonzero(seg_start2)
+        seg_node = n2[seg_first]
+
+        def seg_cumsum(vals):
+            """Within-segment inclusive cumulative sum along order2."""
+            cum = np.cumsum(vals, axis=0)
+            base = (cum - vals)[seg_first]
+            return cum - base[seg_id2]
+
+        req2 = placed_req[cand][order2]  # [C, R]
+        cum_req = seg_cumsum(req2)
+        free0 = (alloc - used)[seg_node[seg_id2]]  # [C, R] start free per row
+        res_ok = np.all(
+            free0 + cum_req >= pod_req[None, :] - 1e-6, axis=1
+        )
+        if reason == FAIL_GPU:
+            gpu_use_all = (
+                np.asarray(ext_log["gpu_shares"], np.float32).sum(axis=1)
+                * np.asarray(ext_log["gpu_mem"], np.float32)
+                if len(ext_log["gpu_mem"])
+                else np.zeros(0, np.float32)
+            )
+            gpu_used_n = np.zeros(n_nodes, np.float32)
+            np.add.at(gpu_used_n, placed_nodes, gpu_use_all)
+            gpu_free0 = tz.ext.gpu_dev_total.sum(axis=1) - gpu_used_n
+            cum_gpu = seg_cumsum(gpu_use_all[cand][order2])
+            res_ok &= (
+                gpu_free0[seg_node[seg_id2]] + cum_gpu >= gpu_need - 1e-6
+            )
+        elif reason == FAIL_STORAGE:
+            vg_use_all = (
+                np.asarray(ext_log["vg_alloc"], np.float32).sum(axis=1)
+                if len(ext_log["vg_alloc"])
+                else np.zeros(0, np.float32)
+            )
+            vg_used_n = np.zeros(n_nodes, np.float32)
+            np.add.at(vg_used_n, placed_nodes, vg_use_all)
+            vg_free0 = (tz.ext.vg_cap.sum(axis=1) - tz.ext.vg_req0.sum(axis=1)) - vg_used_n
+            cum_vg = seg_cumsum(vg_use_all[cand][order2])
+            res_ok &= vg_free0[seg_node[seg_id2]] + cum_vg >= lvm_need - 1e-6
+        elif reason in (FAIL_PORTS, FAIL_INTERPOD, FAIL_SPREAD, FAIL_VOLUME, FAIL_ATTACH):
+            # every relevant victim on the node must go (a single eviction
+            # may leave another conflicting holder or a saturated class)
+            is_last = np.concatenate([seg_start2[1:], [True]])
+            res_ok &= is_last
+
+        # minimal qualifying prefix per segment
+        pos_in_seg = np.arange(len(order2)) - seg_first[seg_id2]
+        first_ok = np.full(n_segs, np.iinfo(np.int64).max)
+        ok_pos = np.flatnonzero(res_ok)
+        np.minimum.at(first_ok, seg_id2[ok_pos], pos_in_seg[ok_pos])
+        valid_seg = first_ok < np.iinfo(np.int64).max
+        if not valid_seg.any():
+            return False
+
+        # pickOneNode key on each segment's prefix: (PDB violations counted
+        # in eviction order, highest victim priority, summed priorities,
+        # victim count, node index)
+        prio2 = c_prios[order2].astype(np.float64)
+        cum_prio = seg_cumsum(prio2)
+        # segmented running max via monotone per-segment offsets: shift
+        # priorities to [0, range] and add seg_id*(range+1) — offsets stay
+        # far below 2^53, so the subtraction is exact
+        p_min = float(prio2.min())
+        span = float(prio2.max()) - p_min + 1.0
+        off = seg_id2.astype(np.float64) * span
+        cum_max = np.maximum.accumulate(prio2 - p_min + off) - off + p_min
+        if j_pdbs:
+            viol2 = np.zeros(len(order2), bool)
+            for j in range(j_pdbs):
+                mj = pdb_match_c[j][order2].astype(np.int64)
+                rank = seg_cumsum(mj)
+                viol2 |= (mj > 0) & (rank > pdb_list[j][2])
+            cum_viol = seg_cumsum(viol2.astype(np.int64))
+        else:
+            cum_viol = np.zeros(len(order2), np.int64)
+        sel = seg_first + np.where(valid_seg, first_ok, 0)
+        keys = np.lexsort(
+            (
+                seg_node,
+                first_ok + 1,
+                cum_prio[sel],
+                cum_max[sel],
+                cum_viol[sel],
+                ~valid_seg,  # invalid segments last
+            )
+        )
+        best_seg = int(keys[0])
+        if not valid_seg[best_seg]:
+            return False
+        node = int(seg_node[best_seg])
+        a = int(seg_first[best_seg])
+        b = a + int(first_ok[best_seg]) + 1
+        victims = [int(cand[i]) for i in order2[a:b]]
 
         saved = self._engine.remove_placements(victims)
         saved_pods = [(i, self._scheduled[i], self._placed_prio[i]) for i in saved["indices"]]
@@ -493,70 +599,89 @@ class Simulator:
         )
 
     def _write_extended_annotations(self, nodes: List[dict]) -> None:
-        """Mirror the storage/GPU state the reference's Bind/Reserve plugins
-        write back into node annotations (`plugin/open-local.go:218-249`,
-        `plugin/open-gpu-share.go:146-189`)."""
-        import json as _json
+        write_extended_annotations(self._tensorizer.ext, self._engine.ext_log, nodes)
 
-        import numpy as np
 
-        from .core.extended import NodeStorage
+def record_placed_pod(pod: dict, node_name: str, gpu_shares) -> dict:
+    """The placed copy of `pod`: nodeName bound, phase Running, and the
+    GPU device-assignment annotation the reference's GpuSharePlugin.Bind
+    applies (`open-gpu-share.go:221-241` + `utils/pod.go:117-127`)."""
+    placed = shallow_pod_copy(pod)
+    placed["spec"]["nodeName"] = node_name
+    placed.setdefault("status", {})["phase"] = "Running"
+    already = annotations_of(placed).get(C.ANNO_POD_GPU_INDEX)
+    if gpu_shares.sum() > 0 and not already:
+        ids = []
+        for dev_id, cnt in enumerate(gpu_shares):
+            ids.extend([str(dev_id)] * int(round(float(cnt))))
+        set_annotation(placed, C.ANNO_POD_GPU_INDEX, "-".join(ids))
+    return placed
 
-        ext = self._tensorizer.ext
-        log = self._engine.ext_log
-        n = len(nodes)
-        v = ext.vg_cap.shape[1]
-        sd = ext.sdev_cap.shape[1]
-        gd = ext.gpu_dev_total.shape[1]
-        vg_used = np.zeros((n, v), np.float64)
-        sdev_taken = np.zeros((n, sd), bool)
-        gpu_used = np.zeros((n, gd), np.float64)
-        gpu_pods = np.zeros(n, np.int64)
-        for node_idx, vg_alloc, take, shares, mem in zip(
-            log["node"], log["vg_alloc"], log["sdev_take"], log["gpu_shares"], log["gpu_mem"]
-        ):
-            vg_used[node_idx] += vg_alloc
-            sdev_taken[node_idx] |= take
-            gpu_used[node_idx] += np.asarray(shares) * mem
-            if mem > 0:
-                gpu_pods[node_idx] += 1
-        for i, node in enumerate(nodes):
-            storage = NodeStorage.from_node(node)
-            if storage is not None:
-                for j, vg in enumerate(storage.vgs):
-                    if j < v:
-                        prev = parse_quantity(vg.get("requested") or 0)
-                        vg["requested"] = int(prev + vg_used[i, j])
-                        if isinstance(vg.get("capacity"), str):
-                            vg["capacity"] = int(parse_quantity(vg["capacity"]))
-                for j, dev in enumerate(storage.devices):
-                    if j < sd and sdev_taken[i, j]:
-                        dev["isAllocated"] = True
-                set_annotation(
-                    node,
-                    C.ANNO_NODE_LOCAL_STORAGE,
-                    _json.dumps({"vgs": storage.vgs, "devices": storage.devices}),
-                )
-            if ext.gpu_total[i] > 0:
-                devs = {
-                    str(j): {
-                        "gpuTotalMemory": int(ext.gpu_dev_total[i, j]),
-                        "gpuUsedMemory": int(gpu_used[i, j]),
-                    }
-                    for j in range(gd)
-                    if ext.gpu_dev_total[i, j] > 0
+
+def write_extended_annotations(ext, log: dict, nodes: List[dict]) -> None:
+    """Mirror the storage/GPU state the reference's Bind/Reserve plugins
+    write back into node annotations (`plugin/open-local.go:218-249`,
+    `plugin/open-gpu-share.go:146-189`). `ext` is the tensorizer's
+    ExtendedNodeArrays, `log` an engine ext_log (node-parallel lists)."""
+    import json as _json
+
+    import numpy as np
+
+    from .core.extended import NodeStorage
+
+    n = len(nodes)
+    v = ext.vg_cap.shape[1]
+    sd = ext.sdev_cap.shape[1]
+    gd = ext.gpu_dev_total.shape[1]
+    vg_used = np.zeros((n, v), np.float64)
+    sdev_taken = np.zeros((n, sd), bool)
+    gpu_used = np.zeros((n, gd), np.float64)
+    gpu_pods = np.zeros(n, np.int64)
+    for node_idx, vg_alloc, take, shares, mem in zip(
+        log["node"], log["vg_alloc"], log["sdev_take"], log["gpu_shares"], log["gpu_mem"]
+    ):
+        vg_used[node_idx] += vg_alloc
+        sdev_taken[node_idx] |= take
+        gpu_used[node_idx] += np.asarray(shares) * mem
+        if mem > 0:
+            gpu_pods[node_idx] += 1
+    for i, node in enumerate(nodes):
+        storage = NodeStorage.from_node(node)
+        if storage is not None:
+            for j, vg in enumerate(storage.vgs):
+                if j < v:
+                    prev = parse_quantity(vg.get("requested") or 0)
+                    vg["requested"] = int(prev + vg_used[i, j])
+                    if isinstance(vg.get("capacity"), str):
+                        vg["capacity"] = int(parse_quantity(vg["capacity"]))
+            for j, dev in enumerate(storage.devices):
+                if j < sd and sdev_taken[i, j]:
+                    dev["isAllocated"] = True
+            set_annotation(
+                node,
+                C.ANNO_NODE_LOCAL_STORAGE,
+                _json.dumps({"vgs": storage.vgs, "devices": storage.devices}),
+            )
+        if ext.gpu_total[i] > 0:
+            devs = {
+                str(j): {
+                    "gpuTotalMemory": int(ext.gpu_dev_total[i, j]),
+                    "gpuUsedMemory": int(gpu_used[i, j]),
                 }
-                info = {
-                    "gpuCount": int((ext.gpu_dev_total[i] > 0).sum()),
-                    "gpuAllocatable": int(
-                        ((ext.gpu_dev_total[i] > 0) & (gpu_used[i] == 0)).sum()
-                    ),
-                    "gpuTotalMemory": int(ext.gpu_total[i]),
-                    "gpuUsedMemory": int(gpu_used[i].sum()),
-                    "numPods": int(gpu_pods[i]),
-                    "devs": devs,
-                }
-                set_annotation(node, C.ANNO_NODE_GPU_SHARE, _json.dumps(info))
+                for j in range(gd)
+                if ext.gpu_dev_total[i, j] > 0
+            }
+            info = {
+                "gpuCount": int((ext.gpu_dev_total[i] > 0).sum()),
+                "gpuAllocatable": int(
+                    ((ext.gpu_dev_total[i] > 0) & (gpu_used[i] == 0)).sum()
+                ),
+                "gpuTotalMemory": int(ext.gpu_total[i]),
+                "gpuUsedMemory": int(gpu_used[i].sum()),
+                "numPods": int(gpu_pods[i]),
+                "devs": devs,
+            }
+            set_annotation(node, C.ANNO_NODE_GPU_SHARE, _json.dumps(info))
 
 
 def simulate(

@@ -138,9 +138,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     apply_p.add_argument(
         "--search",
-        choices=["binary", "linear"],
+        choices=["binary", "linear", "incremental"],
         default="binary",
-        help="min-node-add search strategy (linear = reference-exact walk)",
+        help="min-node-add search strategy (linear = reference-exact walk; "
+        "incremental = one tensorization + completion probes + fresh "
+        "verification, the fast path for large clusters)",
     )
     apply_p.add_argument(
         "--bulk",

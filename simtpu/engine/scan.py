@@ -225,14 +225,30 @@ def statics_from(tensors: ClusterTensors, sched_config=None) -> StaticArrays:
     score_w = (
         sched_config.score_weights if sched_config is not None else DEFAULT_WEIGHTS
     )
+
+    def dev(host_arr, dtype=None):
+        """Device-resident copy; constant planes materialize ON DEVICE.
+        The [G, N] score planes are all-zero (and vol_mask all-True) for
+        most problems — on a tunneled TPU, shipping them as dense host
+        buffers costs tens of seconds per fresh tensorization, while a
+        device-side fill is a dispatch."""
+        dt = dtype or host_arr.dtype
+        if host_arr.size:
+            first = host_arr.flat[0]
+            if not host_arr.any():
+                return jnp.zeros(host_arr.shape, dt)
+            if host_arr.dtype == bool and first and host_arr.all():
+                return jnp.ones(host_arr.shape, dt)
+        return jnp.asarray(host_arr, dt)
+
     statics = StaticArrays(
         alloc=jnp.asarray(tensors.alloc, jnp.float32),
-        static_mask=jnp.asarray(tensors.static_mask),
-        vol_mask=jnp.asarray(tensors.vol_mask),
-        node_pref=jnp.asarray(tensors.node_pref_score),
-        taint_intol=jnp.asarray(tensors.taint_intolerable),
-        static_score=jnp.asarray(tensors.static_score, jnp.float32),
-        avoid_pen=jnp.asarray(tensors.avoid_pen, jnp.float32),
+        static_mask=dev(tensors.static_mask),
+        vol_mask=dev(tensors.vol_mask),
+        node_pref=dev(tensors.node_pref_score),
+        taint_intol=dev(tensors.taint_intolerable),
+        static_score=dev(tensors.static_score, jnp.float32),
+        avoid_pen=dev(tensors.avoid_pen, jnp.float32),
         node_dom=jnp.asarray(
             tensors.node_dom if tensors.node_dom.shape[0] else
             np.zeros((1, tensors.alloc.shape[0]), np.int32),
@@ -722,6 +738,78 @@ def _run_scan(statics: StaticArrays, state: SchedState, pods, flags: StepFlags =
     return jax.lax.scan(partial(schedule_step, statics, flags=flags), state, pods)
 
 
+def _delta_step(statics: StaticArrays, state: SchedState, entry):
+    """Apply one placement-log entry to the state with weight w (+1 =
+    re-place, -1 = evict): exactly `schedule_step`'s state-update block,
+    without filters or node choice. Drives incremental preemption — a full
+    build_state from a million-entry log per eviction costs more than the
+    whole preemption."""
+    g, node, w, req, vg_alloc, sdev_take, gpu_vec = entry
+    safe = jnp.clip(node, 0)
+    updates = {"free": state.free.at[safe].add(-req * w)}
+    if state.ports_used.shape[1]:
+        updates["ports_used"] = state.ports_used.at[safe].add(
+            statics.ports_req[g] * w
+        )
+    if state.vols_any.shape[1]:
+        v_rw = statics.vol_rw_req[g]
+        v_present = v_rw | statics.vol_ro_req[g] | statics.vol_att_req[g]
+        updates["vols_any"] = state.vols_any.at[safe].add(v_present * w)
+        updates["vols_rw"] = state.vols_rw.at[safe].add(v_rw * w)
+    if state.vg_free.shape[1]:
+        updates["vg_free"] = state.vg_free.at[safe].add(-vg_alloc * w)
+    if state.sdev_free.shape[1]:
+        # boolean devices: w>0 consumes (clear), w<0 releases (set)
+        row = state.sdev_free[safe]
+        row = jnp.where(w > 0, row & ~sdev_take, row | sdev_take)
+        updates["sdev_free"] = state.sdev_free.at[safe].set(row)
+    if state.gpu_free.shape[1]:
+        updates["gpu_free"] = state.gpu_free.at[safe].add(-gpu_vec * w)
+    t_cap = statics.g_terms.shape[1]
+    if t_cap:
+        terms_g = statics.g_terms[g]
+        tvalid = terms_g >= 0
+        tsafe = jnp.clip(terms_g, 0)
+        dom_sub = statics.node_dom[statics.term_topo[tsafe]]
+        valid_sub = (dom_sub >= 0) & tvalid[:, None]
+        dom_chosen = dom_sub[:, safe]
+        valid_chosen = (dom_chosen >= 0) & tvalid
+        same = valid_sub & (dom_sub == dom_chosen[:, None]) & valid_chosen[:, None]
+        inc = jnp.where(same, w, 0.0)
+
+        updates["cnt_match"] = state.cnt_match.at[tsafe].add(
+            statics.s_match[g][:, None] * inc
+        )
+        updates["cnt_total"] = state.cnt_total.at[tsafe].add(
+            statics.s_match[g] * jnp.where(valid_chosen, w, 0.0)
+        )
+        ip_g = statics.ip_of[tsafe]
+        ipsafe = jnp.clip(ip_g, 0)
+        ip_w = jnp.where(ip_g >= 0, 1.0, 0.0)
+
+        def bump_ip(arr, vals):
+            return arr.at[ipsafe].add((vals * ip_w)[:, None] * inc)
+
+        updates["cnt_own_anti"] = bump_ip(
+            state.cnt_own_anti, statics.a_anti_req[g].astype(jnp.float32)
+        )
+        updates["cnt_own_aff"] = bump_ip(
+            state.cnt_own_aff, statics.a_aff_req[g].astype(jnp.float32)
+        )
+        updates["w_own_aff_pref"] = bump_ip(state.w_own_aff_pref, statics.w_aff_pref[g])
+        updates["w_own_anti_pref"] = bump_ip(
+            state.w_own_anti_pref, statics.w_anti_pref[g]
+        )
+    return state._replace(**updates), ()
+
+
+@partial(jax.jit, donate_argnums=(1,))
+def _apply_log_delta(statics: StaticArrays, state: SchedState, entries):
+    """Scan `_delta_step` over padded entry arrays (w = 0 rows are no-ops)."""
+    state, _ = jax.lax.scan(partial(_delta_step, statics), state, entries)
+    return state
+
+
 class Engine:
     """Host-side driver: threads the placement log across app batches.
 
@@ -747,6 +835,21 @@ class Engine:
         self.last_state: SchedState = None
         self._last_vocab = None  # vocabulary sizes behind last_state
         self._state_dirty = False  # log surgery (preemption) invalidates reuse
+
+    @staticmethod
+    def state_vocab(tensors) -> tuple:
+        """The vocabulary tuple a carried state is valid under — the single
+        source of truth for Engine.place's reuse check, the eviction delta
+        guard, and the incremental planner's snapshot injection (a field
+        added to one but not the others would silently validate a stale
+        state)."""
+        return (
+            tensors.alloc.shape[1],
+            tensors.n_terms,
+            tensors.n_ports,
+            tensors.n_vols,
+            int((interpod_term_index(tensors) >= 0).sum()),
+        )
 
     def _dispatch(
         self, statics: StaticArrays, state: SchedState, pods, flags: StepFlags
@@ -775,13 +878,7 @@ class Engine:
         # The interpod-plane count participates: a new group can mark an
         # ALREADY-interned term as interpod-used without growing n_terms,
         # which reshapes the compacted own planes.
-        vocab = (
-            r,
-            tensors.n_terms,
-            tensors.n_ports,
-            tensors.n_vols,
-            int((interpod_term_index(tensors) >= 0).sum()),
-        )
+        vocab = self.state_vocab(tensors)
         if (
             self.last_state is not None
             and not self._state_dirty
@@ -842,9 +939,46 @@ class Engine:
     # evicting a victim = deleting its log entry (build_state recounts all
     # derived state from the log on the next batch).
 
+    def _apply_saved_delta(self, saved: dict, sign: float) -> None:
+        """Incrementally apply an eviction (sign=-1) or its undo (sign=+1)
+        to the carried device state, so preemption does not force a full
+        build_state from the placement log. Falls back to marking the state
+        dirty (rebuild on next place) when no reusable state exists."""
+        entries = saved["entries"]
+        if (
+            self.last_state is None
+            or self._state_dirty
+            or not entries
+        ):
+            self._state_dirty = True
+            return
+        tensors = self.tensorizer.freeze()
+        r = tensors.alloc.shape[1]
+        if self._last_vocab != self.state_vocab(tensors):
+            self._state_dirty = True
+            return
+        v = len(entries)
+        v_pad = 1 << max(v - 1, 0).bit_length()  # pow2-bounded compile set
+        g_a = np.zeros(v_pad, np.int32)
+        n_a = np.zeros(v_pad, np.int32)
+        w_a = np.zeros(v_pad, np.float32)
+        req_a = np.zeros((v_pad, r), np.float32)
+        vg_a = np.zeros((v_pad, tensors.ext.vg_cap.shape[1]), np.float32)
+        sd_a = np.zeros((v_pad, tensors.ext.sdev_cap.shape[1]), bool)
+        gp_a = np.zeros((v_pad, tensors.ext.gpu_dev_total.shape[1]), np.float32)
+        for i, (g, node, req, _enode, vg, sdev, gpu_sh, gpu_mem) in enumerate(entries):
+            g_a[i], n_a[i], w_a[i] = g, node, sign
+            req_a[i, : req.shape[0]] = req
+            vg_a[i] = vg
+            sd_a[i] = sdev
+            gp_a[i] = np.asarray(gpu_sh) * gpu_mem
+        statics = statics_from(tensors, self.sched_config)
+        self.last_state = _apply_log_delta(
+            statics, self.last_state, (g_a, n_a, w_a, req_a, vg_a, sd_a, gp_a)
+        )
+
     def remove_placements(self, indices: List[int]) -> dict:
         """Delete log entries at `indices`; returns an undo token."""
-        self._state_dirty = True
         idx = sorted(set(indices))
         ext = self.ext_log
         saved = {
@@ -869,11 +1003,11 @@ class Engine:
             del self.placed_req[i]
             for key in ("node", "vg_alloc", "sdev_take", "gpu_shares", "gpu_mem"):
                 del ext[key][i]
+        self._apply_saved_delta(saved, sign=-1.0)
         return saved
 
     def restore_placements(self, saved: dict) -> None:
         """Undo a remove_placements (entries return to their positions)."""
-        self._state_dirty = True
         ext = self.ext_log
         for i, entry in zip(saved["indices"], saved["entries"]):
             g, node, req, enode, vg, sdev, gpu_sh, gpu_mem = entry
@@ -885,3 +1019,4 @@ class Engine:
             ext["sdev_take"].insert(i, sdev)
             ext["gpu_shares"].insert(i, gpu_sh)
             ext["gpu_mem"].insert(i, gpu_mem)
+        self._apply_saved_delta(saved, sign=1.0)

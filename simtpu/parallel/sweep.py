@@ -62,29 +62,28 @@ def _sweep_scan(
     return jax.vmap(one)(valid_s)
 
 
-def sweep_feasibility(
+def assemble_planning_problem(
     cluster: ResourceTypes,
     apps: Sequence[AppResource],
     new_node: dict,
-    candidates: Sequence[int],
+    max_new: int,
     extended_resources: Sequence[str] = (),
-    mesh=None,
-    sched_config=None,
 ):
-    """Run every candidate clone-count in one batched placement.
+    """One tensorization covering the base cluster plus `max_new` template
+    clones, with the ordered pod sequence exactly as simulate() submits it
+    (cluster pods + DaemonSet expansion over ALL nodes incl. clones, then
+    each app's sorted pods). Shared by the batched sweep and the
+    incremental planner — candidate membership is expressed afterwards via
+    `node_valid` masks, never by re-tensorizing.
 
-    Returns (failures [S] int array — unscheduled-pod count per candidate,
-    n_base, pods) where `pods` is the concatenated ordered pod list.
+    Returns (tensorizer, all_nodes, n_base, ordered_pods).
     """
     from ..plan.capacity import new_fake_nodes
 
-    candidates = np.asarray(list(candidates), np.int32)
-    max_new = int(candidates.max()) if len(candidates) else 0
     base_nodes = list(cluster.nodes)
     n_base = len(base_nodes)
     all_nodes = base_nodes + new_fake_nodes(new_node, max_new)
 
-    # ordered pod sequence, exactly as simulate() submits it
     ordered: List[dict] = []
     work = ResourceTypes(**{k: list(v) for k, v in vars(cluster).items()})
     work.nodes = all_nodes
@@ -109,6 +108,28 @@ def sweep_feasibility(
         services=list(cluster.services),
         pvcs=list(cluster.persistent_volume_claims),
         pvs=list(cluster.persistent_volumes),
+    )
+    return tensorizer, all_nodes, n_base, ordered
+
+
+def sweep_feasibility(
+    cluster: ResourceTypes,
+    apps: Sequence[AppResource],
+    new_node: dict,
+    candidates: Sequence[int],
+    extended_resources: Sequence[str] = (),
+    mesh=None,
+    sched_config=None,
+):
+    """Run every candidate clone-count in one batched placement.
+
+    Returns (failures [S] int array — unscheduled-pod count per candidate,
+    n_base, pods) where `pods` is the concatenated ordered pod list.
+    """
+    candidates = np.asarray(list(candidates), np.int32)
+    max_new = int(candidates.max()) if len(candidates) else 0
+    tensorizer, all_nodes, n_base, ordered = assemble_planning_problem(
+        cluster, apps, new_node, max_new, extended_resources
     )
     batch = tensorizer.add_pods(ordered)
     tensors = tensorizer.freeze()

@@ -1,0 +1,11 @@
+"""Capacity planning: serial reference-faithful search (`capacity`),
+incremental single-tensorization search (`incremental`), and the batched
+candidate sweep (`simtpu.parallel.sweep`)."""
+
+from .capacity import (  # noqa: F401
+    Applier,
+    ApplierOptions,
+    PlanResult,
+    plan_capacity,
+)
+from .incremental import plan_capacity_incremental  # noqa: F401

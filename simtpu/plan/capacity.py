@@ -397,17 +397,30 @@ class Applier:
             ctx = jax.profiler.trace(trace_dir)
         t0 = _time.perf_counter()
         with ctx:
-            plan = plan_capacity(
-                cluster,
-                apps,
-                new_node,
-                extended_resources=self.opts.extended_resources,
-                search=self.opts.search,
-                progress=progress,
-                bulk=self.opts.bulk,
-                sched_config=self._sched_config(),
-                corrected_ds_overhead=self.opts.corrected_ds_overhead,
-            )
+            if self.opts.search == "incremental":
+                from .incremental import plan_capacity_incremental
+
+                plan = plan_capacity_incremental(
+                    cluster,
+                    apps,
+                    new_node,
+                    extended_resources=self.opts.extended_resources,
+                    progress=progress,
+                    sched_config=self._sched_config(),
+                    corrected_ds_overhead=self.opts.corrected_ds_overhead,
+                )
+            else:
+                plan = plan_capacity(
+                    cluster,
+                    apps,
+                    new_node,
+                    extended_resources=self.opts.extended_resources,
+                    search=self.opts.search,
+                    progress=progress,
+                    bulk=self.opts.bulk,
+                    sched_config=self._sched_config(),
+                    corrected_ds_overhead=self.opts.corrected_ds_overhead,
+                )
         timings["plan"] = _time.perf_counter() - t0
         plan.timings = timings
         return plan

@@ -236,6 +236,8 @@ def synth_apps(
     spread_hard_frac: float = 0.0,  # fraction OF spread workloads DoNotSchedule
     gpu_frac: float = 0.0,
     storage_frac: float = 0.0,
+    storage_device_frac: float = 0.3,  # fraction OF storage workloads claiming
+    # an exclusive device (the rest binpack LVM)
 ) -> List[AppResource]:
     """App list totalling ~n_pods pods across deployments with mixed
     constraints (the `complicate` example writ large)."""
@@ -251,7 +253,7 @@ def synth_apps(
         if roll < gpu_frac:
             kw["gpu_mem_mib"] = int(rng.choice([4096, 8192, 16384]))
         elif roll < gpu_frac + storage_frac:
-            if rng.random() < 0.3:
+            if rng.random() < storage_device_frac:
                 kw["device_gib"] = int(rng.integers(50, 200))
             else:
                 kw["lvm_gib"] = int(rng.integers(5, 40))

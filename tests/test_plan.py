@@ -194,3 +194,107 @@ class TestMeetResourceRequests:
         plan = plan_capacity(cluster, [app], TEMPLATE, max_new_nodes=4)
         assert not plan.success
         assert "cannot meet resource requests" not in plan.message
+
+
+class TestIncrementalPlanner:
+    """plan_capacity_incremental must agree with the serial planner on
+    success and node count while paying tensorization once (VERDICT r2
+    task 1 — the second half of the BASELINE metric)."""
+
+    @pytest.mark.parametrize("seed", [5, 21, 34])
+    def test_matches_serial_planner(self, seed):
+        import numpy as np
+
+        from simtpu.plan.incremental import plan_capacity_incremental
+        from simtpu.synth import make_node, synth_apps
+
+        cluster = ResourceTypes()
+        cluster.nodes = [
+            make_node(
+                f"node-{i:06d}",
+                8000,
+                16,
+                {
+                    "topology.kubernetes.io/zone": f"zone-{i % 2}",
+                    "kubernetes.io/hostname": f"node-{i:06d}",
+                },
+            )
+            for i in range(3)
+        ]
+        apps = synth_apps(
+            160,
+            seed=seed + 1,
+            zones=2,
+            pods_per_deployment=20,
+            selector_frac=0.0,
+            anti_affinity_frac=0.2,
+            spread_frac=0.4,
+            spread_hard_frac=0.5,
+        )
+        template = make_node(
+            "tmpl",
+            16000,
+            64,
+            {
+                "kubernetes.io/hostname": "tmpl",
+                "topology.kubernetes.io/zone": "zone-0",
+            },
+        )
+        seed_name_hashes(seed)
+        serial = plan_capacity(cluster, apps, template, max_new_nodes=60)
+        seed_name_hashes(seed)
+        inc = plan_capacity_incremental(cluster, apps, template, max_new_nodes=60)
+        seed_name_hashes(seed)
+        inc_nv = plan_capacity_incremental(
+            cluster, apps, template, max_new_nodes=60, verify=False
+        )
+        assert inc.success == serial.success
+        assert inc_nv.success == serial.success
+        if serial.success:
+            assert inc.nodes_added == serial.nodes_added
+            # the unverified oracle may differ from a fresh greedy trace in
+            # principle; in practice these scenarios agree exactly
+            assert abs(inc_nv.nodes_added - serial.nodes_added) <= 1
+            for r in (inc, inc_nv):
+                assert len(r.result.unscheduled_pods) == 0
+                placed = sum(len(s.pods) for s in r.result.node_status)
+                assert placed == sum(
+                    len(s.pods) for s in serial.result.node_status
+                )
+
+    def test_never_help_diagnostic(self):
+        from simtpu.plan.incremental import plan_capacity_incremental
+        from simtpu.workloads.expand import seed_name_hashes as _snh
+
+        cluster = _small_cluster()
+        app = _app(6, "2", "4Gi")  # needs ~3 template nodes of capacity
+        tainted = make_fake_node(
+            "tmpl",
+            "16",
+            "64Gi",
+            with_node_taints([{"key": "k", "value": "v", "effect": "NoSchedule"}]),
+        )
+        _snh(11)
+        plan = plan_capacity_incremental(cluster, [app], tainted, max_new_nodes=8)
+        assert not plan.success
+        assert "does not fit new node affinity or taints" in plan.message
+
+    def test_single_candidate_cap(self):
+        """max_new_nodes=1 (exclusive upper bound: no candidate beyond 0)
+        must fail cleanly, not crash in the lower-bound arithmetic."""
+        from simtpu.plan.incremental import plan_capacity_incremental
+        from simtpu.synth import make_deployment, make_node
+
+        cluster = ResourceTypes()
+        cluster.nodes = [make_node("n0", 2000, 4, {"kubernetes.io/hostname": "n0"})]
+        dep = make_deployment("big", 8, 1000, 512)
+        res = ResourceTypes()
+        res.deployments = [dep]
+        plan = plan_capacity_incremental(
+            cluster,
+            [AppResource(name="a", resource=res)],
+            make_node("t", 2000, 4, {"kubernetes.io/hostname": "t"}),
+            max_new_nodes=1,
+        )
+        assert not plan.success
+        assert "still failed" in plan.message

@@ -258,3 +258,56 @@ def test_pdb_with_budget_does_not_penalize_covered_victim():
     assert placed.get("vip") == "n0"
     assert [p.pod["metadata"]["name"] for p in result.preempted_pods] == ["covered"]
     assert placed.get("pricey") == "n0"
+
+
+def test_preemption_at_scale():
+    """VERDICT r2 task 5: hundreds of preemptions against a placement log of
+    thousands of entries must run in seconds — the victim search is
+    vectorized over the whole log (api.py) and evictions update the carried
+    device state incrementally instead of rebuilding it (engine/scan.py).
+    Semantics pinned: every high-priority pod lands, every eviction is
+    recorded, and the displaced capacity matches exactly."""
+    import time
+
+    from simtpu.core.objects import AppResource, ResourceTypes
+    from simtpu.synth import make_deployment, make_node
+
+    n = 300
+    cluster = ResourceTypes()
+    cluster.nodes = [
+        make_node(
+            f"node-{i:06d}",
+            4000,
+            16,
+            {
+                "topology.kubernetes.io/zone": f"zone-{i % 4}",
+                "kubernetes.io/hostname": f"node-{i:06d}",
+            },
+        )
+        for i in range(n)
+    ]
+    low = make_deployment("low", n * 4, 1000, 512)
+    low["spec"]["template"]["spec"]["priority"] = 10
+    high = make_deployment("high", 250, 2000, 1024)
+    high["spec"]["template"]["spec"]["priority"] = 1000
+    res_low = ResourceTypes()
+    res_low.deployments = [low]
+    res_high = ResourceTypes()
+    res_high.deployments = [high]
+    apps = [
+        AppResource(name="low", resource=res_low),
+        AppResource(name="high", resource=res_high),
+    ]
+    from simtpu.workloads.expand import seed_name_hashes
+
+    seed_name_hashes(1)
+    t0 = time.perf_counter()
+    out = simulate(cluster, apps, bulk=True)
+    wall = time.perf_counter() - t0
+    placed = sum(len(s.pods) for s in out.node_status)
+    # every high-prio pod fits by evicting exactly two 1-cpu victims
+    assert len(out.unscheduled_pods) == 0
+    assert len(out.preempted_pods) == 2 * 250
+    assert placed == n * 4 - 2 * 250 + 250
+    # generous envelope: the pre-vectorization search alone took minutes
+    assert wall < 120, f"preemption path too slow: {wall:.1f}s"
