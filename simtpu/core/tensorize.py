@@ -87,6 +87,11 @@ _BASE_RESOURCES = ("cpu", "memory", "pods")
 # `vendor/.../plugins/nodeunschedulable/node_unschedulable.go`).
 _UNSCHEDULABLE_TAINT = {"key": "node.kubernetes.io/unschedulable", "effect": "NoSchedule"}
 
+# Domain-count cap for the "small key" same-domain reduction route (zone /
+# region / rack-sized keys); keys with more domains use the unique-per-node
+# route (hostname) or the scatter fallback. Shared with engine/rounds.py.
+DOM_SMALL = 64
+
 
 # ---------------------------------------------------------------------------
 # Node-side vectorized label algebra
@@ -457,6 +462,12 @@ class ClusterTensors:
     node_dom: np.ndarray  # [K, N] i32 global domain id, -1 when key absent
     n_domains: int
     topo_keys: List[str]
+    # per-key same-domain reduction routing (engine/rounds.py): 1 = SMALL
+    # (≤ DOM_SMALL domains; compact per-key ids in node_dom_small feed a
+    # one-hot einsum), 2 = UNIQUE (every domain holds one node — zone sums
+    # are the values themselves), 0 = fallback scatter
+    key_kind: np.ndarray  # [K] i32
+    node_dom_small: np.ndarray  # [K, N] i32 compact per-key id, -1 absent
 
     # group axis
     groups: List[PodGroup]
@@ -634,6 +645,8 @@ class Tensorizer:
         self.topo_keys = Interner()
         self.domains = Interner()  # (key, value) pairs
         self._node_dom_rows: List[np.ndarray] = []  # [K][N]
+        self._node_dom_small_rows: List[np.ndarray] = []  # [K][N] compact ids
+        self._key_kinds: List[int] = []  # [K] reduction route per key
         self.term_interner = Interner()
         self.terms: List[Term] = []
         self._term_topo: List[int] = []
@@ -693,8 +706,11 @@ class Tensorizer:
         k = self.topo_keys.intern(key)
         li = self.label_index
         vid = li._vid.get(key)
+        n = len(self.nodes)
         if vid is None:
-            row = np.full(len(self.nodes), -1, np.int32)
+            row = np.full(n, -1, np.int32)
+            small = np.full(n, -1, np.int32)
+            kind = 1  # vacuous small key: no domains at all
         else:
             # domain id per label-value id, then one vectorized gather (a
             # 100k-node Python loop per new topology key was measurable);
@@ -705,7 +721,19 @@ class Tensorizer:
             for v, j in vmap.items():
                 dom_of[j] = self.domains.intern((key, v))
             row = dom_of[vid]
+            # same-domain reduction routing: the per-key value ids are
+            # already compact [0, n_vals)
+            if len(vmap) <= DOM_SMALL:
+                kind, small = 1, vid.astype(np.int32)
+            elif vid.max(initial=-1) >= 0 and np.all(
+                np.bincount(vid[vid >= 0]) <= 1
+            ):
+                kind, small = 2, np.full(n, -1, np.int32)  # unique per node
+            else:
+                kind, small = 0, np.full(n, -1, np.int32)  # scatter fallback
         self._node_dom_rows.append(row)
+        self._node_dom_small_rows.append(small)
+        self._key_kinds.append(kind)
         return k
 
     def _intern_term(self, term: Term) -> int:
@@ -1366,6 +1394,12 @@ class Tensorizer:
         node_dom = (
             np.stack(self._node_dom_rows) if self._node_dom_rows else np.zeros((0, n), np.int32)
         )
+        node_dom_small = (
+            np.stack(self._node_dom_small_rows)
+            if self._node_dom_small_rows
+            else np.zeros((0, n), np.int32)
+        )
+        key_kind = np.asarray(self._key_kinds, np.int32)
         p_n = len(self.ports)
         ports = np.zeros((g_n, p_n), bool)
         for gi, row in enumerate(self._port_rows):
@@ -1394,6 +1428,8 @@ class Tensorizer:
             node_dom=node_dom,
             n_domains=max(len(self.domains), 1),
             topo_keys=[str(k) for k in self.topo_keys.items()],
+            key_kind=key_kind,
+            node_dom_small=node_dom_small,
             groups=list(self.groups),
             static_mask=self._static_mask.view(),
             node_pref_score=self._node_pref.view(),

@@ -57,14 +57,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.tensorize import DOM_SMALL
 from ..kernels.filters import _RES_EPS, interpod_filter, topology_spread_filter
 from .scan import (
     Engine,
     SchedState,
     StaticArrays,
     StepFlags,
+    add_rows,
     filter_and_score,
     score_pod,
+    take_rows,
+    take_rows_i32,
 )
 
 # plain floats: a module-level jnp constant would initialize the JAX backend
@@ -94,20 +98,29 @@ def _fill_order(cap_x: jnp.ndarray, free_x: jnp.ndarray):
     tightest-first means containers are visited in ascending initial free
     order — a partially-filled tightest container has strictly less free
     than it started with, so it stays tightest until exhausted — taking
-    cap_x[v] pods each. Returns (ord [N, X] visit order, c_sorted, cum_sorted)
-    for the rank arithmetic of caps, updates, and per-slot picks."""
+    cap_x[v] pods each. Returns (perm [N, X, X] one-hot visit permutation,
+    order [N, X] visit order, c_sorted, cum_sorted) for the rank arithmetic
+    of caps, updates, and per-slot picks. The permuted reads/writes run as
+    one-hot einsums: per-element take_along_axis/scatter over the container
+    axis lowered to latency-bound kernels costing milliseconds per round,
+    while X is tiny (≤ a handful of VGs/devices) so the [N, X, X] products
+    run at bandwidth."""
     key = jnp.where(cap_x > 0, free_x, _BIG)
     order = jnp.argsort(key, axis=1)  # stable: ties by index, like the serial argmin
-    c_sorted = jnp.take_along_axis(cap_x, order, axis=1)
-    return order, c_sorted, jnp.cumsum(c_sorted, axis=1)
+    perm = jax.nn.one_hot(order, cap_x.shape[1], dtype=jnp.float32)
+    c_sorted = jnp.einsum(
+        "nvw,nw->nv", perm, cap_x, precision=jax.lax.Precision.HIGHEST
+    )
+    return perm, order, c_sorted, jnp.cumsum(c_sorted, axis=1)
 
 
-def _unsort_take(m_n, order, c_sorted, cum_sorted):
+def _unsort_take(m_n, perm, c_sorted, cum_sorted):
     """Pods per container given m_n pods on each node, mapped back from the
     sorted visit order to container positions. [N, X]."""
     take_sorted = jnp.clip(m_n[:, None] - (cum_sorted - c_sorted), 0.0, c_sorted)
-    n = order.shape[0]
-    return jnp.zeros_like(c_sorted).at[jnp.arange(n)[:, None], order].set(take_sorted)
+    return jnp.einsum(
+        "nvw,nv->nw", perm, take_sorted, precision=jax.lax.Precision.HIGHEST
+    )
 
 
 def _quota_fill(
@@ -155,15 +168,10 @@ def _quota_fill(
     anti = statics.a_anti_req[g][t_star]
     dom_t = dom_sub[t_star]  # [N] global domain id for t*'s key (-1 absent)
     valid_t = valid_sub[t_star]
-    cnt_sub = jnp.where(tvalid[:, None], state.cnt_match[tsafe], 0.0)
+    cnt_sub = take_rows(state.cnt_match, jnp.where(tvalid, tsafe, -1))
     cnt_t = cnt_sub[t_star]
-    ip_g = statics.ip_of[tsafe]
-    ip_star = ip_g[t_star]
-    own_t = jnp.where(
-        ip_star >= 0,
-        state.cnt_own_anti[jnp.clip(ip_star, 0)],
-        jnp.zeros_like(cnt_t),
-    )
+    ip_eff = jnp.where(tvalid, statics.ip_of[tsafe], -1)
+    own_t = take_rows(state.cnt_own_anti, ip_eff)[t_star]
 
     # -- base feasibility: every constraint EXCEPT t*'s own filter --------
     base = ev.m_gpu
@@ -175,10 +183,9 @@ def _quota_fill(
         # t*'s missing-key infeasibility survives the lift for spread terms
         base = base & (valid_t | ~use_skew)
     if f.interpod_req:
-        ip_ok = (tvalid & (ip_g >= 0))[:, None]
         base = base & interpod_filter(
             cnt_sub,
-            jnp.where(ip_ok, state.cnt_own_anti[jnp.clip(ip_g, 0)], 0.0),
+            take_rows(state.cnt_own_anti, ip_eff),
             valid_sub,
             jnp.where(tvalid, state.cnt_total[tsafe], 0.0),
             statics.s_match[g] & ~onehot,  # t*'s symmetry moves to the quota
@@ -304,7 +311,9 @@ def _round_core(
         terms_g = statics.g_terms[g]
         tvalid = terms_g >= 0
         tsafe = jnp.clip(terms_g, 0)
-        dom_sub = statics.node_dom[statics.term_topo[tsafe]]  # [Tc, N]
+        dom_sub = take_rows_i32(
+            statics.node_dom, jnp.where(tvalid, statics.term_topo[tsafe], -1)
+        )  # [Tc, N]
         valid_sub = (dom_sub >= 0) & tvalid[:, None]
 
     ev = filter_and_score(statics, state, pod, flags)
@@ -349,7 +358,7 @@ def _round_core(
             0.0,
         )
         cap = jnp.where(has_lvm, jnp.minimum(cap, jnp.sum(c_vg, axis=1)), cap)
-        ord_vg, cs_vg, cum_vg = _fill_order(c_vg, state.vg_free)
+        perm_vg, ord_vg, cs_vg, cum_vg = _fill_order(c_vg, state.vg_free)
 
         di = jnp.argmax(dev_size)
         d_size, d_media = dev_size[di], dev_media[di]
@@ -365,7 +374,7 @@ def _round_core(
             0.0,
         )
         cap = jnp.where(has_dev, jnp.minimum(cap, jnp.sum(c_dev, axis=1)), cap)
-        ord_dev, cs_dev, cum_dev = _fill_order(c_dev, statics.sdev_cap)
+        perm_dev, ord_dev, cs_dev, cum_dev = _fill_order(c_dev, statics.sdev_cap)
     if f.gpu:
         is_gpu = gpu_mem > 0
         free_g = jnp.where(statics.gpu_dev_exists, state.gpu_free, -1.0)
@@ -373,7 +382,7 @@ def _round_core(
             is_gpu & (free_g >= gpu_mem), _floor_slots(free_g, gpu_mem), 0.0
         )
         cap = jnp.where(is_gpu, jnp.minimum(cap, jnp.sum(c_gpu, axis=1)), cap)
-        ord_gpu, cs_gpu, cum_gpu = _fill_order(c_gpu, free_g)
+        perm_gpu, ord_gpu, cs_gpu, cum_gpu = _fill_order(c_gpu, free_g)
 
     if quota and t_cap:
         m_n = _quota_fill(
@@ -393,9 +402,7 @@ def _round_core(
         cnt_sub1 = None
         if t_cap:
             bump1 = jnp.where(valid_sub, statics.s_match[g][:, None], 0.0)
-            cnt_sub1 = (
-                jnp.where(tvalid[:, None], state.cnt_match[tsafe], 0.0) + bump1
-            )
+            cnt_sub1 = take_rows(state.cnt_match, terms_g) + bump1
         score1 = score_pod(
             statics,
             state,
@@ -472,18 +479,45 @@ def _round_core(
             updates["vols_rw"] = state.vols_rw + one[:, None] * v_rw
     if t_cap:
         # per-domain totals of m_n over the group's relevant term rows,
-        # broadcast back to every node sharing the domain: one [Tc, D]
-        # scatter + one gather per round, not per pod
-        safe_d = jnp.where(valid_sub, dom_sub, 0)
-        t_idx = jnp.arange(t_cap)[:, None]
+        # broadcast back to every node sharing the domain — routed by key
+        # structure: SMALL keys (zone-sized) ride a one-hot einsum over
+        # compact per-key ids, UNIQUE keys (hostname) are their own sums,
+        # and the [Tc, D] scatter+gather pair (measured ~7.6 ms per round
+        # at 100k nodes) compiles in only when some key actually needs it
+        topo_eff = jnp.where(tvalid, statics.term_topo[tsafe], -1)
+        kind_sub = jnp.where(
+            tvalid, statics.key_kind[jnp.clip(topo_eff, 0)], -1
+        )  # [Tc]
         contrib = jnp.where(valid_sub, m_n[None, :], 0.0)
-        dom_m = jnp.zeros((t_cap, n_domains), jnp.float32).at[t_idx, safe_d].add(
-            contrib
+        dsm = jnp.where(
+            (kind_sub == 1)[:, None],
+            take_rows_i32(
+                statics.node_dom_small, jnp.where(kind_sub == 1, topo_eff, -1)
+            ),
+            -1,
         )
-        add_n = jnp.where(valid_sub, dom_m[t_idx, safe_d], 0.0)  # [Tc, N]
+        a_oh = jax.nn.one_hot(dsm, DOM_SMALL, dtype=jnp.float32)  # [Tc, N, B]
+        sums = jnp.einsum(
+            "tnb,tn->tb", a_oh, contrib, precision=jax.lax.Precision.HIGHEST
+        )
+        y = jnp.einsum(
+            "tb,tnb->tn", sums, a_oh, precision=jax.lax.Precision.HIGHEST
+        )
+        add_n = jnp.where((kind_sub == 2)[:, None], contrib, y)  # [Tc, N]
+        if f.dom_fallback:
+            fb = (kind_sub == 0)[:, None]
+            safe_d = jnp.where(valid_sub & fb, dom_sub, 0)
+            t_idx = jnp.arange(t_cap)[:, None]
+            contrib_fb = jnp.where(fb, contrib, 0.0)
+            dom_m = jnp.zeros((t_cap, n_domains), jnp.float32).at[
+                t_idx, safe_d
+            ].add(contrib_fb)
+            add_n = jnp.where(
+                fb, jnp.where(valid_sub, dom_m[t_idx, safe_d], 0.0), add_n
+            )
 
         def bump(arr, vals):
-            return arr.at[tsafe].add(vals[:, None] * add_n)
+            return add_rows(arr, terms_g, vals[:, None] * add_n)
 
         s_match_g = statics.s_match[g].astype(jnp.float32)
         updates["cnt_match"] = bump(state.cnt_match, s_match_g)
@@ -492,14 +526,12 @@ def _round_core(
         )
         if f.interpod_req or f.interpod_pref:
             # own planes live on the compacted interpod axis (scan.py
-            # schedule_step has the same mapping); zeroed vals make the
-            # clipped row-0 scatters of non-interpod terms no-ops
-            ip_g = statics.ip_of[tsafe]
-            ipsafe = jnp.clip(ip_g, 0)
-            ip_w = jnp.where(ip_g >= 0, 1.0, 0.0)
+            # schedule_step has the same mapping); -1 rows are inert
+            # through the one-hot matmul
+            ip_eff = jnp.where(tvalid, statics.ip_of[tsafe], -1)
 
             def bump_ip(arr, vals):
-                return arr.at[ipsafe].add((vals * ip_w)[:, None] * add_n)
+                return add_rows(arr, ip_eff, vals[:, None] * add_n)
 
         if f.interpod_req:
             updates["cnt_own_anti"] = bump_ip(
@@ -514,12 +546,12 @@ def _round_core(
                 state.w_own_anti_pref, statics.w_anti_pref[g]
             )
     if f.storage:
-        take_vg = _unsort_take(m_n, ord_vg, cs_vg, cum_vg)
+        take_vg = _unsort_take(m_n, perm_vg, cs_vg, cum_vg)
         updates["vg_free"] = state.vg_free - take_vg * l_size
-        taken_dev = _unsort_take(m_n, ord_dev, cs_dev, cum_dev) > 0
+        taken_dev = _unsort_take(m_n, perm_dev, cs_dev, cum_dev) > 0
         updates["sdev_free"] = state.sdev_free & ~taken_dev
     if f.gpu:
-        take_gpu = _unsort_take(m_n, ord_gpu, cs_gpu, cum_gpu)
+        take_gpu = _unsort_take(m_n, perm_gpu, cs_gpu, cum_gpu)
         updates["gpu_free"] = state.gpu_free - take_gpu * gpu_mem
 
     # -- expand per-node intake into per-slot assignments -----------------
@@ -970,44 +1002,126 @@ class RoundsEngine(Engine):
                     gpu_shares, gpu_mem, lvm_sizes, dev_sizes, leftovers,
                 )
             # Leftovers re-check after the whole bulk stretch, so their
-            # reasons reflect the (more-constrained) final state.
-            # Leftover pods of one run are IDENTICAL, and a failed serial
-            # step leaves the state untouched — so probe them one at a time
-            # and stamp the first failure's reason onto the whole remainder
-            # (identical pod + unchanged state ⇒ identical outcome). A probe
-            # that PLACES (e.g. a cross-group spread constraint relaxed by
-            # intervening placements) keeps walking pod-by-pod, exactly like
-            # the serial engine. This keeps the all-fail case O(1) probes per
-            # run instead of O(leftover) full scan steps — at 1M-pod scale
-            # the per-pod re-check was the single largest cost.
-            for a2, b2 in leftovers:
-                state, outs = self._run_scan_segment(
-                    statics, state, pods, a2, a2 + 1, flags
-                )
-                nodes[a2], reasons[a2] = outs[0][0], outs[1][0]
-                lvm_alloc[a2], dev_take[a2], gpu_shares[a2] = (
-                    outs[2][0],
-                    outs[3][0],
-                    outs[4][0],
-                )
-                if nodes[a2] < 0:
-                    # a failed probe leaves the state untouched, and the
-                    # run's pods are identical — the remainder shares its
-                    # failure without running (the all-fail case is O(1)
-                    # probes per run; at 1M-pod scale the per-pod re-check
-                    # was the single largest cost)
-                    nodes[a2 + 1 : b2] = -1
-                    reasons[a2 + 1 : b2] = reasons[a2]
-                elif a2 + 1 < b2:
-                    # the probe placed (e.g. a cross-group spread constraint
-                    # relaxed by intervening placements) — run the remainder
-                    # as one serial segment, exactly like the serial engine
-                    state, outs = self._run_scan_segment(
-                        statics, state, pods, a2 + 1, b2, flags
-                    )
-                    nodes[a2 + 1 : b2], reasons[a2 + 1 : b2] = outs[0], outs[1]
-                    lvm_alloc[a2 + 1 : b2], dev_take[a2 + 1 : b2], gpu_shares[
-                        a2 + 1 : b2
-                    ] = outs[2:5]
+            # reasons reflect the (more-constrained) final state. Leftover
+            # pods of one run are IDENTICAL, and a failed serial step leaves
+            # the state untouched, so ONE probe per run decides its whole
+            # remainder (the all-fail case is O(1) probes per run; at
+            # 1M-pod scale the per-pod re-check was the single largest
+            # cost). The probes themselves are BATCHED: one scan runs the
+            # first pod of every leftover run back-to-back — sequentially
+            # identical to per-run dispatches while failures dominate (a
+            # failed step is a state no-op), and each tunneled dispatch
+            # costs more than the whole probe. When a mid-batch probe
+            # PLACES, later probes ran against a state missing that run's
+            # remainder: their placements (if any) are reverted through the
+            # eviction delta scan and they re-probe next iteration, while
+            # the placed run's remainder walks pod-by-pod exactly like the
+            # serial engine.
+            state = self._probe_leftovers(
+                statics, state, pods, leftovers, flags,
+                nodes, reasons, lvm_alloc, dev_take, gpu_shares,
+            )
         return state, (nodes, reasons, lvm_alloc, dev_take, gpu_shares)
+
+    def _probe_leftovers(
+        self, statics, state, pods, leftovers, flags,
+        nodes, reasons, lvm_alloc, dev_take, gpu_shares,
+    ):
+        from .scan import _apply_log_delta
+
+        pending = list(leftovers)
+        while pending:
+            firsts = np.array([a for a, _ in pending], np.int32)
+            state, outs = self._run_scan_segment_idx(
+                statics, state, pods, firsts, flags
+            )
+            nodes_p, reasons_p, lvm_p, dev_p, gpu_p = outs
+            placed_pos = np.flatnonzero(nodes_p >= 0)
+            stop = int(placed_pos[0]) if len(placed_pos) else len(pending)
+            # when placements dominate the batch, the revert-and-reprobe
+            # loop degrades toward quadratic probe work — after committing
+            # this iteration's prefix, finish the rest one run at a time
+            # (the pre-batching path, linear in runs)
+            go_serial = len(placed_pos) > 4
+            for j in range(stop):
+                a2, b2 = pending[j]
+                nodes[a2:b2] = -1
+                reasons[a2:b2] = reasons_p[j]
+            if stop == len(pending):
+                break
+            # probes beyond the first placement saw a state missing the
+            # placed run's remainder — revert any of their placements and
+            # re-probe them next iteration
+            revert = [int(j) for j in placed_pos if j > stop]
+            if revert:
+                v_pad = self._pow2(len(revert))
+                r = pods[1].shape[1]
+                g_a = np.zeros(v_pad, np.int32)
+                n_a = np.zeros(v_pad, np.int32)
+                w_a = np.zeros(v_pad, np.float32)
+                req_a = np.zeros((v_pad, r), np.float32)
+                vg_a = np.zeros((v_pad, lvm_p.shape[1]), np.float32)
+                sd_a = np.zeros((v_pad, dev_p.shape[1]), bool)
+                gp_a = np.zeros((v_pad, gpu_p.shape[1]), np.float32)
+                for i, j in enumerate(revert):
+                    g_a[i] = pods[0][firsts[j]]
+                    n_a[i] = nodes_p[j]
+                    w_a[i] = -1.0
+                    req_a[i] = pods[1][firsts[j]]
+                    vg_a[i] = lvm_p[j]
+                    sd_a[i] = dev_p[j]
+                    gp_a[i] = gpu_p[j] * pods[8][firsts[j]]
+                state = _apply_log_delta(
+                    statics, state, (g_a, n_a, w_a, req_a, vg_a, sd_a, gp_a)
+                )
+            a2, b2 = pending[stop]
+            nodes[a2], reasons[a2] = nodes_p[stop], 0
+            lvm_alloc[a2], dev_take[a2], gpu_shares[a2] = (
+                lvm_p[stop], dev_p[stop], gpu_p[stop],
+            )
+            if a2 + 1 < b2:
+                # the probe placed (e.g. a cross-group spread constraint
+                # relaxed by intervening placements) — run the remainder as
+                # one serial segment, exactly like the serial engine
+                state, outs2 = self._run_scan_segment(
+                    statics, state, pods, a2 + 1, b2, flags
+                )
+                nodes[a2 + 1 : b2], reasons[a2 + 1 : b2] = outs2[0], outs2[1]
+                lvm_alloc[a2 + 1 : b2], dev_take[a2 + 1 : b2], gpu_shares[
+                    a2 + 1 : b2
+                ] = outs2[2:5]
+            pending = pending[stop + 1 :]
+            if go_serial:
+                for a3, b3 in pending:
+                    state, outs3 = self._run_scan_segment(
+                        statics, state, pods, a3, a3 + 1, flags
+                    )
+                    nodes[a3], reasons[a3] = outs3[0][0], outs3[1][0]
+                    lvm_alloc[a3], dev_take[a3], gpu_shares[a3] = (
+                        outs3[2][0], outs3[3][0], outs3[4][0],
+                    )
+                    if nodes[a3] < 0:
+                        nodes[a3 + 1 : b3] = -1
+                        reasons[a3 + 1 : b3] = reasons[a3]
+                    elif a3 + 1 < b3:
+                        state, outs3 = self._run_scan_segment(
+                            statics, state, pods, a3 + 1, b3, flags
+                        )
+                        nodes[a3 + 1 : b3] = outs3[0]
+                        reasons[a3 + 1 : b3] = outs3[1]
+                        lvm_alloc[a3 + 1 : b3] = outs3[2]
+                        dev_take[a3 + 1 : b3] = outs3[3]
+                        gpu_shares[a3 + 1 : b3] = outs3[4]
+                return state
+        return state
+
+    def _run_scan_segment_idx(self, statics, state, pods, idx, flags):
+        """One scan over an arbitrary index selection of the batch's pods
+        (the batched leftover probes), padded like a contiguous segment."""
+        seg = self._pad_pods(
+            tuple(arr[idx] for arr in pods), self._pow2(len(idx))
+        )
+        state, outs = self._scan_call(statics, state, seg, flags)
+        outs = jax.device_get(outs)
+        return state, tuple(np.asarray(o)[: len(idx)] for o in outs)
 

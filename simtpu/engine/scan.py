@@ -97,6 +97,11 @@ class StaticArrays(NamedTuple):
     # T/K x the memory (T grows with the number of workloads, K is ~2-3).
     node_dom: jnp.ndarray  # [K, N] node domain per topology key (-1 absent)
     term_topo: jnp.ndarray  # [T] topology-key index per term
+    # same-domain reduction routing (engine/rounds.py round updates):
+    # key_kind[k] = 1 small (one-hot einsum over ≤ DOM_SMALL compact ids in
+    # node_dom_small), 2 unique-per-node (sum = value), 0 scatter fallback
+    key_kind: jnp.ndarray  # [K]
+    node_dom_small: jnp.ndarray  # [K, N] compact per-key domain id (-1 absent)
     # The four interpod "own" count planes in SchedState live on a compacted
     # axis of terms that actually appear in some group's (anti-)affinity:
     # ip_of[t] is a term's row there (-1 for spread/selector-spread terms).
@@ -254,6 +259,19 @@ def statics_from(tensors: ClusterTensors, sched_config=None) -> StaticArrays:
             np.zeros((1, tensors.alloc.shape[0]), np.int32),
             jnp.int32,
         ),
+        key_kind=jnp.asarray(
+            tensors.key_kind
+            if tensors.key_kind is not None and tensors.key_kind.shape[0]
+            else np.zeros(1, np.int32),
+            jnp.int32,
+        ),
+        node_dom_small=jnp.asarray(
+            tensors.node_dom_small
+            if tensors.node_dom_small is not None
+            and tensors.node_dom_small.shape[0]
+            else np.full((1, tensors.alloc.shape[0]), -1, np.int32),
+            jnp.int32,
+        ),
         term_topo=jnp.asarray(tensors.term_topo_key, jnp.int32),
         ip_of=jnp.asarray(interpod_term_index(tensors), jnp.int32),
         g_terms=jnp.asarray(g_terms),
@@ -308,6 +326,10 @@ class StepFlags(NamedTuple):
     node_pref: bool = True  # any preferred node affinity weight
     taint_pref: bool = True  # any intolerable PreferNoSchedule taint
     static_score: bool = True  # any ImageLocality / preferAvoidPods signal
+    # any topology key needing the scatter-fallback same-domain reduction
+    # (neither ≤ DOM_SMALL domains nor unique-per-node); False removes the
+    # [Tc, D] scatter/gather pair from the bulk round entirely
+    dom_fallback: bool = True
 
 
 def flags_from(tensors: ClusterTensors, batch_ext: dict) -> StepFlags:
@@ -328,7 +350,9 @@ def flags_from(tensors: ClusterTensors, batch_ext: dict) -> StepFlags:
         and np.asarray(batch_ext["dev_size"]).max() > 0
     )
     gpu = gpu or bool(np.asarray(batch_ext["gpu_mem"]).max(initial=0) > 0)
+    kinds = tensors.key_kind if tensors.key_kind is not None else np.zeros(0)
     return StepFlags(
+        dom_fallback=bool(np.any(kinds == 0)),
         ports=tensors.n_ports > 0,
         vols=bool(tensors.vol_rw.any() or tensors.vol_ro.any()),
         attach=bool(tensors.vol_att.any()),
@@ -343,6 +367,65 @@ def flags_from(tensors: ClusterTensors, batch_ext: dict) -> StepFlags:
         taint_pref=bool(tensors.taint_intolerable.any()),
         static_score=bool(tensors.static_score.any() or tensors.avoid_pen.any()),
     )
+
+
+# plane height up to which the one-hot matmul forms pay: the matmul touches
+# the WHOLE plane (fine for the rounds engine's ROW_BUDGET-bounded carried
+# planes and the [K, N] domain map), while a tall plane (the serial scan's
+# full [T, N] count state) is cheaper through the classic gather/scatter,
+# which touches only the addressed rows
+_MATMUL_ROWS = 512
+
+
+def take_rows(plane: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
+    """`plane[rows]` for a [K, N] plane and a small [Tc] int row vector.
+    Negative row ids yield ZERO rows, subsuming the
+    `where(valid, plane[clip(rows)], 0)` masking idiom at the call sites.
+
+    For short planes this is a one-hot matmul: dynamic row gathers along
+    the major axis lower to latency-bound kernels on TPU (measured ~4 ms
+    for a 1.6 MB gather at 100k nodes — the single hottest op in a bulk
+    round), while the [Tc, K] @ [K, N] product rides the MXU at memory
+    bandwidth. Precision is pinned to HIGHEST: the TPU's default bf16
+    matmul would round counts/domain ids above 256, while the f32-exact
+    passes keep one-hot selection bit-identical to the gather. Tall planes
+    keep the masked gather (the matmul would read the whole plane)."""
+    if plane.shape[0] <= _MATMUL_ROWS:
+        oh = jax.nn.one_hot(rows, plane.shape[0], dtype=jnp.float32)
+        return jnp.matmul(
+            oh, plane.astype(jnp.float32), precision=jax.lax.Precision.HIGHEST
+        )
+    safe = jnp.clip(rows, 0)
+    return jnp.where(
+        (rows >= 0)[:, None], plane[safe].astype(jnp.float32), 0.0
+    )
+
+
+def take_rows_i32(plane: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
+    """Integer-plane row gather via take_rows; exact for values below 2^24
+    (domain ids). Negative row ids yield 0 — callers that need a -1
+    sentinel for invalid rows must mask separately."""
+    if plane.shape[0] <= _MATMUL_ROWS:
+        return take_rows(plane, rows).astype(jnp.int32)
+    safe = jnp.clip(rows, 0)
+    return jnp.where((rows >= 0)[:, None], plane[safe], 0)
+
+
+def add_rows(plane: jnp.ndarray, rows: jnp.ndarray, delta: jnp.ndarray) -> jnp.ndarray:
+    """`plane.at[rows].add(delta)`: duplicate and negative row ids behave
+    like scatter-add with masked rows. Short planes use the full-plane
+    matmul add (row scatters cost milliseconds each on TPU; the
+    [T, Tc] @ [Tc, N] product plus a full-plane add runs at bandwidth —
+    the rounds engine's carried planes are ROW_BUDGET-bounded, ~100 MB).
+    Tall planes (the serial scan's full count state) keep the row scatter,
+    which touches only the addressed rows."""
+    if plane.shape[0] <= _MATMUL_ROWS:
+        oh = jax.nn.one_hot(rows, plane.shape[0], dtype=delta.dtype)
+        return plane + jnp.matmul(
+            oh.T, delta, precision=jax.lax.Precision.HIGHEST
+        )
+    safe = jnp.clip(rows, 0)
+    return plane.at[safe].add(jnp.where((rows >= 0)[:, None], delta, 0.0))
 
 
 class StepEval(NamedTuple):
@@ -421,7 +504,7 @@ def score_pod(
         tvalid = terms_g >= 0
         tsafe = jnp.clip(terms_g, 0)
         if cnt_sub is None:
-            cnt_sub = jnp.where(tvalid[:, None], state.cnt_match[tsafe], 0.0)
+            cnt_sub = take_rows(state.cnt_match, terms_g)
     fr = state.free if free is None else free
     w_ = statics.score_w
     score = w_[0] * least_allocated(fr, statics.alloc, req)
@@ -434,14 +517,14 @@ def score_pod(
     if f.taint_pref:
         score += w_[5] * taint_toleration_score(statics.taint_intol[g], m_all)
     if (f.interpod_pref or f.interpod_req) and t_cap:
-        ip_g = statics.ip_of[tsafe]  # [Tc] rows in the compacted own planes
-        ip_ok = (tvalid & (ip_g >= 0))[:, None]
-        ipsafe = jnp.clip(ip_g, 0)
+        # [Tc] rows in the compacted own planes; -1 (non-interpod/pad)
+        # gathers as zeros through the one-hot matmul
+        ip_eff = jnp.where(tvalid, statics.ip_of[tsafe], -1)
         raw_ipa = interpod_score(
             cnt_sub,
-            jnp.where(ip_ok, state.cnt_own_aff[ipsafe], 0.0),
-            jnp.where(ip_ok, state.w_own_aff_pref[ipsafe], 0.0),
-            jnp.where(ip_ok, state.w_own_anti_pref[ipsafe], 0.0),
+            take_rows(state.cnt_own_aff, ip_eff),
+            take_rows(state.w_own_aff_pref, ip_eff),
+            take_rows(state.w_own_anti_pref, ip_eff),
             statics.s_match[g],
             statics.w_aff_pref[g],
             statics.w_anti_pref[g],
@@ -484,14 +567,17 @@ def filter_and_score(
     f = flags
 
     # row-gather the group's relevant slice of the per-node count state and
-    # domain map ([Tc, N] each — contiguous-row gathers, cheap on TPU)
+    # domain map ([Tc, N] each) via one-hot matmuls (take_rows): -1 padding
+    # rows gather as zeros, and tvalid gates the domain validity
     if t_cap:
         terms_g = statics.g_terms[g]  # [Tc]
         tvalid = terms_g >= 0
         tsafe = jnp.clip(terms_g, 0)
-        dom_sub = statics.node_dom[statics.term_topo[tsafe]]
+        dom_sub = take_rows_i32(
+            statics.node_dom, jnp.where(tvalid, statics.term_topo[tsafe], -1)
+        )
         valid_sub = (dom_sub >= 0) & tvalid[:, None]
-        cnt_sub = jnp.where(tvalid[:, None], state.cnt_match[tsafe], 0.0)
+        cnt_sub = take_rows(state.cnt_match, terms_g)
 
     static_m = statics.static_mask[g]
     # pin: -1 = unpinned, -2 = pinned to a nonexistent node (matches nothing)
@@ -569,11 +655,10 @@ def filter_and_score(
 
     m_all = m_spread
     if f.interpod_req and t_cap:
-        ip_g = statics.ip_of[tsafe]
-        ip_ok = (tvalid & (ip_g >= 0))[:, None]
+        ip_eff = jnp.where(tvalid, statics.ip_of[tsafe], -1)
         m_all = m_spread & interpod_filter(
             cnt_sub,
-            jnp.where(ip_ok, state.cnt_own_anti[jnp.clip(ip_g, 0)], 0.0),
+            take_rows(state.cnt_own_anti, ip_eff),
             valid_sub,
             jnp.where(tvalid, state.cnt_total[tsafe], 0.0),
             statics.s_match[g],
@@ -685,11 +770,13 @@ def schedule_step(
     if t_cap:
         # same-domain increment on the group's relevant term rows only:
         # every node sharing the chosen node's domain for term t gains the
-        # pod's incidence — a [Tc, N] compare + row scatter (see SchedState)
+        # pod's incidence — a [Tc, N] compare + matmul row add (add_rows)
         terms_g = statics.g_terms[g]
         tvalid = terms_g >= 0
         tsafe = jnp.clip(terms_g, 0)
-        dom_sub = statics.node_dom[statics.term_topo[tsafe]]  # [Tc, N]
+        dom_sub = take_rows_i32(
+            statics.node_dom, jnp.where(tvalid, statics.term_topo[tsafe], -1)
+        )
         valid_sub = (dom_sub >= 0) & tvalid[:, None]
         dom_chosen = dom_sub[:, safe]  # [Tc]
         valid_chosen = (dom_chosen >= 0) & tvalid & placed  # [Tc]
@@ -701,21 +788,19 @@ def schedule_step(
         inc = jnp.where(same, 1.0, 0.0)  # [Tc, N]
 
         def bump(arr, vals):
-            return arr.at[tsafe].add(vals[:, None] * inc)
+            return add_rows(arr, terms_g, vals[:, None] * inc)
 
         updates["cnt_match"] = bump(state.cnt_match, statics.s_match[g])
         updates["cnt_total"] = state.cnt_total.at[tsafe].add(
             statics.s_match[g] * jnp.where(valid_chosen, 1.0, 0.0)
         )
         if f.interpod_req or f.interpod_pref:
-            # the own planes live on the compacted interpod axis; vals are 0
-            # for non-interpod terms, so clipped row-0 scatters add nothing
-            ip_g = statics.ip_of[tsafe]
-            ipsafe = jnp.clip(ip_g, 0)
-            ip_w = jnp.where(ip_g >= 0, 1.0, 0.0)
+            # the own planes live on the compacted interpod axis; -1 rows
+            # (non-interpod terms) are inert through the one-hot matmul
+            ip_eff = jnp.where(tvalid, statics.ip_of[tsafe], -1)
 
             def bump_ip(arr, vals):
-                return arr.at[ipsafe].add((vals * ip_w)[:, None] * inc)
+                return add_rows(arr, ip_eff, vals[:, None] * inc)
 
         if f.interpod_req:
             updates["cnt_own_anti"] = bump_ip(state.cnt_own_anti, statics.a_anti_req[g])
@@ -770,25 +855,25 @@ def _delta_step(statics: StaticArrays, state: SchedState, entry):
         terms_g = statics.g_terms[g]
         tvalid = terms_g >= 0
         tsafe = jnp.clip(terms_g, 0)
-        dom_sub = statics.node_dom[statics.term_topo[tsafe]]
+        dom_sub = take_rows_i32(
+            statics.node_dom, jnp.where(tvalid, statics.term_topo[tsafe], -1)
+        )
         valid_sub = (dom_sub >= 0) & tvalid[:, None]
         dom_chosen = dom_sub[:, safe]
         valid_chosen = (dom_chosen >= 0) & tvalid
         same = valid_sub & (dom_sub == dom_chosen[:, None]) & valid_chosen[:, None]
         inc = jnp.where(same, w, 0.0)
 
-        updates["cnt_match"] = state.cnt_match.at[tsafe].add(
-            statics.s_match[g][:, None] * inc
+        updates["cnt_match"] = add_rows(
+            state.cnt_match, terms_g, statics.s_match[g][:, None] * inc
         )
         updates["cnt_total"] = state.cnt_total.at[tsafe].add(
             statics.s_match[g] * jnp.where(valid_chosen, w, 0.0)
         )
-        ip_g = statics.ip_of[tsafe]
-        ipsafe = jnp.clip(ip_g, 0)
-        ip_w = jnp.where(ip_g >= 0, 1.0, 0.0)
+        ip_eff = jnp.where(tvalid, statics.ip_of[tsafe], -1)
 
         def bump_ip(arr, vals):
-            return arr.at[ipsafe].add((vals * ip_w)[:, None] * inc)
+            return add_rows(arr, ip_eff, vals[:, None] * inc)
 
         updates["cnt_own_anti"] = bump_ip(
             state.cnt_own_anti, statics.a_anti_req[g].astype(jnp.float32)

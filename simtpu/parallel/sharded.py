@@ -53,6 +53,7 @@ def pad_statics(statics: StaticArrays, multiple: int) -> Tuple[StaticArrays, int
             static_score=_pad_axis(statics.static_score, 1, pad, 0.0),
             avoid_pen=_pad_axis(statics.avoid_pen, 1, pad, 0.0),
             node_dom=_pad_axis(statics.node_dom, 1, pad, -1),
+            node_dom_small=_pad_axis(statics.node_dom_small, 1, pad, -1),
             has_storage=_pad_axis(statics.has_storage, 0, pad, False),
             vg_cap=_pad_axis(statics.vg_cap, 0, pad, 0.0),
             vg_name_id=_pad_axis(statics.vg_name_id, 0, pad, -1),
@@ -101,6 +102,8 @@ def statics_sharding(mesh: Mesh) -> StaticArrays:
         static_score=trail,
         avoid_pen=trail,
         node_dom=trail,
+        key_kind=rep,
+        node_dom_small=trail,
         term_topo=rep,
         ip_of=rep,
         g_terms=rep,

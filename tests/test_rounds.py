@@ -213,3 +213,103 @@ def test_chunked_rows_equivalent_to_whole_plane(monkeypatch):
 
     assert placements(whole) == placements(chunked)
     assert len(whole.unscheduled_pods) == len(chunked.unscheduled_pods)
+
+
+class TestBatchedLeftoverProbes:
+    """Control-flow of the batched leftover probe machinery: one scan probes
+    every exhausted run; a mid-batch placement truncates the batch, reverts
+    any later placements through the eviction delta, and re-probes them."""
+
+    def _engine(self):
+        from simtpu.engine.rounds import RoundsEngine
+
+        eng = RoundsEngine.__new__(RoundsEngine)
+        return eng
+
+    def test_mid_batch_placement_reverts_and_reprobes(self, monkeypatch):
+        import numpy as np
+
+        import simtpu.engine.rounds as rounds_mod
+        from simtpu.engine import scan as scan_mod
+
+        eng = self._engine()
+        p = 8
+        r, v, sd, gd = 2, 1, 1, 1
+        pods = (
+            np.arange(p, dtype=np.int32),          # group
+            np.ones((p, r), np.float32),           # req
+            np.full(p, -1, np.int32),              # pin
+            np.zeros(p, bool),                     # forced
+            np.zeros((p, v), np.float32),          # lvm_size
+            np.full((p, v), -1, np.int32),         # lvm_vg
+            np.zeros((p, sd), np.float32),         # dev_size
+            np.zeros((p, sd), np.int32),           # dev_media
+            np.full(p, 2.0, np.float32),           # gpu_mem
+            np.ones(p, np.int32),                  # gpu_count
+            np.zeros((p, gd), np.float32),         # gpu_preset
+        )
+        leftovers = [(0, 3), (3, 5), (5, 8)]
+        batches = []
+        # batch 1: run0 fails, run1 places, run2 ALSO places (must revert);
+        # batch 2 (re-probe of run2): fails
+        script = [
+            (np.array([-1, 4, 6]), np.array([2, 0, 0])),
+            (np.array([-1]), np.array([5])),
+        ]
+
+        def fake_segment_idx(statics, state, pods_, idx, flags):
+            nodes_s, reasons_s = script[len(batches)]
+            batches.append(list(idx))
+            k = len(idx)
+            return state, (
+                nodes_s,
+                reasons_s,
+                np.zeros((k, v), np.float32),
+                np.zeros((k, sd), bool),
+                np.full((k, gd), 1.0, np.float32),
+            )
+
+        walked = []
+
+        def fake_segment(statics, state, pods_, a, b, flags):
+            walked.append((a, b))
+            k = b - a
+            return state, (
+                np.full(k, 7, np.int32),
+                np.zeros(k, np.int32),
+                np.zeros((k, v), np.float32),
+                np.zeros((k, sd), bool),
+                np.zeros((k, gd), np.float32),
+            )
+
+        deltas = []
+
+        def fake_delta(statics, state, entries):
+            deltas.append(entries)
+            return state
+
+        monkeypatch.setattr(eng, "_run_scan_segment_idx", fake_segment_idx)
+        monkeypatch.setattr(eng, "_run_scan_segment", fake_segment)
+        monkeypatch.setattr(scan_mod, "_apply_log_delta", fake_delta)
+
+        nodes = np.full(p, -9, np.int32)
+        reasons = np.zeros(p, np.int32)
+        lvm = np.zeros((p, v), np.float32)
+        dev = np.zeros((p, sd), bool)
+        gpu = np.zeros((p, gd), np.float32)
+        eng._probe_leftovers(
+            None, "state", pods, leftovers, None, nodes, reasons, lvm, dev, gpu
+        )
+        # run0 stamped failed with its probe reason
+        assert list(nodes[0:3]) == [-1, -1, -1] and list(reasons[0:3]) == [2, 2, 2]
+        # run1's probe placed on node 4; remainder walked serially to node 7
+        assert nodes[3] == 4 and list(nodes[4:5]) == [7]
+        assert walked == [(4, 5)]
+        # run2's premature placement was reverted (one delta with w=-1 and
+        # the gpu row scaled by gpu_mem), then re-probed and stamped failed
+        assert len(deltas) == 1
+        g_a, n_a, w_a, req_a, vg_a, sd_a, gp_a = deltas[0]
+        assert w_a[0] == -1.0 and n_a[0] == 6 and g_a[0] == 5
+        assert gp_a[0, 0] == 2.0  # shares(1.0) * gpu_mem(2.0)
+        assert batches == [[0, 3, 5], [5]]
+        assert list(nodes[5:8]) == [-1, -1, -1] and list(reasons[5:8]) == [5, 5, 5]
