@@ -271,6 +271,10 @@ def time_plan():
 
 
 def main() -> int:
+    from simtpu.cache import enable_compilation_cache
+
+    cache_dir = enable_compilation_cache()
+    note(f"compilation cache: {cache_dir or 'disabled'}")
     n_nodes = int(os.environ.get("SIMTPU_BENCH_NODES", 100_000))
     n_pods = int(os.environ.get("SIMTPU_BENCH_PODS", 1_000_000))
     # informational serial-rate slice; 2k pods keeps it under ~15 s at the

@@ -180,6 +180,9 @@ def cmd_gen_doc(args: argparse.Namespace) -> int:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    from .cache import enable_compilation_cache
+
+    enable_compilation_cache()  # one-shot CLI runs are compile-dominated
     _setup_logging()
     parser = build_parser()
     args = parser.parse_args(argv)

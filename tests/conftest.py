@@ -18,6 +18,12 @@ import jax  # noqa: E402
 # tests must run on the 8-device virtual CPU topology regardless
 jax.config.update("jax_platforms", "cpu")
 
+from simtpu.cache import enable_compilation_cache  # noqa: E402
+
+# reuse compiled engine bodies across test runs (the suite is
+# compile-dominated; a warm cache roughly halves its wall-clock)
+enable_compilation_cache()
+
 import pytest  # noqa: E402
 
 REFERENCE_EXAMPLES = "/root/reference/example"
