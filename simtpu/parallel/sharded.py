@@ -216,7 +216,13 @@ class ShardedEngine(_MeshMixin, Engine):
 
 
 def build_sharded_rounds(
-    mesh: Mesh, n_domains: int, k_cap: int, flags: StepFlags, quota: bool = False
+    mesh: Mesh,
+    n_domains: int,
+    k_cap: int,
+    flags: StepFlags,
+    quota: bool = False,
+    self_aff: bool = False,
+    ext_mats: bool = False,
 ):
     """Compile the bulk multi-round scan with the node axis over `mesh`."""
     from ..engine.rounds import rounds_scan
@@ -227,7 +233,8 @@ def build_sharded_rounds(
 
     def fn(statics, state, seg_pods, ks):
         return rounds_scan(
-            statics, state, seg_pods, ks, n_domains, k_cap, flags, quota
+            statics, state, seg_pods, ks, n_domains, k_cap, flags, quota,
+            self_aff, ext_mats,
         )
 
     return jax.jit(
@@ -260,12 +267,13 @@ class ShardedRoundsEngine(_MeshMixin, RoundsEngine):
         return self._sharded_scan_for(flags)(statics, state, seg)
 
     def _bulk_call(
-        self, statics, state, seg_pods, ks, n_domains, k_cap, flags, quota=False
+        self, statics, state, seg_pods, ks, n_domains, k_cap, flags,
+        quota=False, self_aff=False, ext_mats=False,
     ):
-        key = (n_domains, k_cap, flags, quota)
+        key = (n_domains, k_cap, flags, quota, self_aff, ext_mats)
         fn = self._bulk_jits.get(key)
         if fn is None:
             fn = self._bulk_jits[key] = build_sharded_rounds(
-                self.mesh, n_domains, k_cap, flags, quota
+                self.mesh, n_domains, k_cap, flags, quota, self_aff, ext_mats
             )
         return fn(statics, state, seg_pods, ks)

@@ -78,10 +78,13 @@ def make_deployment(
     tolerations=None,
     anti_affinity_topo: str = None,
     anti_affinity_required: bool = False,  # required vs preferred anti-affinity
+    affinity_topo: str = None,  # required SELF-affinity (colocate-with-self)
     spread_topo: str = None,  # topologySpreadConstraints topology key
     spread_hard: bool = False,  # DoNotSchedule vs ScheduleAnyway
     gpu_mem_mib: int = 0,
-    lvm_gib: int = 0,
+    gpu_count: int = 1,  # GPU shares per pod (multi-GPU when > 1)
+    gpu_index: str = None,  # preset gpu-index annotation, e.g. "0-1"
+    lvm_gib=0,  # int (one claim) or tuple of ints (multi-claim)
     device_gib: int = 0,  # exclusive-SSD claim size
 ) -> dict:
     labels = {"app": name}
@@ -111,6 +114,16 @@ def make_deployment(
                 ]
             }
         spec["affinity"] = {"podAntiAffinity": anti}
+    if affinity_topo:
+        # required colocate-with-self: every replica must share a domain
+        # with a pod matching the workload's own labels
+        aff_term = {
+            "labelSelector": {"matchLabels": labels},
+            "topologyKey": affinity_topo,
+        }
+        spec.setdefault("affinity", {})["podAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": [aff_term]
+        }
     if spread_topo:
         spec["topologySpreadConstraints"] = [
             {
@@ -129,13 +142,17 @@ def make_deployment(
     annotations = {}
     if gpu_mem_mib:
         annotations["alibabacloud.com/gpu-mem"] = f"{gpu_mem_mib}Mi"
-        annotations["alibabacloud.com/gpu-count"] = "1"
+        annotations["alibabacloud.com/gpu-count"] = str(gpu_count)
+        if gpu_index:
+            annotations["alibabacloud.com/gpu-index"] = gpu_index
     volumes = []
-    if lvm_gib:
-        # unnamed-VG LVM volume → binpack across node VGs (common.go:59-107)
-        volumes.append(
-            {"kind": "LVM", "scName": "open-local-lvm", "size": lvm_gib * (1 << 30)}
-        )
+    for gib in (lvm_gib,) if isinstance(lvm_gib, int) else tuple(lvm_gib):
+        if gib:
+            # unnamed-VG LVM volumes → binpack across node VGs
+            # (common.go:59-107); a tuple makes a multi-claim pod
+            volumes.append(
+                {"kind": "LVM", "scName": "open-local-lvm", "size": gib * (1 << 30)}
+            )
     if device_gib:
         # exclusive-device claim (media resolved via the SC catalog)
         volumes.append(
@@ -235,9 +252,12 @@ def synth_apps(
     spread_frac: float = 0.0,
     spread_hard_frac: float = 0.0,  # fraction OF spread workloads DoNotSchedule
     gpu_frac: float = 0.0,
+    gpu_multi_frac: float = 0.0,  # fraction OF gpu workloads with count 2-4
     storage_frac: float = 0.0,
     storage_device_frac: float = 0.3,  # fraction OF storage workloads claiming
     # an exclusive device (the rest binpack LVM)
+    lvm_multi_frac: float = 0.0,  # fraction OF LVM workloads with 2-3 claims
+    affinity_frac: float = 0.0,  # required colocate-with-self workloads
 ) -> List[AppResource]:
     """App list totalling ~n_pods pods across deployments with mixed
     constraints (the `complicate` example writ large)."""
@@ -252,11 +272,21 @@ def synth_apps(
         roll = rng.random()
         if roll < gpu_frac:
             kw["gpu_mem_mib"] = int(rng.choice([4096, 8192, 16384]))
+            # draw only when enabled so pre-existing seeds' streams (and the
+            # fuzz scenarios pinned to them) are unchanged
+            if gpu_multi_frac and rng.random() < gpu_multi_frac:
+                kw["gpu_count"] = int(rng.integers(2, 5))
+                kw["gpu_mem_mib"] = 4096
         elif roll < gpu_frac + storage_frac:
             if rng.random() < storage_device_frac:
                 kw["device_gib"] = int(rng.integers(50, 200))
             else:
                 kw["lvm_gib"] = int(rng.integers(5, 40))
+                if lvm_multi_frac and rng.random() < lvm_multi_frac:
+                    kw["lvm_gib"] = tuple(
+                        int(rng.integers(5, 30))
+                        for _ in range(int(rng.integers(2, 4)))
+                    )
         if rng.random() < selector_frac:
             kw["node_selector"] = {
                 "topology.kubernetes.io/zone": f"zone-{int(rng.integers(zones))}"
@@ -271,6 +301,8 @@ def synth_apps(
             # fuzz scenarios pinned to them) are unchanged
             if anti_affinity_hard_frac and rng.random() < anti_affinity_hard_frac:
                 kw["anti_affinity_required"] = True
+        if affinity_frac and rng.random() < affinity_frac:
+            kw["affinity_topo"] = "topology.kubernetes.io/zone"
         # draw only when enabled so pre-existing seeds' random streams (and
         # the scenarios fuzz tests pinned to them) are unchanged
         if spread_frac and rng.random() < spread_frac:

@@ -18,11 +18,10 @@ import jax  # noqa: E402
 # tests must run on the 8-device virtual CPU topology regardless
 jax.config.update("jax_platforms", "cpu")
 
-from simtpu.cache import enable_compilation_cache  # noqa: E402
-
-# reuse compiled engine bodies across test runs (the suite is
-# compile-dominated; a warm cache roughly halves its wall-clock)
-enable_compilation_cache()
+# NOTE: the persistent compilation cache is deliberately NOT enabled here:
+# tests run on the CPU backend, whose cached-executable loader can segfault
+# on this host (see simtpu/cache.py) — enable_compilation_cache() itself
+# refuses CPU backends for the same reason.
 
 import pytest  # noqa: E402
 

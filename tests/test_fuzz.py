@@ -97,7 +97,8 @@ def test_scan_vs_bulk_equivalence_extended_resources(seed):
 
     class SpyEngine(RoundsEngine):
         def _bulk_call(
-            self, statics, state, seg_pods, ks, n_domains, k_cap, flags, quota=False
+            self, statics, state, seg_pods, ks, n_domains, k_cap, flags,
+            quota=False, self_aff=False, ext_mats=False,
         ):
             lvm = np.asarray(seg_pods[4]).max(axis=1) > 0
             dev = np.asarray(seg_pods[6]).max(axis=1) > 0
@@ -105,7 +106,8 @@ def test_scan_vs_bulk_equivalence_extended_resources(seed):
             ks_h = np.asarray(ks)
             bulk_ext_pods.append(int(ks_h[lvm | dev | gpu].sum()))
             return super()._bulk_call(
-                statics, state, seg_pods, ks, n_domains, k_cap, flags, quota
+                statics, state, seg_pods, ks, n_domains, k_cap, flags,
+                quota, self_aff, ext_mats,
             )
 
     seed_name_hashes(seed)
@@ -233,12 +235,14 @@ def test_scan_vs_bulk_hard_constraints(seed):
 
     class SpyEngine(RoundsEngine):
         def _bulk_call(
-            self, statics, state, seg_pods, ks, n_domains, k_cap, flags, quota=False
+            self, statics, state, seg_pods, ks, n_domains, k_cap, flags,
+            quota=False, self_aff=False, ext_mats=False,
         ):
             if quota:
                 quota_pods.append(int(np.asarray(ks).sum()))
             return super()._bulk_call(
-                statics, state, seg_pods, ks, n_domains, k_cap, flags, quota
+                statics, state, seg_pods, ks, n_domains, k_cap, flags,
+                quota, self_aff, ext_mats,
             )
 
     seed_name_hashes(seed)
@@ -254,6 +258,255 @@ def test_scan_vs_bulk_hard_constraints(seed):
         _assert_no_overcommit(res)
         _assert_spread_satisfied(res)
         _assert_anti_satisfied(res)
+
+
+@pytest.mark.parametrize("seed", [13, 29, 47, 88, 131])
+def test_scan_vs_bulk_matrix_extended(seed):
+    """VERDICT r3 task 1: multi-GPU (gpu_count > 1) and multi-claim LVM runs
+    must ride the MATRIX bulk rounds (ext_mats), not the serial fallback,
+    agree with the serial scan within the documented band, and never
+    overcommit a GPU device or VG. The multi-GPU intake/split is exact
+    (consecutive pods take consecutive share-pool prefixes, mirroring the
+    two-pointer greedy gpunodeinfo.go:271-288); multi-claim LVM reuses the
+    round-start binpack plan, whose fragmentation drift the band covers.
+
+    The band here is 5% (vs 1% for the plain ext fuzz): at a 70% multi
+    fraction, runs that choose different (score-tied) nodes fragment the
+    share pools and VG frees differently for every later run — by the 6th
+    heavy run the pools can differ by several whole pods (observed: serial
+    strands a late count=3 run at 0 where the round's state fits 5 — the
+    round was STRICTLY better there). Single-run
+    totals are pinned EXACT by test_multi_gpu_single_run_exact — the drift
+    is purely cross-run state divergence, the same class the reference's
+    random tie-break exhibits reference-vs-reference. The cluster is
+    uniformly GPU+storage equipped so extended capacity is not hostage to
+    WHERE the mix's plain CPU runs happen to land (serial packs them onto
+    big nodes, the round spreads them — both legal, wildly different GPU
+    starvation downstream)."""
+    from simtpu.engine.rounds import RoundsEngine
+
+    rng = np.random.default_rng(seed)
+    n_nodes = int(rng.integers(12, 36))
+    n_pods = int(rng.integers(80, 220))
+    cluster = synth_cluster(
+        n_nodes, seed=seed, zones=3, taint_frac=0.1, gpu_frac=1.0, storage_frac=1.0
+    )
+    apps = synth_apps(
+        n_pods,
+        seed=seed + 1,
+        zones=3,
+        pods_per_deployment=int(rng.integers(12, 40)),
+        selector_frac=0.1,
+        anti_affinity_frac=0.1,
+        gpu_frac=0.35,
+        gpu_multi_frac=0.7,
+        storage_frac=0.35,
+        storage_device_frac=0.0,
+        lvm_multi_frac=0.7,
+    )
+    mats_pods = []
+
+    class SpyEngine(RoundsEngine):
+        def _bulk_call(
+            self, statics, state, seg_pods, ks, n_domains, k_cap, flags,
+            quota=False, self_aff=False, ext_mats=False,
+        ):
+            if ext_mats:
+                mats_pods.append(int(np.asarray(ks).sum()))
+            return super()._bulk_call(
+                statics, state, seg_pods, ks, n_domains, k_cap, flags,
+                quota, self_aff, ext_mats,
+            )
+
+    seed_name_hashes(seed)
+    serial = simulate(cluster, apps)
+    seed_name_hashes(seed)
+    bulk = simulate(cluster, apps, engine_factory=SpyEngine)
+    assert sum(mats_pods) > 0, "no multi-GPU/multi-claim run engaged the matrix path"
+    placed_serial = sum(len(s.pods) for s in serial.node_status)
+    placed_bulk = sum(len(s.pods) for s in bulk.node_status)
+    tol = max(1, (placed_serial * 5) // 100)
+    assert abs(placed_serial - placed_bulk) <= tol, (placed_serial, placed_bulk)
+    for res in (serial, bulk):
+        _assert_no_overcommit(res)
+        _assert_no_storage_gpu_overcommit(res)
+
+
+@pytest.mark.parametrize("count", [2, 3, 4])
+def test_multi_gpu_single_run_exact(count):
+    """A single multi-GPU run from a common state places EXACTLY the serial
+    count: per-node intake floor(pool/count) with prefix share consumption
+    reproduces the two-pointer greedy's totals bit-for-bit (the cross-run
+    fuzz band exists only because node-choice divergence fragments state
+    for LATER runs)."""
+    from simtpu.synth import make_deployment, make_node
+    from simtpu.core.objects import AppResource, ResourceTypes
+
+    for seed in (1, 2, 3):
+        rng = np.random.default_rng(seed)
+        nodes = []
+        for i in range(12):
+            gd = int(rng.integers(1, 6))
+            mem = int(rng.choice([8192, 16384, 24576]))
+            nodes.append(
+                make_node(
+                    f"n-{i:02d}", 64000, 256,
+                    {"kubernetes.io/hostname": f"n-{i:02d}"}, gpu=(gd, mem),
+                )
+            )
+        cluster = ResourceTypes()
+        cluster.nodes = nodes
+        res = ResourceTypes()
+        res.deployments.append(
+            make_deployment("mg", 40, 250, 256, gpu_mem_mib=4096, gpu_count=count)
+        )
+        apps = [AppResource(name="a", resource=res)]
+        seed_name_hashes(0)
+        s = simulate(cluster, apps)
+        seed_name_hashes(0)
+        b = simulate(cluster, apps, bulk=True)
+        ps = sum(len(st.pods) for st in s.node_status)
+        pb = sum(len(st.pods) for st in b.node_status)
+        assert ps == pb, (count, seed, ps, pb)
+        _assert_no_storage_gpu_overcommit(b)
+
+
+def _assert_colocated(result):
+    """Every workload with a required self-affinity term keeps all its placed
+    pods in domains holding a matching pod; with no pre-existing matchers
+    (synth gives each deployment unique labels) that means ONE domain."""
+    from collections import defaultdict
+
+    doms = defaultdict(set)
+    for st in result.node_status:
+        labels = (st.node["metadata"].get("labels")) or {}
+        for pod in st.pods:
+            aff = ((pod["spec"].get("affinity")) or {}).get("podAffinity") or {}
+            for term in aff.get("requiredDuringSchedulingIgnoredDuringExecution") or []:
+                ml = ((term.get("labelSelector")) or {}).get("matchLabels") or {}
+                plabels = (pod["metadata"].get("labels")) or {}
+                if not ml or not all(plabels.get(k) == str(v) for k, v in ml.items()):
+                    continue
+                key = term.get("topologyKey", "")
+                dom = labels.get(key)
+                assert dom is not None, "self-affinity pod on a key-less node"
+                doms[(tuple(sorted(ml.items())), key)].add(dom)
+    for ident, ds in doms.items():
+        assert len(ds) == 1, (ident, sorted(ds))
+
+
+@pytest.mark.parametrize("seed", [17, 41, 73, 109])
+def test_scan_vs_bulk_self_affinity(seed):
+    """VERDICT r3 task 1: required colocate-with-self runs must ride the
+    bulk path (self_aff rounds), stay within the equivalence band, and the
+    final placement must keep each such workload inside one domain."""
+    from simtpu.engine.rounds import RoundsEngine
+
+    rng = np.random.default_rng(seed)
+    n_nodes = int(rng.integers(10, 36))
+    n_pods = int(rng.integers(60, 200))
+    cluster = synth_cluster(
+        n_nodes, seed=seed, zones=int(rng.integers(2, 5)), taint_frac=0.1
+    )
+    apps = synth_apps(
+        n_pods,
+        seed=seed + 1,
+        zones=3,
+        pods_per_deployment=int(rng.integers(10, 40)),
+        selector_frac=0.15,
+        anti_affinity_frac=0.1,
+        affinity_frac=0.6,
+    )
+    aff_pods = []
+
+    class SpyEngine(RoundsEngine):
+        def _bulk_call(
+            self, statics, state, seg_pods, ks, n_domains, k_cap, flags,
+            quota=False, self_aff=False, ext_mats=False,
+        ):
+            if self_aff:
+                aff_pods.append(int(np.asarray(ks).sum()))
+            return super()._bulk_call(
+                statics, state, seg_pods, ks, n_domains, k_cap, flags,
+                quota, self_aff, ext_mats,
+            )
+
+    seed_name_hashes(seed)
+    serial = simulate(cluster, apps)
+    seed_name_hashes(seed)
+    bulk = simulate(cluster, apps, engine_factory=SpyEngine)
+    assert sum(aff_pods) > 0, "no self-affinity run engaged the bulk path"
+    placed_serial = sum(len(s.pods) for s in serial.node_status)
+    placed_bulk = sum(len(s.pods) for s in bulk.node_status)
+    tol = max(1, placed_serial // 100)
+    assert abs(placed_serial - placed_bulk) <= tol, (placed_serial, placed_bulk)
+    for res in (serial, bulk):
+        _assert_no_overcommit(res)
+        _assert_colocated(res)
+
+
+def test_scan_vs_bulk_preset_gpu_index():
+    """Preset gpu-index runs ride the matrix bulk path with the annotation
+    honored verbatim (AllocateGpuId short-circuit, gpunodeinfo.go:247-253):
+    serial and bulk must agree exactly on placed counts and on every pod's
+    device assignment."""
+    from simtpu.engine.rounds import RoundsEngine
+    from simtpu.synth import make_deployment, make_node
+    from simtpu.core.objects import AppResource, ResourceTypes
+
+    nodes = [
+        make_node(
+            f"gpu-{i:03d}", 64000, 256,
+            {"kubernetes.io/hostname": f"gpu-{i:03d}"},
+            gpu=(4, 16384),
+        )
+        for i in range(6)
+    ]
+    cluster = ResourceTypes()
+    cluster.nodes = nodes
+    res = ResourceTypes()
+    res.deployments.append(
+        make_deployment(
+            "preset", 24, 500, 512, gpu_mem_mib=4096, gpu_count=2,
+            gpu_index="0-1",
+        )
+    )
+    apps = [AppResource(name="preset-app", resource=res)]
+    mats_pods = []
+
+    class SpyEngine(RoundsEngine):
+        def _bulk_call(
+            self, statics, state, seg_pods, ks, n_domains, k_cap, flags,
+            quota=False, self_aff=False, ext_mats=False,
+        ):
+            if ext_mats:
+                mats_pods.append(int(np.asarray(ks).sum()))
+            return super()._bulk_call(
+                statics, state, seg_pods, ks, n_domains, k_cap, flags,
+                quota, self_aff, ext_mats,
+            )
+
+    def gpu_indices(result):
+        out = {}
+        for st in result.node_status:
+            for pod in st.pods:
+                anno = (pod["metadata"].get("annotations")) or {}
+                out[pod["metadata"]["name"]] = (
+                    st.node["metadata"]["name"],
+                    anno.get("alibabacloud.com/gpu-index"),
+                )
+        return out
+
+    seed_name_hashes(1)
+    serial = simulate(cluster, apps)
+    seed_name_hashes(1)
+    bulk = simulate(cluster, apps, engine_factory=SpyEngine)
+    assert sum(mats_pods) > 0, "preset run did not engage the matrix path"
+    assert len(serial.unscheduled_pods) == len(bulk.unscheduled_pods)
+    si, bi = gpu_indices(serial), gpu_indices(bulk)
+    assert set(si) == set(bi)
+    for name in si:
+        assert si[name][1] == bi[name][1] == "0-1", (name, si[name], bi[name])
 
 
 @pytest.mark.parametrize("seed", [101, 202, 303, 404])
@@ -284,6 +537,76 @@ def test_scan_vs_bulk_equivalence(seed):
     assert len(serial.unscheduled_pods) == len(bulk.unscheduled_pods)
     _assert_no_overcommit(serial)
     _assert_no_overcommit(bulk)
+
+
+def test_scan_vs_bulk_hard_mix_agreement():
+    """Mid-scale pin of the bench's HARD mix (VERDICT r3 task 6): under the
+    exact hard-point constraint fractions (DoNotSchedule spread + required
+    anti-affinity riding the domain-quota rounds), serial and bulk agree on
+    placed counts within the documented band and the final placement
+    satisfies every hard constraint."""
+    cluster = synth_cluster(400, seed=3, zones=16, taint_frac=0.1, storage_frac=0.3)
+    apps = synth_apps(
+        2000,
+        seed=4,
+        zones=16,
+        pods_per_deployment=100,
+        selector_frac=0.2,
+        toleration_frac=0.1,
+        anti_affinity_frac=0.2,
+        anti_affinity_hard_frac=0.34,
+        spread_frac=0.3,
+        spread_hard_frac=0.5,
+        storage_frac=0.2,
+    )
+    seed_name_hashes(42)
+    serial = simulate(cluster, apps)
+    seed_name_hashes(42)
+    bulk = simulate(cluster, apps, bulk=True)
+    ps = sum(len(s.pods) for s in serial.node_status)
+    pb = sum(len(s.pods) for s in bulk.node_status)
+    tol = max(1, ps // 100)
+    assert abs(ps - pb) <= tol, (ps, pb)
+    _assert_no_overcommit(bulk)
+    _assert_spread_satisfied(bulk)
+    _assert_anti_satisfied(bulk)
+
+
+def test_scan_vs_bulk_matrix_mix_agreement():
+    """Mid-scale pin of the bench's MATRIX mix (round-4): the multi-GPU /
+    multi-claim-LVM / self-affinity fractions the matrix-point times, at
+    400 nodes x 2000 pods, within the heavy-mix band and with every
+    colocation constraint satisfied."""
+    cluster = synth_cluster(
+        400, seed=3, zones=16, taint_frac=0.1, storage_frac=0.3, gpu_frac=0.4
+    )
+    apps = synth_apps(
+        2000,
+        seed=4,
+        zones=16,
+        pods_per_deployment=100,
+        selector_frac=0.2,
+        toleration_frac=0.1,
+        anti_affinity_frac=0.2,
+        spread_frac=0.3,
+        gpu_frac=0.25,
+        gpu_multi_frac=0.6,
+        storage_frac=0.25,
+        storage_device_frac=0.0,
+        lvm_multi_frac=0.6,
+        affinity_frac=0.15,
+    )
+    seed_name_hashes(42)
+    serial = simulate(cluster, apps)
+    seed_name_hashes(42)
+    bulk = simulate(cluster, apps, bulk=True)
+    ps = sum(len(s.pods) for s in serial.node_status)
+    pb = sum(len(s.pods) for s in bulk.node_status)
+    tol = max(1, (ps * 5) // 100)
+    assert abs(ps - pb) <= tol, (ps, pb)
+    _assert_no_overcommit(bulk)
+    _assert_no_storage_gpu_overcommit(bulk)
+    _assert_colocated(bulk)
 
 
 def test_scan_vs_bulk_north_star_mix_agreement():

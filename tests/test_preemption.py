@@ -260,6 +260,63 @@ def test_pdb_with_budget_does_not_penalize_covered_victim():
     assert placed.get("pricey") == "n0"
 
 
+def test_preemption_at_100k_scale():
+    """VERDICT r3 task 2: preemption at the scale round 2 actually asked for
+    — a placement log of 100,000 pods and >= 1,000 forced preemptions.
+    The wave machinery (api.py _preempt_failed_batch) makes this a handful
+    of device dispatches: host-side victim proposals against one shared
+    whole-log model, one batched eviction delta, one batched verify
+    placement (the 1,100 preemptors are one run, so the verify itself is a
+    bulk round). Semantics pinned exactly: every high-priority pod lands,
+    each evicting precisely the two 1-cpu victims its 2-cpu request needs.
+
+    Measured 2026-07-31 (CPU, shared host): 113 s end-to-end including the
+    100k-pod initial bulk placement and jit compiles; docs/status.md keeps
+    the number. The envelope below is deliberately loose for slow CI."""
+    import time
+
+    from simtpu.core.objects import AppResource, ResourceTypes
+    from simtpu.synth import make_deployment, make_node
+
+    n = 6250
+    cluster = ResourceTypes()
+    cluster.nodes = [
+        make_node(
+            f"node-{i:06d}",
+            16000,
+            64,
+            {
+                "topology.kubernetes.io/zone": f"zone-{i % 8}",
+                "kubernetes.io/hostname": f"node-{i:06d}",
+            },
+        )
+        for i in range(n)
+    ]
+    low = make_deployment("low", n * 16, 1000, 512)
+    low["spec"]["template"]["spec"]["priority"] = 10
+    high = make_deployment("high", 1100, 2000, 1024)
+    high["spec"]["template"]["spec"]["priority"] = 1000
+    res_low = ResourceTypes()
+    res_low.deployments = [low]
+    res_high = ResourceTypes()
+    res_high.deployments = [high]
+    apps = [
+        AppResource(name="low", resource=res_low),
+        AppResource(name="high", resource=res_high),
+    ]
+    from simtpu.workloads.expand import seed_name_hashes
+
+    seed_name_hashes(1)
+    t0 = time.perf_counter()
+    out = simulate(cluster, apps, bulk=True)
+    wall = time.perf_counter() - t0
+    placed = sum(len(s.pods) for s in out.node_status)
+    assert len(out.unscheduled_pods) == 0
+    assert len(out.preempted_pods) == 2 * 1100
+    assert placed == n * 16 - 2 * 1100 + 1100
+    assert wall < 420, f"100k-scale preemption too slow: {wall:.1f}s"
+
+
 def test_preemption_at_scale():
     """VERDICT r2 task 5: hundreds of preemptions against a placement log of
     thousands of entries must run in seconds — the victim search is

@@ -194,8 +194,8 @@ def test_chunked_rows_equivalent_to_whole_plane(monkeypatch):
     chunk_counts = []
     orig = Chunked._chunk_runs
 
-    def spy(self, run, batch, tensors):
-        out = list(orig(self, run, batch, tensors))
+    def spy(self, run, batch, tensors, max_segs=None):
+        out = list(orig(self, run, batch, tensors, max_segs))
         chunk_counts.append(len(out))
         return iter(out)
 
