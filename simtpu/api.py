@@ -64,6 +64,37 @@ from .workloads.expand import (
 )
 
 
+def _anti_topo_keys(pod: dict) -> set:
+    """topologyKeys of the pod's REQUIRED anti-affinity terms."""
+    from .core.objects import pod_affinity
+
+    anti = pod_affinity(pod).get("podAntiAffinity") or {}
+    return {
+        t.get("topologyKey")
+        for t in anti.get("requiredDuringSchedulingIgnoredDuringExecution") or []
+        if t.get("topologyKey")
+    }
+
+
+def _restore_topo_keys(pod: dict) -> set:
+    """topologyKeys along which re-adding previously evicted pods can turn
+    this pod's filter verdict from pass to fail on a node the victims do
+    NOT occupy.  Only domain-scoped negative constraints can: required pod
+    anti-affinity and DoNotSchedule topology spread (a restore raises
+    domain counts).  Positive required affinity can't — restores only add
+    satisfiers."""
+    from .core.objects import pod_topology_spread_constraints
+
+    keys = _anti_topo_keys(pod)
+    keys |= {
+        c.get("topologyKey")
+        for c in pod_topology_spread_constraints(pod)
+        if (c.get("whenUnsatisfiable") or "DoNotSchedule") == "DoNotSchedule"
+        and c.get("topologyKey")
+    }
+    return keys
+
+
 def _sort_app_pods(pods: List[dict], nodes: Sequence[dict] = (), use_greed: bool = False) -> List[dict]:
     """Stable emulation of the reference's app-pod ordering: AffinityQueue
     (nodeSelector pods first) then TolerationQueue (tolerations pods first),
@@ -290,11 +321,17 @@ class Simulator:
         3. all preemptors re-run the real filter pipeline as ONE batched
            placement (sequentially exact within the batch, like the serial
            engine's retry order);
-        4. on the first verify failure f: pods before f commit; pod f's
-           evictions are restored and the pod re-proposes FRESH at the
-           front of the next wave (a first-in-wave proposal sees the true
-           log state, so its verify verdict is serial-authoritative — a
-           second failure is final and the pod records its original
+        4. on the first verify failure f: pods before f commit — EXCEPT
+           pods whose verdict may have ridden f's evictions (their node
+           hosts one of f's victims, or a domain-scoped negative
+           constraint — theirs or a victim's — could flip when the victims
+           return; committing them would break a no-overcommit /
+           hard-constraint invariant), which are demoted and re-verified
+           next wave with their evictions kept; pod f's
+           evictions are restored and the pod re-proposes FRESH next wave
+           (a fresh proposal runs against the wave-start model, i.e. the
+           true log state, so its verify verdict is serial-authoritative —
+           a second failure is final and the pod records its original
            reason); later pods' placements are reverted (they saw a state
            missing f's restored victims) and re-verify next wave with
            their evictions kept.
@@ -310,7 +347,19 @@ class Simulator:
             return
         # (pod, reason, saved victim records or None, fresh-retry used)
         pending = [(pod, reason, None, False) for pod, reason in failed]
+        # termination insurance: the retried-finality rule below only
+        # finalizes FRESH-attempt failures, so an adversarial geometry
+        # could in principle ping-pong demotions between already-retried
+        # pods; the serial flow's work is O(failed), so is this cap
+        waves_left = 4 + 2 * len(failed)
         while pending:
+            waves_left -= 1
+            if waves_left < 0:
+                for pod, reason, preev, _ in pending:
+                    if preev:
+                        self._restore_victims(preev)
+                    self._record_failed(pod, reason)
+                return
             model = self._build_preempt_model()
             wave = []  # (pod, reason, new victims, prior records, retried)
             for pod, reason, preev, retried in pending:
@@ -351,7 +400,71 @@ class Simulator:
             fail_pos = np.flatnonzero(~placed_mask)
             f = int(fail_pos[0]) if len(fail_pos) else len(wave)
             ranks = np.cumsum(placed_mask) - 1  # log rank of each placed pod
+            # A pod before f may have verify-landed on a placement that only
+            # passed because of f's (about to be restored) evictions — the
+            # batched placement saw ALL wave evictions, not just the pod's
+            # own.  Committing it while restoring f's victims would silently
+            # violate an invariant the serial evict/retry/undo flow never
+            # can: node resource overcommit (pod sits on a victim's node),
+            # a required anti-affinity or DoNotSchedule-spread verdict that
+            # flips when the victims return (domain-scoped — demote when the
+            # pod's node shares a relevant topology domain with a victim's
+            # node), or a restored victim's own required anti-affinity now
+            # matching the new pod (same domain test, victim's keys).
+            # Demote those pods instead: skip their commit, drop their log
+            # entries, and re-verify them next wave with their own evictions
+            # kept (advisor finding, round 4).
+            # An eviction is PERMANENT only once its proposer commits.  The
+            # victims of f (restored this wave), of after-f pods, and of
+            # demoted pods (carried as preev, restorable in a LATER wave or
+            # the cap-abort path) are all provisional — so the demote scan
+            # runs to a fixpoint: demoting a pod makes its own victims
+            # provisional too.
+            demote: set = set()
+            if f < len(wave):
+
+                def _labels(idx: int) -> dict:
+                    meta = self._nodes[idx].get("metadata") or {}
+                    return meta.get("labels") or {}
+
+                prov_nodes: set = set()
+                prov_victims: list = []
+
+                def _absorb(records):
+                    for entry, vpod, _ in records:
+                        prov_nodes.add(entry[1])
+                        prov_victims.append(
+                            (_labels(entry[1]), _anti_topo_keys(vpod))
+                        )
+
+                for w in range(f, len(wave)):
+                    _absorb(saved_per_pod[w])
+                # hoisted per-pod spec parses / label lookups: the fixpoint
+                # below rescans range(f) once per demotion
+                w_node = [int(nodes[w]) for w in range(f)]
+                w_keys = [_restore_topo_keys(wave[w][0]) for w in range(f)]
+                w_labels = [_labels(n) for n in w_node]
+                changed = True
+                while changed:
+                    changed = False
+                    for w in range(f):
+                        if w in demote:
+                            continue
+                        rides = w_node[w] in prov_nodes
+                        if not rides:
+                            wl = w_labels[w]
+                            rides = any(
+                                k in wl and k in vl and wl[k] == vl[k]
+                                for vl, vkeys in prov_victims
+                                for k in (*w_keys[w], *vkeys)
+                            )
+                        if rides:
+                            demote.add(w)
+                            _absorb(saved_per_pod[w])
+                            changed = True
             for w in range(f):
+                if w in demote:
+                    continue
                 pod = wave[w][0]
                 who = f"{namespace_of(pod)}/{name_of(pod)}"
                 for _, vpod, _ in saved_per_pod[w]:
@@ -365,27 +478,41 @@ class Simulator:
                 self._record_placed(pod, int(nodes[w]), extras["gpu_shares"][w])
             if f == len(wave):
                 return
-            # pods after f placed against a state missing f's restored
-            # victims — revert their log entries; they re-verify next wave
+            # demoted pods and pods after f placed against a state that is
+            # about to change (f's victims return) — revert their log
+            # entries; they re-verify next wave
             revert = [
                 log_base + int(ranks[w])
-                for w in range(f + 1, len(wave))
+                for w in list(demote) + list(range(f + 1, len(wave)))
                 if placed_mask[w]
             ]
             if revert:
                 self._engine.remove_placements(revert)  # permanent, no undo
             self._restore_victims(saved_per_pod[f])
-            pod_f, reason_f, _, _, retried_f = wave[f]
-            if retried_f:
-                # the failed attempt was a front-of-wave FRESH proposal —
-                # the verify verdict is serial-authoritative
+            pod_f, reason_f, _, preev_f, retried_f = wave[f]
+            if retried_f and preev_f is None:
+                # the failed attempt was a FRESH proposal against the true
+                # wave-start log state — the verify verdict is
+                # serial-authoritative.  (A retried pod failing a
+                # preev-carried MID-WAVE re-verify — it was demoted after
+                # its fresh attempt placed — is NOT final: its victims were
+                # just restored, so it re-proposes fresh next wave.)
                 self._record_failed(pod_f, reason_f)
                 head = []
             else:
                 head = [(pod_f, reason_f, None, True)]
+            # the retried head verifies FIRST: a demoted pod verifying ahead
+            # of it could re-grab the head's victim node (wave evictions
+            # apply before every verify), wrongly finalizing the head's
+            # failure; demoted pods re-verify right after, before after-f
+            # pods, keeping their relative serial order.  (Known bounded
+            # divergence: if the head's verdict depends on a demoted pod
+            # BEING placed — a required positive affinity to it — the retry
+            # can finalize a failure the serial order would not; favoring
+            # finality keeps the wave loop's termination bound.)
             pending = head + [
                 (wave[w][0], wave[w][1], saved_per_pod[w], wave[w][4])
-                for w in range(f + 1, len(wave))
+                for w in [*sorted(demote), *range(f + 1, len(wave))]
             ]
 
     def _restore_victims(self, records) -> None:

@@ -4,7 +4,13 @@ mirroring `vendor/.../plugins/defaultpreemption/default_preemption.go`).
 
 from __future__ import annotations
 
+import os
+
 from simtpu.api import simulate
+
+# wall-clock envelopes only fire on dedicated perf runs (advisor low, round
+# 4): explicit opt-in, anything else keeps them off
+_PERF_ASSERT = os.environ.get("SIMTPU_PERF_ASSERT", "").lower() in ("1", "true", "yes", "on")
 from simtpu.core.objects import ResourceTypes
 
 from .fixtures import make_fake_node, make_fake_pod
@@ -101,6 +107,48 @@ def test_mid_batch_failure_keeps_bookkeeping_aligned():
     names = {p.pod["metadata"]["name"] for p in result.preempted_pods}
     assert names == {"tiny", "low1"}
     assert set(placed) == {"low0", "vip"}
+
+
+def test_wave_commit_never_rides_restored_victims():
+    """Advisor finding (round 4): in a preemption wave, a pod committed
+    before the first verify failure f may have verify-landed on a node that
+    only had room because of f's evictions (the batched placement applies
+    ALL wave evictions).  Restoring f's victims under it silently
+    overcommits the node — impossible in the serial evict/retry/undo flow.
+
+    Construction: preemptors A (10 cpu) and B (20 cpu) both fail and wave
+    together.  A's proposal evicts fA on nA (lowest victim priority), B's
+    evicts fB on nB.  With both evictions applied, the score pipeline sends
+    A to the roomier nB; B then cannot fit and fails verify.  The buggy
+    flow committed A on nB and restored fB beside it (30 cpu on a 20-cpu
+    node).  The fixed flow demotes A, lets B's authoritative retry land on
+    nB, and re-verifies A — converging to the serial-exact placement."""
+    nA = make_fake_node("nA", "10", "16Gi")
+    nB = make_fake_node("nB", "20", "32Gi")
+    fA = _prio(make_fake_pod("fa", "default", "10", "1Gi"), 0)
+    fA["spec"]["nodeName"] = "nA"
+    fB = _prio(make_fake_pod("fb", "default", "20", "2Gi"), 1)
+    fB["spec"]["nodeName"] = "nB"
+    a = _prio(make_fake_pod("a", "default", "10", "1Gi"), 100)
+    b = _prio(make_fake_pod("b", "default", "20", "2Gi"), 100)
+    result = simulate(ResourceTypes(nodes=[nA, nB], pods=[fA, fB, a, b]))
+    placed = _placements(result)
+    # the serial flow places both preemptors, evicting both fillers
+    assert placed.get("a") == "nA"
+    assert placed.get("b") == "nB"
+    assert not result.unscheduled_pods
+    assert {p.pod["metadata"]["name"] for p in result.preempted_pods} == {"fa", "fb"}
+    # the no-overcommit invariant the buggy flow violated: per-node summed
+    # cpu requests within allocatable
+    cap = {"nA": 10.0, "nB": 20.0}
+    used: dict = {}
+    for status in result.node_status:
+        name = status.node["metadata"]["name"]
+        for pod in status.pods:
+            cpu = pod["spec"]["containers"][0]["resources"]["requests"]["cpu"]
+            used[name] = used.get(name, 0.0) + float(cpu)
+    for name, total in used.items():
+        assert total <= cap[name] + 1e-9, (name, total)
 
 
 def test_preempts_port_holder():
@@ -314,7 +362,11 @@ def test_preemption_at_100k_scale():
     assert len(out.unscheduled_pods) == 0
     assert len(out.preempted_pods) == 2 * 1100
     assert placed == n * 16 - 2 * 1100 + 1100
-    assert wall < 420, f"100k-scale preemption too slow: {wall:.1f}s"
+    # wall-clock envelope only on dedicated perf runs (advisor low, round
+    # 4): a loaded shared CI host can exceed it without anything being
+    # wrong; functional runs still pin placement/preemption counts above
+    if _PERF_ASSERT:
+        assert wall < 420, f"100k-scale preemption too slow: {wall:.1f}s"
 
 
 def test_preemption_at_scale():
@@ -367,4 +419,5 @@ def test_preemption_at_scale():
     assert len(out.preempted_pods) == 2 * 250
     assert placed == n * 4 - 2 * 250 + 250
     # generous envelope: the pre-vectorization search alone took minutes
-    assert wall < 120, f"preemption path too slow: {wall:.1f}s"
+    if _PERF_ASSERT:
+        assert wall < 120, f"preemption path too slow: {wall:.1f}s"
