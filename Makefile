@@ -1,0 +1,38 @@
+# Build/test entry points — the analog of the reference's Makefile
+# (`/root/reference/Makefile:24-25`, `go test ./...`).  simtpu is pure
+# Python + a self-building ctypes extension, so there is no build step;
+# `install` wires an editable checkout, `test` is the CI gate.
+
+PY ?= python
+
+.PHONY: all install lint test test-all test-perf bench clean
+
+all: test
+
+install:
+	$(PY) -m pip install -e .
+
+# syntax gate (no third-party linter is vendored; compileall catches
+# parse/syntax errors across every module)
+lint:
+	$(PY) -m compileall -q simtpu tools tests bench.py __graft_entry__.py
+
+# fast tier: every module, slow-marked tests deselected (<10 min target)
+test: lint
+	$(PY) tools/run_tests.py --fast
+
+# the full suite, one subprocess per module (see tools/run_tests.py for
+# why plain `pytest tests/` cannot be the canonical entry on CPU hosts)
+test-all: lint
+	$(PY) tools/run_tests.py
+
+# dedicated perf runs: wall-clock envelopes armed (idle host required)
+test-perf:
+	SIMTPU_PERF_ASSERT=1 $(PY) tools/run_tests.py
+
+bench:
+	$(PY) bench.py
+
+clean:
+	rm -rf build dist *.egg-info simtpu/native/_build
+	find . -name __pycache__ -type d -exec rm -rf {} +

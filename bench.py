@@ -127,31 +127,38 @@ def build_problem(n_nodes: int, n_pods: int, mix: str = "north"):
     return tensors, batch, statics, state, pod_arrays, req, gen_s, tensorize_s
 
 
-def time_engine(statics, state, pod_arrays, flags=None) -> float:
-    """Seconds for one full placement scan (compiled, post-warmup).
+def time_engine(statics, state, pod_arrays, flags=None, tensors=None, groups=None):
+    """(seconds, placed_nodes) for one full placement scan (compiled,
+    post-warmup) through the engine's chunked + term-row-sliced dispatch
+    (run_scan_chunked) — the path `Engine.place` actually uses for
+    serial-only shapes.
 
     Timing runs to full host materialization of the placement vector:
     `block_until_ready` alone under-reports on tunneled TPU backends (it can
     return before the executable finishes), so the device→host copy is the
-    only trustworthy completion barrier.
+    only trustworthy completion barrier (run_scan_chunked's outputs are
+    host arrays already).
     """
     import jax
-    from functools import partial
-    from simtpu.engine.scan import StepFlags, schedule_step
+    import jax.numpy as jnp
+
+    from simtpu.engine.scan import StepFlags, run_scan_chunked
 
     step_flags = flags if flags is not None else StepFlags()
 
-    @jax.jit
-    def run(statics, state, pods):
-        return jax.lax.scan(
-            partial(schedule_step, statics, flags=step_flags), state, pods
+    def run(st):
+        _, outs = run_scan_chunked(
+            statics, st, pod_arrays, step_flags, tensors, groups
         )
+        return outs[0]
 
-    out = run(statics, state, pod_arrays)  # compile + warm
-    np.asarray(out[1][0])
+    # run_scan_chunked's dispatches donate the state, so each run gets its
+    # own copy (made OUTSIDE the timed region)
+    run(jax.tree.map(jnp.copy, state))  # compile + warm
+    fresh = jax.tree.map(jnp.copy, state)
+    jax.block_until_ready(fresh)
     t0 = time.perf_counter()
-    out = run(statics, state, pod_arrays)
-    placed_nodes = np.asarray(out[1][0])
+    placed_nodes = run(fresh)
     return time.perf_counter() - t0, placed_nodes
 
 
@@ -344,7 +351,12 @@ def main() -> int:
     note("problem built; timing scan slice")
     scan_slice = tuple(arr[:scan_pods] for arr in pod_arrays)
     engine_s, _ = time_engine(
-        statics, state, scan_slice, flags_from(tensors, batch.ext)
+        statics,
+        state,
+        scan_slice,
+        flags_from(tensors, batch.ext),
+        tensors=tensors,
+        groups=np.asarray(batch.group)[:scan_pods],
     )
     scan_rate = scan_pods / engine_s
     note(f"scan={scan_rate:.0f} pods/s; timing bulk")

@@ -139,16 +139,26 @@ def build_parser() -> argparse.ArgumentParser:
     apply_p.add_argument(
         "--search",
         choices=["binary", "linear", "incremental"],
-        default="binary",
-        help="min-node-add search strategy (linear = reference-exact walk; "
-        "incremental = one tensorization + completion probes + fresh "
-        "verification, the fast path for large clusters)",
+        default=None,
+        help="min-node-add search strategy (default: auto by problem size; "
+        "linear = reference-exact walk; incremental = one tensorization + "
+        "completion probes + fresh verification, the fast path for large "
+        "clusters)",
     )
     apply_p.add_argument(
         "--bulk",
+        dest="bulk",
         action="store_true",
-        help="place replica runs with the bulk rounds engine (faster on "
-        "large app lists; tie-breaking may differ from the serial scan)",
+        default=None,
+        help="place replica runs with the bulk rounds engine (default: auto "
+        "by problem size; faster on large app lists; tie-breaking may "
+        "differ from the serial scan)",
+    )
+    apply_p.add_argument(
+        "--no-bulk",
+        dest="bulk",
+        action="store_false",
+        help="force the serial scan engine even at scale",
     )
     apply_p.add_argument(
         "--corrected-ds-overhead",

@@ -92,8 +92,10 @@ from .scan import (
     SchedState,
     StaticArrays,
     StepFlags,
+    _pow2_up,
     add_rows,
     filter_and_score,
+    pad_pods_pow2,
     score_pod,
     take_rows,
     take_rows_i32,
@@ -114,11 +116,9 @@ def _floor_slots(free: jnp.ndarray, size) -> jnp.ndarray:
     return jnp.where(c * size > free, c - 1.0, c)
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def _scatter_rows(plane: jnp.ndarray, rows: jnp.ndarray, values: jnp.ndarray):
-    """plane[rows] = values, in place (the full plane is donated — an eager
-    .at[].set would copy the whole plane per chunk)."""
-    return plane.at[rows].set(values)
+# single jitted home in scan.py (the chunked serial scan flushes through it
+# too); re-exported here for the bulk-chunk path
+from .scan import _scatter_rows  # noqa: E402
 
 
 def _fill_order(cap_x: jnp.ndarray, free_x: jnp.ndarray):
@@ -936,37 +936,10 @@ class RoundsEngine(Engine):
                 segments.append(("scan", a, b))
         return segments
 
-    @staticmethod
-    def _pad_pods(seg, target: int):
-        """Pad pod-tuple arrays to `target` rows with inert pods: forced with
-        pin=-1 never places and never touches state (schedule_step's forced
-        path), so padded scan segments are placement-neutral. Shapes are
-        padded to powers of two because each distinct length is a separate
-        XLA compilation."""
-        pad = target - seg[0].shape[0]
-        if pad <= 0:
-            return seg
-        out = []
-        for idx, arr in enumerate(seg):
-            widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
-            if idx == 2:  # pin
-                out.append(jnp.pad(arr, widths, constant_values=-1))
-            elif idx == 3:  # forced
-                out.append(jnp.pad(arr, widths, constant_values=True))
-            else:
-                out.append(jnp.pad(arr, widths))
-        return tuple(out)
-
-    @staticmethod
-    def _pow2(x: int) -> int:
-        return 1 << max(x - 1, 0).bit_length()
-
-    def _scan_call(self, statics, state, seg, flags):
-        """Dispatch one serial-scan segment (overridden by the sharded
-        subclass to run on a mesh)."""
-        from .scan import _run_scan
-
-        return _run_scan(statics, state, seg, flags)
+    # shared with the chunked serial scan (single home for the pod-tuple
+    # padding invariants: pin=-1 / forced=True columns)
+    _pad_pods = staticmethod(pad_pods_pow2)
+    _pow2 = staticmethod(_pow2_up)
 
     def _bulk_call(
         self, statics, state, seg_pods, ks, n_domains, k_cap, flags,
@@ -980,14 +953,20 @@ class RoundsEngine(Engine):
         )
 
     def _run_scan_segment(self, statics, state, pods, a, b, flags):
-        seg = self._pad_pods(
-            tuple(arr[a:b] for arr in pods), self._pow2(b - a)
+        # chunked + term-row-sliced (scan.run_scan_chunked): serial
+        # fallback segments inside a bulk run get the same count-plane
+        # compaction the bulk chunks do
+        from .scan import run_scan_chunked
+
+        return run_scan_chunked(
+            statics,
+            state,
+            tuple(arr[a:b] for arr in pods),
+            flags,
+            self._current_tensors,
+            np.asarray(self._current_batch.group)[a:b],
+            scan_call=self._scan_call,
         )
-        state, outs = self._scan_call(statics, state, seg, flags)
-        # one batched device→host transfer: per-array np.asarray syncs pay a
-        # full tunnel round-trip each
-        outs = jax.device_get(outs)
-        return state, tuple(np.asarray(o)[: b - a] for o in outs)
 
     #: carried-row budget per bulk chunk (padded to the next power of two):
     #: each chunk's scan carries only these many cnt-plane rows, so per-round

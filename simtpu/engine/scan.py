@@ -823,6 +823,185 @@ def _run_scan(statics: StaticArrays, state: SchedState, pods, flags: StepFlags =
     return jax.lax.scan(partial(schedule_step, statics, flags=flags), state, pods)
 
 
+# -- chunked + term-row-sliced serial scan ----------------------------------
+#
+# At 100k nodes x thousands of interned terms, each scan step's count-plane
+# reads/writes touch [T, N]-scale memory and dominate the per-pod cost
+# (~172 pods/s at the north-star shape, BENCH_r04).  But one pod only ever
+# touches its GROUP's few term rows, and consecutive pods overwhelmingly
+# share a group — so the scan runs in chunks that carry ONLY the union of
+# their pods' term rows (a [rows<=256, N] plane instead of [T, N]), with
+# one gather + one in-place scatter per rows-change.  The same compaction
+# the bulk engine's `_chunk_runs` applies to rounds (rounds.py), applied to
+# the serial referee.  Placements are bit-identical: a step reads/writes
+# term rows only through `statics.g_terms[g]`, which is remapped onto the
+# sliced axis.
+
+_SCAN_CHUNK = 1024  # pods per dispatch (pow2-padded tail; bounded shapes)
+_SCAN_ROW_BUDGET = 224  # target carried term rows (pow2-padded, like rounds)
+
+
+def _pow2_up(x: int) -> int:
+    return 1 << max(x - 1, 0).bit_length()
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_rows(plane: jnp.ndarray, rows: jnp.ndarray, values: jnp.ndarray):
+    """plane[rows] = values, in place (the full plane is donated — an eager
+    .at[].set would copy the whole plane per flush)."""
+    return plane.at[rows].set(values)
+
+
+def pad_pods_pow2(seg, target: int):
+    """Pad pod-tuple arrays to `target` rows with inert pods: forced with
+    pin=-1 never places and never touches state (schedule_step's forced
+    path), so padded scan segments are placement-neutral.  Pow2 targets keep
+    the compiled-shape set bounded (each length is a separate executable)."""
+    pad = target - seg[0].shape[0]
+    if pad <= 0:
+        return seg
+    out = []
+    for idx, arr in enumerate(seg):
+        widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+        if idx == 2:  # pin
+            out.append(jnp.pad(arr, widths, constant_values=-1))
+        elif idx == 3:  # forced
+            out.append(jnp.pad(arr, widths, constant_values=True))
+        else:
+            out.append(jnp.pad(arr, widths))
+    return tuple(out)
+
+
+def pad_row_ids(rows: np.ndarray, t: int):
+    """Pad a sorted term-row list to a power of two with DISTINCT unused
+    term ids (their values ride along unchanged; duplicates would let a
+    stale copy win the scatter-back).  None = carrying the full plane is
+    cheaper (pow2 would reach t anyway)."""
+    rows = np.asarray(rows, np.int32)
+    u_pad = _pow2_up(len(rows))
+    if u_pad >= t:
+        return None
+    pad = u_pad - len(rows)
+    if pad > 0:
+        unused = np.setdiff1d(np.arange(t, dtype=np.int32), rows)[:pad]
+        rows = np.concatenate([rows, unused])
+    return rows
+
+
+def run_scan_chunked(
+    statics: StaticArrays,
+    state: SchedState,
+    pods,
+    flags: StepFlags,
+    tensors,
+    groups: np.ndarray,
+    scan_call=None,
+    chunk: int = None,
+    row_budget: int = None,
+):
+    """Serial-equivalent scan over `pods`, dispatched in pow2 chunks whose
+    count planes are sliced to each chunk's term-row union.
+
+    `groups` is the host-side group id per pod (drives the row unions).
+    `scan_call(statics, state, seg, flags)` defaults to the compiled
+    `_run_scan`; engines pass their sharded variants.  Returns
+    (final_state, host output tuple) — outputs are numpy, truncated to the
+    real pod count."""
+    call = scan_call or _run_scan
+    chunk = _SCAN_CHUNK if chunk is None else chunk
+    row_budget = _SCAN_ROW_BUDGET if row_budget is None else row_budget
+    n = groups.shape[0]
+    if n == 0:  # preserve _run_scan's total contract (empty outputs)
+        state, outs = call(statics, state, pods, flags)
+        return state, tuple(np.asarray(o) for o in jax.device_get(outs))
+    t = int(tensors.n_terms)
+    use_topo = (
+        flags.spread_hard
+        or flags.spread_soft
+        or flags.selector_spread
+        or flags.interpod_req
+        or flags.interpod_pref
+    )
+    sliceable = bool(t) and use_topo and _pow2_up(min(t, row_budget)) < t
+    g_terms_host = _compact_terms(tensors)[0] if sliceable else None
+
+    # active slice context: (rows_p, sliced statics, full planes set aside)
+    ctx_rows = None
+    full_match = full_total = None
+
+    def flush(state):
+        nonlocal ctx_rows, full_match, full_total
+        if ctx_rows is None:
+            return state
+        rows_dev = jnp.asarray(ctx_rows)
+        state = state._replace(
+            cnt_match=_scatter_rows(full_match, rows_dev, state.cnt_match),
+            cnt_total=_scatter_rows(full_total, rows_dev, state.cnt_total),
+        )
+        ctx_rows, full_match, full_total = None, None, None
+        return state
+
+    outs_dev = []
+    eff_statics = statics
+    for c0 in range(0, n, chunk):
+        c1 = min(c0 + chunk, n)
+        seg = pad_pods_pow2(
+            tuple(arr[c0:c1] for arr in pods), _pow2_up(c1 - c0)
+        )
+        rows_p = None
+        if sliceable:
+            gs = np.unique(groups[c0:c1])
+            rows = np.unique(g_terms_host[gs])
+            rows = rows[rows >= 0]
+            if len(rows) <= row_budget:
+                rows_p = pad_row_ids(np.sort(rows), t)
+        if rows_p is None:
+            state = flush(state)
+            eff_statics = statics
+            state, outs = call(statics, state, seg, flags)
+        else:
+            if ctx_rows is None or not np.array_equal(rows_p, ctx_rows):
+                # consecutive chunks usually share a group set — re-slice
+                # only when the row union actually changes
+                state = flush(state)
+                inv = np.zeros(t, np.int32)
+                inv[rows_p] = np.arange(len(rows_p), dtype=np.int32)
+                g_terms_chunk = np.where(
+                    g_terms_host >= 0, inv[np.clip(g_terms_host, 0, None)], -1
+                ).astype(np.int32)
+                ip_of = interpod_term_index(tensors)
+                eff_statics = statics._replace(
+                    g_terms=jnp.asarray(g_terms_chunk),
+                    term_topo=jnp.asarray(tensors.term_topo_key[rows_p]),
+                    ip_of=jnp.asarray(ip_of[rows_p]),
+                )
+                rows_dev = jnp.asarray(rows_p)
+                full_match, full_total = state.cnt_match, state.cnt_total
+                state = state._replace(
+                    cnt_match=state.cnt_match[rows_dev],
+                    cnt_total=state.cnt_total[rows_dev],
+                )
+                ctx_rows = rows_p
+            state, outs = call(eff_statics, state, seg, flags)
+        # keep outputs on device: a per-chunk device_get would sync the
+        # tunnel once per chunk; all dispatches queue first and one
+        # batched transfer materializes everything afterwards
+        outs_dev.append((outs, c1 - c0))
+    state = flush(state)
+    fetched = jax.device_get([o for o, _ in outs_dev])
+    outs_host = [
+        tuple(np.asarray(o)[:real] for o in chunk_outs)
+        for chunk_outs, (_, real) in zip(fetched, outs_dev)
+    ]
+    if len(outs_host) == 1:
+        return state, outs_host[0]
+    merged = tuple(
+        np.concatenate([chunk_outs[i] for chunk_outs in outs_host])
+        for i in range(len(outs_host[0]))
+    )
+    return state, merged
+
+
 def _delta_step(statics: StaticArrays, state: SchedState, entry):
     """Apply one placement-log entry to the state with weight w (+1 =
     re-place, -1 = evict): exactly `schedule_step`'s state-update block,
@@ -936,12 +1115,27 @@ class Engine:
             int((interpod_term_index(tensors) >= 0).sum()),
         )
 
+    def _scan_call(self, statics, state, seg, flags):
+        """Dispatch one compiled scan segment (overridden by the sharded
+        engines to run on a mesh)."""
+        return _run_scan(statics, state, seg, flags)
+
     def _dispatch(
         self, statics: StaticArrays, state: SchedState, pods, flags: StepFlags
     ):
-        """Run the compiled scan. `ShardedEngine` (simtpu/parallel) overrides
-        this to lay the node axis out across a device mesh."""
-        return _run_scan(statics, state, pods, flags)
+        """Run the scan in pow2 chunks with term-row-sliced count planes
+        (run_scan_chunked).  `ShardedEngine` (simtpu/parallel) overrides
+        `_scan_call` to lay the node axis out across a device mesh; the
+        chunking composes."""
+        return run_scan_chunked(
+            statics,
+            state,
+            pods,
+            flags,
+            self._current_tensors,
+            np.asarray(self._current_batch.group),
+            scan_call=self._scan_call,
+        )
 
     def place(self, batch: PodBatch):
         """Schedule one batch.

@@ -209,10 +209,14 @@ class ShardedEngine(_MeshMixin, Engine):
         self._init_mesh(mesh)
 
     def _dispatch(self, statics: StaticArrays, state: SchedState, pods, flags: StepFlags):
+        # shard the node axis once, then let the base class chunk the scan
+        # (pow2 pod chunks + term-row-sliced count planes); _scan_call
+        # routes every chunk through the mesh-compiled scan
         statics, state = self._shard_inputs(statics, state)
-        pods = jax.device_put(pods, NamedSharding(self.mesh, P()))
-        final_state, out = self._sharded_scan_for(flags)(statics, state, pods)
-        return final_state, out
+        return super()._dispatch(statics, state, pods, flags)
+
+    def _scan_call(self, statics, state, seg, flags):
+        return self._sharded_scan_for(flags)(statics, state, seg)
 
 
 def build_sharded_rounds(

@@ -308,18 +308,82 @@ def plan_capacity(
 
 @dataclass
 class ApplierOptions:
-    """CLI options (`pkg/apply/apply.go:32-38`)."""
+    """CLI options (`pkg/apply/apply.go:32-38`).
+
+    `search` / `bulk` default to None = scale-aware auto: the reference's
+    `simon apply` is ONE command that is always its fastest
+    (`pkg/apply/apply.go:88,183`), so `simtpu apply` picks the engines
+    itself — serial scan + binary search at conformance scale, bulk rounds
+    + incremental search once the problem is large enough that the serial
+    floor would dominate (see `_resolve_engines`)."""
 
     simon_config: str = ""
     default_scheduler_config: str = ""
     use_greed: bool = False
     interactive: bool = False
     extended_resources: Sequence[str] = ()
-    search: str = "binary"
-    bulk: bool = False  # place replica runs with the bulk rounds engine
+    search: Optional[str] = None  # None = auto; binary | linear | incremental
+    bulk: Optional[bool] = None  # None = auto; place replica runs bulk
     # account daemonset overhead on the template node in the can-ever-fit
     # diagnostic (off = faithful to the reference's NewNodeNamePrefix quirk)
     corrected_ds_overhead: bool = False
+
+
+# Auto-engine thresholds: below both, the serial scan keeps its per-pod
+# reference-exact tie-breaks and compiles fastest; above either, the bulk
+# rounds engine (~600x the serial rate at 100k nodes, BENCH_r04) and the
+# incremental planner win by minutes.  Declared pods, not expanded: the
+# estimate runs before workload expansion.
+AUTO_ENGINE_NODES = 1024
+AUTO_ENGINE_PODS = 16384
+
+
+def _declared_pod_estimate(cluster: ResourceTypes, apps: Sequence[AppResource]) -> int:
+    """Cheap upper-ish estimate of the expanded pod count: declared replica
+    counts plus one DaemonSet pod per node, without running expansion."""
+
+    def one(res: ResourceTypes, n_nodes: int) -> int:
+        total = len(res.pods)
+        for w in res.deployments + res.replica_sets + res.replication_controllers + res.stateful_sets:
+            spec = w.get("spec") or {}
+            total += int(spec.get("replicas") or 1)
+        for j in res.jobs:
+            spec = j.get("spec") or {}
+            total += int(spec.get("completions") or spec.get("parallelism") or 1)
+        for cj in res.cron_jobs:
+            total += 1
+        total += len(res.daemon_sets) * n_nodes
+        return total
+
+    n = len(cluster.nodes)
+    return one(cluster, n) + sum(one(a.resource, n) for a in apps)
+
+
+def _resolve_engines(
+    opts: ApplierOptions,
+    cluster: ResourceTypes,
+    apps: Sequence[AppResource],
+) -> Tuple[str, bool]:
+    """Fill in auto (None) search/bulk choices from the problem size and
+    say so loudly on stderr — the user should never need to know the flags
+    to get the fast path, but must be able to see (and override) what was
+    picked."""
+    import sys
+
+    n_nodes = len(cluster.nodes)
+    est_pods = _declared_pod_estimate(cluster, apps)
+    large = n_nodes >= AUTO_ENGINE_NODES or est_pods >= AUTO_ENGINE_PODS
+    search = opts.search if opts.search is not None else ("incremental" if large else "binary")
+    bulk = opts.bulk if opts.bulk is not None else large
+    if large and (opts.search is None or opts.bulk is None):
+        print(
+            f"simtpu: large problem ({n_nodes} nodes, ~{est_pods} declared "
+            f"pods) — auto-selected {'bulk' if bulk else 'serial'} placement"
+            f" + {search} search; pass --search binary/linear or --no-bulk "
+            "for the serial reference-exact engines",
+            file=sys.stderr,
+        )
+    return search, bulk
 
 
 class Applier:
@@ -395,9 +459,10 @@ class Applier:
             import jax
 
             ctx = jax.profiler.trace(trace_dir)
+        search, bulk = _resolve_engines(self.opts, cluster, apps)
         t0 = _time.perf_counter()
         with ctx:
-            if self.opts.search == "incremental":
+            if search == "incremental":
                 from .incremental import plan_capacity_incremental
 
                 plan = plan_capacity_incremental(
@@ -415,9 +480,9 @@ class Applier:
                     apps,
                     new_node,
                     extended_resources=self.opts.extended_resources,
-                    search=self.opts.search,
+                    search=search,
                     progress=progress,
-                    bulk=self.opts.bulk,
+                    bulk=bulk,
                     sched_config=self._sched_config(),
                     corrected_ds_overhead=self.opts.corrected_ds_overhead,
                 )

@@ -33,3 +33,18 @@ def example_dir():
     if not os.path.isdir(REFERENCE_EXAMPLES):
         pytest.skip("reference example fixtures not available")
     return REFERENCE_EXAMPLES
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _drop_xla_executables():
+    """Release each module's compiled XLA:CPU executables.
+
+    A single long pytest process accumulates hundreds of loaded CPU
+    executables; past ~190 tests the host's XLA:CPU
+    `backend_compile_and_load` starts segfaulting (the same toolchain
+    fault class simtpu/cache.py works around).  Dropping the jit caches
+    between modules keeps the resident-executable count bounded at the
+    cost of cross-module recompiles.  `tools/run_tests.py` goes further
+    (one subprocess per module) and is the canonical full-suite entry."""
+    yield
+    jax.clear_caches()

@@ -1,0 +1,100 @@
+"""Multi-process execution of the sharded engine (VERDICT r4 task 4).
+
+`simtpu.parallel.mesh.initialize_multihost` is the DCN/multi-host analog of
+the reference's in-process parallelism (SURVEY.md §2.3/§5): jax.distributed
+wires N processes into one global device mesh.  Real TPU pods give each
+process its own chips; here every process brings 4 virtual CPU devices, so
+2 processes form an 8-device global mesh — the same shape the single-process
+tests shard over.  The gate: a 2-process run must produce placements
+IDENTICAL to the single-process sharded run (which is itself pinned to the
+unsharded engine by test_parallel.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from simtpu.api import simulate
+from simtpu.parallel import ShardedEngine, make_mesh
+from simtpu.synth import synth_apps, synth_cluster
+from simtpu.workloads.expand import seed_name_hashes
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tools", "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _single_process_reference():
+    cluster = synth_cluster(
+        11, seed=21, zones=3, taint_frac=0.2, gpu_frac=0.3, storage_frac=0.3
+    )
+    apps = synth_apps(
+        40,
+        seed=22,
+        zones=3,
+        pods_per_deployment=8,
+        selector_frac=0.3,
+        toleration_frac=0.2,
+        anti_affinity_frac=0.4,
+        gpu_frac=0.2,
+        storage_frac=0.2,
+    )
+    seed_name_hashes(0)
+    mesh = make_mesh(sweep=1)
+    result = simulate(
+        cluster,
+        apps,
+        extended_resources=("open-local", "gpu"),
+        engine_factory=lambda t: ShardedEngine(t, mesh),
+    )
+    placements = {}
+    for status in result.node_status:
+        for pod in status.pods:
+            meta = pod["metadata"]
+            placements[f"{meta.get('namespace')}/{meta['name']}"] = pod["spec"][
+                "nodeName"
+            ]
+    return placements, len(result.unscheduled_pods)
+
+
+@pytest.mark.slow
+def test_two_process_run_matches_single_process(tmp_path):
+    """2 local processes x 4 virtual CPU devices == one 8-device mesh; the
+    distributed placement must equal the single-process sharded one."""
+    out = tmp_path / "multihost.json"
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers set their own device count (4 each)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(i), "2", str(port), str(out)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    logs = []
+    for p in procs:
+        stdout, _ = p.communicate(timeout=600)
+        logs.append(stdout)
+    assert all(p.returncode == 0 for p in procs), "\n---\n".join(logs)
+    data = json.loads(out.read_text())
+    assert data["process_count"] == 2
+    assert data["global_devices"] == 8
+    placements, unscheduled = _single_process_reference()
+    assert data["placements"] == placements
+    assert data["unscheduled"] == unscheduled

@@ -298,3 +298,63 @@ class TestIncrementalPlanner:
         )
         assert not plan.success
         assert "still failed" in plan.message
+
+
+class TestAutoEngines:
+    """Scale-aware engine defaults (VERDICT r4 task 2): `simtpu apply` is one
+    command that is always its fastest — serial/binary at conformance scale,
+    bulk + incremental above the size thresholds, loudly and overridably
+    (the one-engine UX of the reference's `pkg/apply/apply.go:88`)."""
+
+    def test_small_problem_keeps_serial_engines(self, capsys):
+        from simtpu.plan.capacity import ApplierOptions, _resolve_engines
+
+        cluster = _small_cluster()
+        search, bulk = _resolve_engines(ApplierOptions(), cluster, [_app(3)])
+        assert (search, bulk) == ("binary", False)
+        assert capsys.readouterr().err == ""
+
+    def test_large_node_count_selects_fast_engines(self, capsys):
+        from simtpu.plan.capacity import AUTO_ENGINE_NODES, ApplierOptions, _resolve_engines
+
+        cluster = ResourceTypes()
+        cluster.nodes = [
+            make_fake_node(f"n{i}", "4", "8Gi") for i in range(AUTO_ENGINE_NODES)
+        ]
+        search, bulk = _resolve_engines(ApplierOptions(), cluster, [_app(3)])
+        assert (search, bulk) == ("incremental", True)
+        assert "auto-selected" in capsys.readouterr().err
+
+    def test_large_declared_pod_count_selects_fast_engines(self):
+        from simtpu.plan.capacity import AUTO_ENGINE_PODS, ApplierOptions, _resolve_engines
+
+        search, bulk = _resolve_engines(
+            ApplierOptions(), _small_cluster(), [_app(AUTO_ENGINE_PODS)]
+        )
+        assert (search, bulk) == ("incremental", True)
+
+    def test_explicit_flags_override_auto(self, capsys):
+        from simtpu.plan.capacity import AUTO_ENGINE_PODS, ApplierOptions, _resolve_engines
+
+        opts = ApplierOptions(search="linear", bulk=False)
+        search, bulk = _resolve_engines(opts, _small_cluster(), [_app(AUTO_ENGINE_PODS)])
+        assert (search, bulk) == ("linear", False)
+        assert capsys.readouterr().err == ""
+
+    def test_auto_path_plans_documented_config(self, example_dir, monkeypatch):
+        """End-to-end: with thresholds lowered so the demo qualifies as
+        large, the auto-selected bulk + incremental engines must still plan
+        the reference's documented simon-config successfully."""
+        from simtpu.plan import capacity as cap
+
+        monkeypatch.chdir(os.path.dirname(example_dir))
+        monkeypatch.setattr(cap, "AUTO_ENGINE_NODES", 1)
+        applier = cap.Applier(
+            cap.ApplierOptions(
+                simon_config=os.path.join(example_dir, "simon-config.yaml"),
+                extended_resources=("open-local",),
+            )
+        )
+        plan = applier.run()
+        assert plan.success, plan.message
+        assert not plan.result.unscheduled_pods

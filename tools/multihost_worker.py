@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""One process of a multi-process (DCN-style) simtpu run.
+
+Usage: multihost_worker.py PROC_ID NUM_PROCS COORD_PORT OUT_JSON
+
+Each process contributes 4 virtual CPU devices
+(--xla_force_host_platform_device_count), joins the cluster through
+`simtpu.parallel.mesh.initialize_multihost` (jax.distributed — the DCN
+analog; SURVEY.md §2.3/§5 distributed backend), and runs the SAME
+simulation SPMD: host-side ingestion/tensorization is deterministic and
+replicated, device placement runs once across the global mesh with the
+node axis sharded over every process's devices.  Process 0 writes the
+placement map to OUT_JSON; the launcher (tests/test_multihost.py)
+compares it against a single-process run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    proc_id, nproc, port, out_path = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+    )
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4"
+        ).strip()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+
+    # a sitecustomize may have pre-imported jax pinned to an accelerator
+    # platform; the platform must be (re)set before any device use
+    jax.config.update("jax_platforms", "cpu")
+
+    from simtpu.api import simulate
+    from simtpu.parallel import ShardedEngine
+    from simtpu.parallel.mesh import initialize_multihost
+    from simtpu.synth import synth_apps, synth_cluster
+    from simtpu.workloads.expand import seed_name_hashes
+
+    mesh = initialize_multihost(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=proc_id,
+    )
+    assert jax.process_count() == nproc, jax.process_count()
+    assert len(jax.devices()) == 4 * nproc, len(jax.devices())
+
+    cluster = synth_cluster(
+        11, seed=21, zones=3, taint_frac=0.2, gpu_frac=0.3, storage_frac=0.3
+    )
+    apps = synth_apps(
+        40,
+        seed=22,
+        zones=3,
+        pods_per_deployment=8,
+        selector_frac=0.3,
+        toleration_frac=0.2,
+        anti_affinity_frac=0.4,
+        gpu_frac=0.2,
+        storage_frac=0.2,
+    )
+    seed_name_hashes(0)
+    result = simulate(
+        cluster,
+        apps,
+        extended_resources=("open-local", "gpu"),
+        engine_factory=lambda t: ShardedEngine(t, mesh),
+    )
+    placements = {}
+    for status in result.node_status:
+        for pod in status.pods:
+            meta = pod["metadata"]
+            placements[f"{meta.get('namespace')}/{meta['name']}"] = pod["spec"][
+                "nodeName"
+            ]
+    if proc_id == 0:
+        with open(out_path, "w") as f:
+            json.dump(
+                {
+                    "placements": placements,
+                    "unscheduled": len(result.unscheduled_pods),
+                    "process_count": jax.process_count(),
+                    "global_devices": len(jax.devices()),
+                },
+                f,
+            )
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
