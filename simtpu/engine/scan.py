@@ -34,6 +34,7 @@ from ..kernels.filters import (
 )
 from ..kernels.gpushare import gpu_plan
 from ..kernels.scores import (
+    MAX_NODE_SCORE,
     balanced_allocation,
     interpod_score,
     least_allocated,
@@ -516,27 +517,60 @@ def score_pod(
         score += w_[4] * minmax_normalize(statics.node_pref[g], m_all)
     if f.taint_pref:
         score += w_[5] * taint_toleration_score(statics.taint_intol[g], m_all)
+    n = statics.alloc.shape[0]
     if (f.interpod_pref or f.interpod_req) and t_cap:
-        # [Tc] rows in the compacted own planes; -1 (non-interpod/pad)
-        # gathers as zeros through the one-hot matmul
-        ip_eff = jnp.where(tvalid, statics.ip_of[tsafe], -1)
-        raw_ipa = interpod_score(
-            cnt_sub,
-            take_rows(state.cnt_own_aff, ip_eff),
-            take_rows(state.w_own_aff_pref, ip_eff),
-            take_rows(state.w_own_anti_pref, ip_eff),
-            statics.s_match[g],
-            statics.w_aff_pref[g],
-            statics.w_anti_pref[g],
+        # per-pod skip (lax.cond): a pod whose group carries no interpod
+        # terms gets raw 0 → maxabs-normalized 0 — identical constants
+        # without streaming the [Tc, N] own planes
+        def _ipa_term(_):
+            # [Tc] rows in the compacted own planes; -1 (non-interpod/pad)
+            # gathers as zeros through the one-hot matmul
+            ip_eff = jnp.where(tvalid, statics.ip_of[tsafe], -1)
+            raw_ipa = interpod_score(
+                cnt_sub,
+                take_rows(state.cnt_own_aff, ip_eff),
+                take_rows(state.w_own_aff_pref, ip_eff),
+                take_rows(state.w_own_anti_pref, ip_eff),
+                statics.s_match[g],
+                statics.w_aff_pref[g],
+                statics.w_anti_pref[g],
+            )
+            return maxabs_normalize(raw_ipa, m_all)
+
+        # symmetric terms count: existing pods' preferred (anti-)affinity
+        # reaches a pod with no own terms through s_match on the interpod
+        # own planes, so the skip predicate includes that clause
+        ip_eff_s = jnp.where(tvalid, statics.ip_of[tsafe], -1)
+        has_ip = (
+            jnp.any(statics.w_aff_pref[g] != 0)
+            | jnp.any(statics.w_anti_pref[g] != 0)
+            | jnp.any(statics.a_aff_req[g])
+            | jnp.any(statics.a_anti_req[g])
+            | jnp.any(statics.s_match[g] & (ip_eff_s >= 0))
         )
-        score += w_[6] * maxabs_normalize(raw_ipa, m_all)
+        score += w_[6] * jax.lax.cond(
+            has_ip, _ipa_term, lambda _: jnp.zeros(n, score.dtype), None
+        )
     # PodTopologySpread soft constraints, registry weight 2 by default
     if f.spread_soft and t_cap:
-        score += w_[7] * topology_spread_score(cnt_sub, statics.spread_soft[g], m_all)
+        # zero soft terms → raw 0 → the inverse-min-max degenerates to the
+        # constant MAX_NODE_SCORE; skip the [Tc, N] stream for such pods
+        score += w_[7] * jax.lax.cond(
+            jnp.any(statics.spread_soft[g] > 0),
+            lambda _: topology_spread_score(cnt_sub, statics.spread_soft[g], m_all),
+            lambda _: jnp.full(n, MAX_NODE_SCORE, score.dtype),
+            None,
+        )
     # SelectorSpread (default workload/service spreading, weight 1)
     if f.selector_spread and t_cap:
-        score += w_[8] * selector_spread_score(
-            cnt_sub, statics.ss_host[g], statics.ss_zone[g], m_all
+        # zero ss terms → max counts 0 → constant MAX_NODE_SCORE
+        score += w_[8] * jax.lax.cond(
+            jnp.any(statics.ss_host[g]) | jnp.any(statics.ss_zone[g]),
+            lambda _: selector_spread_score(
+                cnt_sub, statics.ss_host[g], statics.ss_zone[g], m_all
+            ),
+            lambda _: jnp.full(n, MAX_NODE_SCORE, score.dtype),
+            None,
         )
     # ImageLocality + NodePreferAvoidPods (static per group)
     if f.static_score:
@@ -612,35 +646,64 @@ def filter_and_score(
     m_bind = m_att & statics.vol_mask[g]
 
     # Open-Local storage (plugin Filter, open-local.go:50-91): pods that need
-    # storage only fit nodes carrying the storage annotation
+    # storage only fit nodes carrying the storage annotation.  The planning
+    # kernels stream [N, V]/[N, SD] planes — a large share of the per-step
+    # cost at 100k nodes — so a storage-free pod skips them via lax.cond
+    # (exact: with zero claims lvm_plan/device_plan return all-fits + zero
+    # allocations, so the branch outputs are identical constants).
     m_storage = m_bind
     if f.storage:
         needs_storage = jnp.any(lvm_size > 0) | jnp.any(dev_size > 0)
-        lvm_ok, lvm_alloc = lvm_plan(
-            state.vg_free, statics.vg_name_id, lvm_size, lvm_vg
-        )
-        dev_ok, dev_take, dev_tight = device_plan(
-            state.sdev_free, statics.sdev_cap, statics.sdev_media, dev_size, dev_media
-        )
-        storage_ok = jnp.where(
-            needs_storage, statics.has_storage & lvm_ok & dev_ok, True
+
+        def _storage_plan(_):
+            lvm_ok, lvm_alloc = lvm_plan(
+                state.vg_free, statics.vg_name_id, lvm_size, lvm_vg
+            )
+            dev_ok, dev_take, dev_tight = device_plan(
+                state.sdev_free,
+                statics.sdev_cap,
+                statics.sdev_media,
+                dev_size,
+                dev_media,
+            )
+            return statics.has_storage & lvm_ok & dev_ok, lvm_alloc, dev_take, dev_tight
+
+        def _storage_skip(_):
+            return (
+                jnp.ones(n, bool),
+                jnp.zeros_like(statics.vg_cap),
+                jnp.zeros(statics.sdev_cap.shape, bool),
+                jnp.zeros(n, statics.vg_cap.dtype),
+            )
+
+        storage_ok, lvm_alloc, dev_take, dev_tight = jax.lax.cond(
+            needs_storage, _storage_plan, _storage_skip, None
         )
         m_storage = m_bind & storage_ok
     else:
         lvm_alloc = jnp.zeros_like(statics.vg_cap)
         dev_take = jnp.zeros(statics.sdev_cap.shape, bool)
 
-    # GPU share (plugin Filter, open-gpu-share.go:51-81)
+    # GPU share (plugin Filter, open-gpu-share.go:51-81); same per-pod skip —
+    # non-GPU pods fit everywhere with zero shares by gpu_plan's own contract
     m_gpu = m_storage
     if f.gpu:
-        gpu_ok, gpu_shares = gpu_plan(
-            state.gpu_free,
-            statics.gpu_dev_exists,
-            statics.gpu_total,
-            gpu_mem,
-            gpu_count,
-            gpu_preset,
-        )
+        is_gpu_pod = gpu_mem > 0
+
+        def _gpu_plan(_):
+            return gpu_plan(
+                state.gpu_free,
+                statics.gpu_dev_exists,
+                statics.gpu_total,
+                gpu_mem,
+                gpu_count,
+                gpu_preset,
+            )
+
+        def _gpu_skip(_):
+            return jnp.ones(n, bool), jnp.zeros_like(state.gpu_free)
+
+        gpu_ok, gpu_shares = jax.lax.cond(is_gpu_pod, _gpu_plan, _gpu_skip, None)
         m_gpu = m_storage & gpu_ok
     else:
         gpu_shares = jnp.zeros_like(state.gpu_free)
@@ -649,21 +712,42 @@ def filter_and_score(
     # minimum taken over nodes passing the pod's static filters
     m_spread = m_gpu
     if f.spread_hard and t_cap:
-        m_spread = m_gpu & topology_spread_filter(
-            cnt_sub, valid_sub, statics.spread_hard[g], m_static
+        # maxSkew 0 = inactive on every term → all-True; per-pod skip of
+        # the [Tc, N] streams (lax.cond)
+        m_spread = m_gpu & jax.lax.cond(
+            jnp.any(statics.spread_hard[g] > 0),
+            lambda _: topology_spread_filter(
+                cnt_sub, valid_sub, statics.spread_hard[g], m_static
+            ),
+            lambda _: jnp.ones(n, bool),
+            None,
         )
 
     m_all = m_spread
     if f.interpod_req and t_cap:
         ip_eff = jnp.where(tvalid, statics.ip_of[tsafe], -1)
-        m_all = m_spread & interpod_filter(
-            cnt_sub,
-            take_rows(state.cnt_own_anti, ip_eff),
-            valid_sub,
-            jnp.where(tvalid, state.cnt_total[tsafe], 0.0),
-            statics.s_match[g],
-            statics.a_aff_req[g],
-            statics.a_anti_req[g],
+
+        def _ip_filter(_):
+            return interpod_filter(
+                cnt_sub,
+                take_rows(state.cnt_own_anti, ip_eff),
+                valid_sub,
+                jnp.where(tvalid, state.cnt_total[tsafe], 0.0),
+                statics.s_match[g],
+                statics.a_aff_req[g],
+                statics.a_anti_req[g],
+            )
+
+        # the filter can bite a pod with NO required terms of its own when
+        # an existing pod's anti-affinity selects it (sym_violated), so the
+        # skip predicate includes s_match on interpod-owned terms
+        touches_ip = (
+            jnp.any(statics.a_aff_req[g])
+            | jnp.any(statics.a_anti_req[g])
+            | jnp.any(statics.s_match[g] & tvalid & (ip_eff >= 0))
+        )
+        m_all = m_spread & jax.lax.cond(
+            touches_ip, _ip_filter, lambda _: jnp.ones(n, bool), None
         )
     feasible = jnp.any(m_all)
 
@@ -673,14 +757,24 @@ def filter_and_score(
     score = score_pod(statics, state, g, req, m_all, flags)
     storage_term = 0.0
     if f.storage:
-        storage_raw = open_local_score(
-            lvm_alloc,
-            statics.vg_cap,
-            dev_tight,
-            jnp.sum(lvm_size > 0),
-            jnp.sum(dev_size > 0),
+        # zero claims → open_local_score is all-zero → the normalized term
+        # is exactly 0 everywhere; skip the [N, V] streams for such pods
+        def _storage_term(_):
+            storage_raw = open_local_score(
+                lvm_alloc,
+                statics.vg_cap,
+                dev_tight,
+                jnp.sum(lvm_size > 0),
+                jnp.sum(dev_size > 0),
+            )
+            return statics.score_w[10] * minmax_normalize(storage_raw, m_all)
+
+        storage_term = jax.lax.cond(
+            needs_storage,
+            _storage_term,
+            lambda _: jnp.zeros(n, statics.vg_cap.dtype),
+            None,
         )
-        storage_term = statics.score_w[10] * minmax_normalize(storage_raw, m_all)
 
     return StepEval(
         m_static=m_static,
@@ -823,26 +917,65 @@ def _run_scan(statics: StaticArrays, state: SchedState, pods, flags: StepFlags =
     return jax.lax.scan(partial(schedule_step, statics, flags=flags), state, pods)
 
 
-# -- chunked + term-row-sliced serial scan ----------------------------------
+# -- chunked + sliced serial scan -------------------------------------------
 #
-# At 100k nodes x thousands of interned terms, each scan step's count-plane
-# reads/writes touch [T, N]-scale memory and dominate the per-pod cost
-# (~172 pods/s at the north-star shape, BENCH_r04).  But one pod only ever
-# touches its GROUP's few term rows, and consecutive pods overwhelmingly
-# share a group — so the scan runs in chunks that carry ONLY the union of
-# their pods' term rows (a [rows<=256, N] plane instead of [T, N]), with
-# one gather + one in-place scatter per rows-change.  The same compaction
-# the bulk engine's `_chunk_runs` applies to rounds (rounds.py), applied to
-# the serial referee.  Placements are bit-identical: a step reads/writes
-# term rows only through `statics.g_terms[g]`, which is remapped onto the
-# sliced axis.
+# At 100k nodes x thousands of interned terms, each scan step's memory
+# traffic dominates the per-pod cost (~172 pods/s at the north-star shape,
+# BENCH_r04): the [T, N] count-plane reads/writes AND the per-step `arr[g]`
+# row gathers from six [G, N] statics planes (profiled at ~1 GB/s effective
+# on the tunneled backend).  But one pod only ever touches its GROUP's few
+# term rows, and consecutive pods overwhelmingly share a group — so the
+# scan runs in chunks that carry ONLY (a) the union of their pods' term
+# rows (a [rows<=256, N] count plane instead of [T, N]; one gather + one
+# in-place scatter per context change) and (b) the chunk's group rows of
+# every group-indexed statics array (a [<=64, N] plane instead of
+# [G=1000, N]).  The same compaction the bulk engine's `_chunk_runs`
+# applies to rounds (rounds.py), applied to the serial referee.
+# Placements are bit-identical: a step reads/writes term rows only through
+# `statics.g_terms[g]` and group rows only through the remapped pod `g`.
 
 _SCAN_CHUNK = 1024  # pods per dispatch (pow2-padded tail; bounded shapes)
 _SCAN_ROW_BUDGET = 224  # target carried term rows (pow2-padded, like rounds)
+_SCAN_GROUP_BUDGET = 64  # target carried group rows (pow2-padded)
+
+#: statics fields whose LEADING axis is the group axis — the chunked scan
+#: slices these to the chunk's group set, turning every per-step `arr[g]`
+#: row gather (six of them are [G, N] planes) into a row pick from a
+#: [<=64, ...] array.  Keep in sync with StaticArrays / statics_from.
+_GROUP_FIELDS = (
+    "static_mask",
+    "vol_mask",
+    "node_pref",
+    "taint_intol",
+    "static_score",
+    "avoid_pen",
+    "g_terms",
+    "s_match",
+    "a_aff_req",
+    "a_anti_req",
+    "w_aff_pref",
+    "w_anti_pref",
+    "spread_hard",
+    "spread_soft",
+    "ss_host",
+    "ss_zone",
+    "ports_req",
+    "vol_rw_req",
+    "vol_ro_req",
+    "vol_att_req",
+)
 
 
 def _pow2_up(x: int) -> int:
     return 1 << max(x - 1, 0).bit_length()
+
+
+@jax.jit
+def _gather_rows_tuple(arrs, gs):
+    """Row-gather each array in `arrs` (one fused device call per slice
+    context; passing whole StaticArrays through jit would copy every
+    untouched field on the way out)."""
+    return tuple(a[gs] for a in arrs)
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -922,11 +1055,17 @@ def run_scan_chunked(
         or flags.interpod_req
         or flags.interpod_pref
     )
-    sliceable = bool(t) and use_topo and _pow2_up(min(t, row_budget)) < t
-    g_terms_host = _compact_terms(tensors)[0] if sliceable else None
+    row_sliceable = bool(t) and use_topo and _pow2_up(min(t, row_budget)) < t
+    g_total = int(statics.static_mask.shape[0])
+    group_sliceable = _pow2_up(min(g_total, _SCAN_GROUP_BUDGET)) < g_total
+    g_terms_host = (
+        _compact_terms(tensors)[0] if (row_sliceable or group_sliceable) else None
+    )
 
-    # active slice context: (rows_p, sliced statics, full planes set aside)
-    ctx_rows = None
+    # active slice context: the (group set, term-row set) the current
+    # eff_statics / sliced count planes were built for
+    ctx_key = None
+    ctx_rows = None  # term rows carried in the sliced count planes
     full_match = full_total = None
 
     def flush(state):
@@ -943,35 +1082,68 @@ def run_scan_chunked(
 
     outs_dev = []
     eff_statics = statics
+    inv_g = None
     for c0 in range(0, n, chunk):
         c1 = min(c0 + chunk, n)
-        seg = pad_pods_pow2(
-            tuple(arr[c0:c1] for arr in pods), _pow2_up(c1 - c0)
-        )
+        gs = np.unique(groups[c0:c1])
+        gs_p = None
+        if group_sliceable and len(gs) <= _SCAN_GROUP_BUDGET:
+            # duplicate padding is fine here: the group axis is read-only
+            pad = _pow2_up(len(gs)) - len(gs)
+            gs_p = np.concatenate([gs, np.repeat(gs[-1:], pad)]).astype(np.int32)
         rows_p = None
-        if sliceable:
-            gs = np.unique(groups[c0:c1])
+        if row_sliceable:
             rows = np.unique(g_terms_host[gs])
             rows = rows[rows >= 0]
             if len(rows) <= row_budget:
                 rows_p = pad_row_ids(np.sort(rows), t)
-        if rows_p is None:
+        key = (
+            None if gs_p is None else gs_p.tobytes(),
+            None if rows_p is None else rows_p.tobytes(),
+        )
+        if key != ctx_key:
+            # consecutive chunks usually share a (group, rows) context —
+            # re-slice only when it actually changes
             state = flush(state)
             eff_statics = statics
-            state, outs = call(statics, state, seg, flags)
-        else:
-            if ctx_rows is None or not np.array_equal(rows_p, ctx_rows):
-                # consecutive chunks usually share a group set — re-slice
-                # only when the row union actually changes
-                state = flush(state)
+
+            def _remap_terms(gm, rows):
+                # remap term ids onto the sliced row axis — only for the
+                # group rows actually dispatched
                 inv = np.zeros(t, np.int32)
-                inv[rows_p] = np.arange(len(rows_p), dtype=np.int32)
-                g_terms_chunk = np.where(
-                    g_terms_host >= 0, inv[np.clip(g_terms_host, 0, None)], -1
-                ).astype(np.int32)
+                inv[rows] = np.arange(len(rows), dtype=np.int32)
+                return np.where(gm >= 0, inv[np.clip(gm, 0, None)], -1).astype(
+                    np.int32
+                )
+
+            if gs_p is not None:
+                gs_dev = jnp.asarray(gs_p)
+                fields = _GROUP_FIELDS
+                if rows_p is not None:
+                    # g_terms gets the host-remapped copy below — skip its
+                    # device gather
+                    fields = tuple(f for f in fields if f != "g_terms")
+                sliced = _gather_rows_tuple(
+                    tuple(getattr(statics, f) for f in fields), gs_dev
+                )
+                eff_statics = eff_statics._replace(**dict(zip(fields, sliced)))
+                if rows_p is not None:
+                    eff_statics = eff_statics._replace(
+                        g_terms=jnp.asarray(
+                            _remap_terms(g_terms_host[gs_p], rows_p)
+                        )
+                    )
+                inv_g = np.zeros(g_total, np.int32)
+                inv_g[gs_p] = np.arange(len(gs_p), dtype=np.int32)
+            else:
+                inv_g = None
+                if rows_p is not None:
+                    eff_statics = eff_statics._replace(
+                        g_terms=jnp.asarray(_remap_terms(g_terms_host, rows_p))
+                    )
+            if rows_p is not None:
                 ip_of = interpod_term_index(tensors)
-                eff_statics = statics._replace(
-                    g_terms=jnp.asarray(g_terms_chunk),
+                eff_statics = eff_statics._replace(
                     term_topo=jnp.asarray(tensors.term_topo_key[rows_p]),
                     ip_of=jnp.asarray(ip_of[rows_p]),
                 )
@@ -982,7 +1154,12 @@ def run_scan_chunked(
                     cnt_total=state.cnt_total[rows_dev],
                 )
                 ctx_rows = rows_p
-            state, outs = call(eff_statics, state, seg, flags)
+            ctx_key = key
+        seg_arrays = [arr[c0:c1] for arr in pods]
+        if inv_g is not None:
+            seg_arrays[0] = inv_g[np.asarray(seg_arrays[0])]
+        seg = pad_pods_pow2(tuple(seg_arrays), _pow2_up(c1 - c0))
+        state, outs = call(eff_statics, state, seg, flags)
         # keep outputs on device: a per-chunk device_get would sync the
         # tunnel once per chunk; all dispatches queue first and one
         # batched transfer materializes everything afterwards

@@ -67,7 +67,8 @@ def main() -> int:
         tail = proc.stdout.strip().splitlines()
         summary = tail[-1] if tail else ""
         for key in totals:
-            m = re.search(rf"(\d+) {key}", summary)
+            # pytest prints singular forms too ("1 error in 0.5s")
+            m = re.search(rf"(\d+) {key.rstrip('s')}s?", summary)
             if m:
                 totals[key] += int(m.group(1))
         ok = proc.returncode in (0, 5)  # 5: no tests collected (e.g. --fast)
