@@ -801,6 +801,70 @@ def _round_place_many(
     )
 
 
+def rounds_scan_sliced(
+    statics: StaticArrays,
+    state: SchedState,
+    rows,  # [r_pad] term rows carried by this chunk
+    g_terms_c,  # [G, Tc] term incidence remapped onto the sliced row axis
+    term_topo_c,  # [r_pad]
+    ip_of_c,  # [r_pad]
+    seg_pods,
+    ks,
+    n_domains: int,
+    k_cap: int,
+    flags: StepFlags = StepFlags(),
+    quota: bool = False,
+    self_aff: bool = False,
+    ext_mats: bool = False,
+):
+    """`rounds_scan` with the count-plane row slice/unslice INSIDE the
+    traced computation: one device call per chunk does gather → rounds →
+    in-place scatter-back, where the eager formulation paid ~6 tunneled
+    RPCs per chunk (each with fixed wire latency that dominated the
+    stretch cost at 100k nodes — the device itself was ~98% idle).
+    Unjitted; the local engine jits it (`_round_place_many_sliced`), the
+    sharded engine with mesh shardings."""
+    st_c = statics._replace(
+        g_terms=g_terms_c, term_topo=term_topo_c, ip_of=ip_of_c
+    )
+    full_match, full_total = state.cnt_match, state.cnt_total
+    state_c = state._replace(
+        cnt_match=full_match[rows], cnt_total=full_total[rows]
+    )
+    state_c, outs = rounds_scan(
+        st_c, state_c, seg_pods, ks, n_domains, k_cap, flags, quota,
+        self_aff, ext_mats,
+    )
+    state_out = state_c._replace(
+        cnt_match=full_match.at[rows].set(state_c.cnt_match),
+        cnt_total=full_total.at[rows].set(state_c.cnt_total),
+    )
+    return state_out, outs
+
+
+@partial(jax.jit, static_argnums=(8, 9, 10, 11, 12, 13), donate_argnums=(1,))
+def _round_place_many_sliced(
+    statics,
+    state,
+    rows,
+    g_terms_c,
+    term_topo_c,
+    ip_of_c,
+    seg_pods,
+    ks,
+    n_domains: int,
+    k_cap: int,
+    flags: StepFlags = StepFlags(),
+    quota: bool = False,
+    self_aff: bool = False,
+    ext_mats: bool = False,
+):
+    return rounds_scan_sliced(
+        statics, state, rows, g_terms_c, term_topo_c, ip_of_c, seg_pods,
+        ks, n_domains, k_cap, flags, quota, self_aff, ext_mats,
+    )
+
+
 class RoundsEngine(Engine):
     """Engine that places eligible same-spec pod runs in bulk rounds and
     routes everything else through the inherited serial scan.
@@ -952,6 +1016,20 @@ class RoundsEngine(Engine):
             self_aff, ext_mats,
         )
 
+    def _bulk_call_sliced(
+        self, statics, state, rows, g_terms_c, term_topo_c, ip_of_c,
+        seg_pods, ks, n_domains, k_cap, flags,
+        quota=False, self_aff=False, ext_mats=False,
+    ):
+        """Dispatch one row-sliced multi-round bulk call — slice, rounds
+        and scatter-back fused into one device call (overridden by the
+        sharded subclass to run on a mesh)."""
+        return _round_place_many_sliced(
+            statics, state, rows, g_terms_c, term_topo_c, ip_of_c,
+            seg_pods, ks, n_domains, k_cap, flags, quota, self_aff,
+            ext_mats,
+        )
+
     def _run_scan_segment(self, statics, state, pods, a, b, flags):
         # chunked + term-row-sliced (scan.run_scan_chunked): serial
         # fallback segments inside a bulk run get the same count-plane
@@ -1064,34 +1142,18 @@ class RoundsEngine(Engine):
 
         if rows_p is None:
             state, outs = self._bulk_call(
-                statics, state, seg_pods, jnp.asarray(ks),
+                statics, state, seg_pods, ks,
                 tensors.n_domains, k_cap, flags, quota, self_aff, ext_mats,
             )
         else:
+            from .scan import remap_term_ids
+
             g_terms, term_topo, ip_of = self._host_term_maps(tensors)
-            inv = np.zeros(tensors.n_terms, np.int32)
-            inv[rows_p] = np.arange(len(rows_p), dtype=np.int32)
-            g_terms_chunk = np.where(
-                g_terms >= 0, inv[np.clip(g_terms, 0, None)], -1
-            ).astype(np.int32)
-            rows_dev = jnp.asarray(rows_p)
-            st_chunk = statics._replace(
-                g_terms=jnp.asarray(g_terms_chunk),
-                term_topo=jnp.asarray(term_topo[rows_p]),
-                ip_of=jnp.asarray(ip_of[rows_p]),
-            )
-            state_chunk = state._replace(
-                cnt_match=state.cnt_match[rows_dev],
-                cnt_total=state.cnt_total[rows_dev],
-            )
-            full_match, full_total = state.cnt_match, state.cnt_total
-            state_chunk, outs = self._bulk_call(
-                st_chunk, state_chunk, seg_pods, jnp.asarray(ks),
+            g_terms_chunk = remap_term_ids(g_terms, rows_p, tensors.n_terms)
+            state, outs = self._bulk_call_sliced(
+                statics, state, rows_p, g_terms_chunk,
+                term_topo[rows_p], ip_of[rows_p], seg_pods, ks,
                 tensors.n_domains, k_cap, flags, quota, self_aff, ext_mats,
-            )
-            state = state_chunk._replace(
-                cnt_match=_scatter_rows(full_match, rows_dev, state_chunk.cnt_match),
-                cnt_total=_scatter_rows(full_total, rows_dev, state_chunk.cnt_total),
             )
         return state, outs
 
@@ -1209,9 +1271,21 @@ class RoundsEngine(Engine):
                     statics, state, chunk, rows_p, pods, tensors, flags,
                     quota, self_aff, ext_mats,
                 )
+                # start the device→host copies NOW: the transfers ride the
+                # tunnel concurrently with later dispatches, so the fetch
+                # below waits on completion instead of paying one serial
+                # round-trip per array
+                for o in outs_dev:
+                    if hasattr(o, "copy_to_host_async"):
+                        o.copy_to_host_async()
                 pending.append((chunk, outs_dev))
-            for chunk, outs_dev in pending:
-                hosts = tuple(np.asarray(o) for o in jax.device_get(outs_dev))
+            # ONE device_get for every chunk: each call pays a full tunnel
+            # round-trip (~100ms on the tunneled backend) regardless of how
+            # much data it moves, and the device queue has already drained
+            # by the first fetch
+            fetched = jax.device_get([outs for _, outs in pending])
+            for (chunk, _), outs_host in zip(pending, fetched):
+                hosts = tuple(np.asarray(o) for o in outs_host)
                 if ext_mats:
                     self._record_chunk_mats(
                         chunk, hosts, nodes, reasons, lvm_alloc, dev_take,

@@ -1005,6 +1005,18 @@ def pad_pods_pow2(seg, target: int):
     return tuple(out)
 
 
+def remap_term_ids(g_terms: np.ndarray, rows: np.ndarray, t: int) -> np.ndarray:
+    """Remap a [., Tc] term-incidence matrix onto the sliced row axis given
+    by `rows` (-1 padding passes through).  Single home for the inverse
+    remap both the chunked scan and the bulk chunks rely on — the sliced
+    and bulk paths must never drift on the padding convention."""
+    inv = np.zeros(t, np.int32)
+    inv[rows] = np.arange(len(rows), dtype=np.int32)
+    return np.where(g_terms >= 0, inv[np.clip(g_terms, 0, None)], -1).astype(
+        np.int32
+    )
+
+
 def pad_row_ids(rows: np.ndarray, t: int):
     """Pad a sorted term-row list to a power of two with DISTINCT unused
     term ids (their values ride along unchanged; duplicates would let a
@@ -1058,9 +1070,7 @@ def run_scan_chunked(
     row_sliceable = bool(t) and use_topo and _pow2_up(min(t, row_budget)) < t
     g_total = int(statics.static_mask.shape[0])
     group_sliceable = _pow2_up(min(g_total, _SCAN_GROUP_BUDGET)) < g_total
-    g_terms_host = (
-        _compact_terms(tensors)[0] if (row_sliceable or group_sliceable) else None
-    )
+    g_terms_host = _compact_terms(tensors)[0] if row_sliceable else None
 
     # active slice context: the (group set, term-row set) the current
     # eff_statics / sliced count planes were built for
@@ -1106,16 +1116,6 @@ def run_scan_chunked(
             # re-slice only when it actually changes
             state = flush(state)
             eff_statics = statics
-
-            def _remap_terms(gm, rows):
-                # remap term ids onto the sliced row axis — only for the
-                # group rows actually dispatched
-                inv = np.zeros(t, np.int32)
-                inv[rows] = np.arange(len(rows), dtype=np.int32)
-                return np.where(gm >= 0, inv[np.clip(gm, 0, None)], -1).astype(
-                    np.int32
-                )
-
             if gs_p is not None:
                 gs_dev = jnp.asarray(gs_p)
                 fields = _GROUP_FIELDS
@@ -1130,7 +1130,7 @@ def run_scan_chunked(
                 if rows_p is not None:
                     eff_statics = eff_statics._replace(
                         g_terms=jnp.asarray(
-                            _remap_terms(g_terms_host[gs_p], rows_p)
+                            remap_term_ids(g_terms_host[gs_p], rows_p, t)
                         )
                     )
                 inv_g = np.zeros(g_total, np.int32)
@@ -1139,7 +1139,9 @@ def run_scan_chunked(
                 inv_g = None
                 if rows_p is not None:
                     eff_statics = eff_statics._replace(
-                        g_terms=jnp.asarray(_remap_terms(g_terms_host, rows_p))
+                        g_terms=jnp.asarray(
+                            remap_term_ids(g_terms_host, rows_p, t)
+                        )
                     )
             if rows_p is not None:
                 ip_of = interpod_term_index(tensors)

@@ -249,6 +249,38 @@ def build_sharded_rounds(
     )
 
 
+def build_sharded_rounds_sliced(
+    mesh: Mesh,
+    n_domains: int,
+    k_cap: int,
+    flags: StepFlags,
+    quota: bool = False,
+    self_aff: bool = False,
+    ext_mats: bool = False,
+):
+    """Compile the fused slice→rounds→scatter bulk call over `mesh` (the
+    sharded analog of `rounds._round_place_many_sliced`)."""
+    from ..engine.rounds import rounds_scan_sliced
+
+    st_spec = statics_sharding(mesh)
+    state_spec = state_sharding(mesh)
+    rep = NamedSharding(mesh, P())
+
+    def fn(statics, state, rows, g_terms_c, term_topo_c, ip_of_c, seg_pods, ks):
+        return rounds_scan_sliced(
+            statics, state, rows, g_terms_c, term_topo_c, ip_of_c,
+            seg_pods, ks, n_domains, k_cap, flags, quota, self_aff,
+            ext_mats,
+        )
+
+    return jax.jit(
+        fn,
+        in_shardings=(st_spec, state_spec, rep, rep, rep, rep, None, rep),
+        out_shardings=(state_spec, (rep, rep, rep, rep)),
+        donate_argnums=(1,),
+    )
+
+
 class ShardedRoundsEngine(_MeshMixin, RoundsEngine):
     """Bulk rounds engine with every node-indexed array laid out over a
     device mesh: rounds, serial fallbacks and leftovers all execute under
@@ -281,3 +313,16 @@ class ShardedRoundsEngine(_MeshMixin, RoundsEngine):
                 self.mesh, n_domains, k_cap, flags, quota, self_aff, ext_mats
             )
         return fn(statics, state, seg_pods, ks)
+
+    def _bulk_call_sliced(
+        self, statics, state, rows, g_terms_c, term_topo_c, ip_of_c,
+        seg_pods, ks, n_domains, k_cap, flags,
+        quota=False, self_aff=False, ext_mats=False,
+    ):
+        key = ("sliced", n_domains, k_cap, flags, quota, self_aff, ext_mats)
+        fn = self._bulk_jits.get(key)
+        if fn is None:
+            fn = self._bulk_jits[key] = build_sharded_rounds_sliced(
+                self.mesh, n_domains, k_cap, flags, quota, self_aff, ext_mats
+            )
+        return fn(statics, state, rows, g_terms_c, term_topo_c, ip_of_c, seg_pods, ks)
