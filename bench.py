@@ -25,7 +25,10 @@ Prints ONE JSON line:
    "plan_s": warm plan search (tensorize+base+probes, unverified),
    "plan_verified_s": warm plan incl. the fresh full-placement verification,
    "plan_cold_s": first-call wall incl. compilation,
-   "plan_nodes_added": N}
+   "plan_nodes_added": N,
+   "hard_point_s"/"hard_point_rate", "matrix_point_s"/"matrix_point_rate",
+   "big_point_s"/"big_point_nodes"/"big_point_placed":   # 400k x 1M, runs
+   LAST (docs/memory.md measured row)}
 vs_target > 1 means the target is met on this chip alone (the target names
 a v5e-8; the sharded engines split the node axis over chips, so single-chip
 is the conservative bound).
@@ -33,8 +36,8 @@ is the conservative bound).
 Env knobs: SIMTPU_BENCH_NODES (default 100000), SIMTPU_BENCH_PODS (default
 1000000), SIMTPU_BENCH_SCAN_PODS (scan-rate slice, default 2000),
 SIMTPU_BENCH_BASELINE_PODS (default 300), SIMTPU_BENCH_SMALL=0 /
-SIMTPU_BENCH_HARD=0 / SIMTPU_BENCH_MATRIX=0 / SIMTPU_BENCH_PLAN=0 to skip
-the extra points.
+SIMTPU_BENCH_HARD=0 / SIMTPU_BENCH_MATRIX=0 / SIMTPU_BENCH_PLAN=0 /
+SIMTPU_BENCH_BIG=0 to skip the extra points.
 """
 
 from __future__ import annotations
@@ -228,6 +231,29 @@ def reason_histogram(nodes, reasons) -> dict:
     }
 
 
+def big_point() -> dict:
+    """The beyond-headline scale point (docs/memory.md measured row): 400k
+    nodes x 1M pods on one chip — fits only because constant [G, N] planes
+    collapse to [1, N] rows (statics_from).  Runs in its own frame and
+    LAST, so the GB-scale tensors (and the device statics memoized on
+    them) are unreachable while the headline points run."""
+    tensors, batch = build_problem(400_000, 1_000_000)[:2]
+    wall, _, nodes, reasons = time_bulk(tensors, batch)
+    placed = int((nodes >= 0).sum())
+    total = len(batch.group)
+    note(
+        f"big-point nodes=400000 pods={total} bulk-wall={wall:.2f}s "
+        f"rate={total / wall:.0f} pods/s placed={placed}"
+    )
+    for reason, cnt in reason_histogram(nodes, reasons).items():
+        note(f"  {cnt:8d}  {reason}")
+    return {
+        "big_point_s": round(wall, 2),
+        "big_point_nodes": 400_000,
+        "big_point_placed": placed,
+    }
+
+
 def time_plan():
     """The min-node-add plan at north-star scale: a 100k-node cluster whose
     Open-Local capacity strands ~28k LVM pods of a 1M-pod selector-free mix,
@@ -417,6 +443,12 @@ def main() -> int:
             except Exception as exc:  # noqa: BLE001 - report, keep the line
                 note(f"plan bench failed: {type(exc).__name__}: {exc}")
                 record["plan_error"] = f"{type(exc).__name__}: {exc}"
+        if os.environ.get("SIMTPU_BENCH_BIG", "1") != "0":
+            try:
+                record.update(big_point())
+            except Exception as exc:  # noqa: BLE001 - report, keep the line
+                note(f"big point failed: {type(exc).__name__}: {exc}")
+                record["big_point_error"] = f"{type(exc).__name__}: {exc}"
     print(json.dumps(record))
     # a failed plan phase keeps the placement record but signals the
     # failure through the exit status (drivers record both)

@@ -233,18 +233,21 @@ def statics_from(tensors: ClusterTensors, sched_config=None) -> StaticArrays:
     )
 
     def dev(host_arr, dtype=None):
-        """Device-resident copy; constant planes materialize ON DEVICE.
-        The [G, N] score planes are all-zero (and vol_mask all-True) for
-        most problems — on a tunneled TPU, shipping them as dense host
-        buffers costs tens of seconds per fresh tensorization, while a
-        device-side fill is a dispatch."""
+        """Device-resident copy; CONSTANT [G, N] planes collapse to one
+        [1, N] row.  The score planes are all-zero (and vol_mask all-True)
+        for most problems — shipping them as dense host buffers costs tens
+        of seconds of tunnel transfer, and even device-side fills cost
+        G x N x 4 B of HBM each (6.4 GB at 400k nodes x 1000 groups, the
+        difference between fitting one chip and OOM).  Every consumer
+        reads rows via `arr[g]`, and XLA's gather clamp maps any g onto
+        the single constant row, so the collapse is read-transparent."""
         dt = dtype or host_arr.dtype
-        if host_arr.size:
+        if host_arr.size and host_arr.ndim == 2:
             first = host_arr.flat[0]
             if not host_arr.any():
-                return jnp.zeros(host_arr.shape, dt)
+                return jnp.zeros((1, host_arr.shape[1]), dt)
             if host_arr.dtype == bool and first and host_arr.all():
-                return jnp.ones(host_arr.shape, dt)
+                return jnp.ones((1, host_arr.shape[1]), dt)
         return jnp.asarray(host_arr, dt)
 
     statics = StaticArrays(
@@ -1068,7 +1071,7 @@ def run_scan_chunked(
         or flags.interpod_pref
     )
     row_sliceable = bool(t) and use_topo and _pow2_up(min(t, row_budget)) < t
-    g_total = int(statics.static_mask.shape[0])
+    g_total = len(tensors.groups)  # statics planes may be [1, N]-collapsed
     group_sliceable = _pow2_up(min(g_total, _SCAN_GROUP_BUDGET)) < g_total
     g_terms_host = _compact_terms(tensors)[0] if row_sliceable else None
 
@@ -1123,6 +1126,11 @@ def run_scan_chunked(
                     # g_terms gets the host-remapped copy below — skip its
                     # device gather
                     fields = tuple(f for f in fields if f != "g_terms")
+                # constant planes are already [1, N]-collapsed (row-clamp
+                # reads); gathering them would just materialize copies
+                fields = tuple(
+                    f for f in fields if getattr(statics, f).shape[0] > 1
+                )
                 sliced = _gather_rows_tuple(
                     tuple(getattr(statics, f) for f in fields), gs_dev
                 )
