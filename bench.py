@@ -54,7 +54,7 @@ def note(msg):
     print(f"# {msg}", file=sys.stderr, flush=True)
 
 
-def build_problem(n_nodes: int, n_pods: int, mix: str = "north"):
+def build_problem(n_nodes: int, n_pods: int, mix: str = "north", with_state: bool = True):
     from simtpu.core.tensorize import Tensorizer
     from simtpu.core.objects import set_label
     from simtpu import constants as C
@@ -116,6 +116,12 @@ def build_problem(n_nodes: int, n_pods: int, mix: str = "north"):
     tensors = tensorizer.freeze()
     tensorize_s = time.perf_counter() - t0
     note(f"tensorized in {tensorize_s:.1f}s")
+
+    if not with_state:
+        # big_point needs only (tensors, batch): the rounds engine builds
+        # its own state, and a discarded build_state at 400k nodes would
+        # transiently allocate multi-GB device buffers at the HBM edge
+        return tensors, batch
 
     statics = statics_from(tensors)
     r = tensors.alloc.shape[1]
@@ -237,7 +243,7 @@ def big_point() -> dict:
     collapse to [1, N] rows (statics_from).  Runs in its own frame and
     LAST, so the GB-scale tensors (and the device statics memoized on
     them) are unreachable while the headline points run."""
-    tensors, batch = build_problem(400_000, 1_000_000)[:2]
+    tensors, batch = build_problem(400_000, 1_000_000, with_state=False)
     wall, _, nodes, reasons = time_bulk(tensors, batch)
     placed = int((nodes >= 0).sum())
     total = len(batch.group)
@@ -336,7 +342,7 @@ def main() -> int:
         unplaced-reason histogram (no silent stranding on ANY point)."""
         if os.environ.get(env, "1") == "0" or not north_star:
             return
-        p_tensors, p_batch = build_problem(20_000, 100_000, mix=mix)[:2]
+        p_tensors, p_batch = build_problem(20_000, 100_000, mix=mix, with_state=False)
         wall, _, p_nodes, p_reasons = time_bulk(p_tensors, p_batch)
         placed = int((p_nodes >= 0).sum())
         total = len(p_batch.group)
@@ -450,9 +456,9 @@ def main() -> int:
                 note(f"big point failed: {type(exc).__name__}: {exc}")
                 record["big_point_error"] = f"{type(exc).__name__}: {exc}"
     print(json.dumps(record))
-    # a failed plan phase keeps the placement record but signals the
-    # failure through the exit status (drivers record both)
-    return 1 if "plan_error" in record else 0
+    # a failed plan or big-point phase keeps the placement record but
+    # signals the failure through the exit status (drivers record both)
+    return 1 if ("plan_error" in record or "big_point_error" in record) else 0
 
 
 if __name__ == "__main__":

@@ -61,7 +61,10 @@ def _assert_no_storage_gpu_overcommit(result):
                 assert dev["gpuUsedMemory"] <= dev["gpuTotalMemory"]
 
 
-@pytest.mark.parametrize("seed", [11, 22, 33, 77, 123])
+@pytest.mark.parametrize(
+    "seed",
+    [11, 22] + [pytest.param(s, marks=pytest.mark.slow) for s in (33, 77, 123)],
+)
 def test_scan_vs_bulk_equivalence_extended_resources(seed):
     """VERDICT r1 task 2: storage/GPU-demanding runs must flow through the
     bulk rounds path (not the serial fallback) and still agree with the
@@ -198,7 +201,10 @@ def _assert_anti_satisfied(result):
                 seen[ident].add(dom)
 
 
-@pytest.mark.parametrize("seed", [7, 19, 55, 91])
+@pytest.mark.parametrize(
+    "seed",
+    [7, 19] + [pytest.param(s, marks=pytest.mark.slow) for s in (55, 91)],
+)
 def test_scan_vs_bulk_hard_constraints(seed):
     """VERDICT r2 task 2: DoNotSchedule spread and required self-anti-affinity
     runs must ride the bulk path (domain-quota rounds), agree with the serial
@@ -260,7 +266,10 @@ def test_scan_vs_bulk_hard_constraints(seed):
         _assert_anti_satisfied(res)
 
 
-@pytest.mark.parametrize("seed", [13, 29, 47, 88, 131])
+@pytest.mark.parametrize(
+    "seed",
+    [13, 29] + [pytest.param(s, marks=pytest.mark.slow) for s in (47, 88, 131)],
+)
 def test_scan_vs_bulk_matrix_extended(seed):
     """VERDICT r3 task 1: multi-GPU (gpu_count > 1) and multi-claim LVM runs
     must ride the MATRIX bulk rounds (ext_mats), not the serial fallback,
@@ -395,7 +404,10 @@ def _assert_colocated(result):
         assert len(ds) == 1, (ident, sorted(ds))
 
 
-@pytest.mark.parametrize("seed", [17, 41, 73, 109])
+@pytest.mark.parametrize(
+    "seed",
+    [17, 41] + [pytest.param(s, marks=pytest.mark.slow) for s in (73, 109)],
+)
 def test_scan_vs_bulk_self_affinity(seed):
     """VERDICT r3 task 1: required colocate-with-self runs must ride the
     bulk path (self_aff rounds), stay within the equivalence band, and the
@@ -509,7 +521,10 @@ def test_scan_vs_bulk_preset_gpu_index():
         assert si[name][1] == bi[name][1] == "0-1", (name, si[name], bi[name])
 
 
-@pytest.mark.parametrize("seed", [101, 202, 303, 404])
+@pytest.mark.parametrize(
+    "seed",
+    [101, 202] + [pytest.param(s, marks=pytest.mark.slow) for s in (303, 404)],
+)
 def test_scan_vs_bulk_equivalence(seed):
     rng = np.random.default_rng(seed)
     n_nodes = int(rng.integers(8, 40))

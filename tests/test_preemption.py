@@ -6,6 +6,8 @@ from __future__ import annotations
 
 import os
 
+import pytest
+
 from simtpu.api import simulate
 
 # wall-clock envelopes only fire on dedicated perf runs (advisor low, round
@@ -308,6 +310,7 @@ def test_pdb_with_budget_does_not_penalize_covered_victim():
     assert placed.get("pricey") == "n0"
 
 
+@pytest.mark.slow
 def test_preemption_at_100k_scale():
     """VERDICT r3 task 2: preemption at the scale round 2 actually asked for
     — a placement log of 100,000 pods and >= 1,000 forced preemptions.

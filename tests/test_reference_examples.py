@@ -69,6 +69,7 @@ def _new_node(example_dir: str, name: str) -> dict:
 class TestDocumentedConfigs:
     """The two runs the reference README documents, through the full Applier."""
 
+    @pytest.mark.slow
     def test_simon_config_plans_all_apps(self, example_dir, monkeypatch):
         # config paths are relative to the reference checkout root
         monkeypatch.chdir(os.path.dirname(example_dir))
@@ -94,6 +95,7 @@ class TestDocumentedConfigs:
         ]
         check_result(_final_cluster(cluster, plan), apps, plan.result)
 
+    @pytest.mark.slow
     def test_gpushare_config_plans_all_apps(self, example_dir, monkeypatch):
         monkeypatch.chdir(os.path.dirname(example_dir))
         applier = Applier(
