@@ -185,3 +185,184 @@ class TestFullExpansion:
         assert by_kind["StatefulSet"] == 4
         assert by_kind["Job"] == 1
         assert by_kind.get("Pod", 0) == 1
+
+
+class TestUpstreamValidationRules:
+    """The scheduling-relevant slice of upstream API validation
+    (`pkg/utils/utils.go:516-529,654-668` → apis/core/validation): every
+    malformed shape below would otherwise change placement semantics
+    SILENTLY (a bad selector matches nothing, a bad operator no-matches,
+    an unparseable quantity corrupts capacity)."""
+
+    def _pod(self, **spec_extra):
+        pod = {
+            "metadata": {"name": "p", "namespace": "default"},
+            "spec": {"containers": [{"name": "c"}]},
+        }
+        pod["spec"].update(spec_extra)
+        return pod
+
+    def test_valid_pod_passes(self):
+        from simtpu.workloads.validate import validate_pod
+
+        validate_pod(
+            self._pod(
+                nodeSelector={"topology.kubernetes.io/zone": "z1"},
+                tolerations=[{"operator": "Exists", "effect": "NoSchedule"}],
+                topologySpreadConstraints=[
+                    {
+                        "maxSkew": 1,
+                        "topologyKey": "zone",
+                        "whenUnsatisfiable": "DoNotSchedule",
+                        "labelSelector": {"matchLabels": {"app": "x"}},
+                    }
+                ],
+            )
+        )
+
+    @pytest.mark.parametrize(
+        "mutate",
+        [
+            lambda p: p["metadata"].__setitem__("labels", {"app": "x" * 64}),
+            lambda p: p["metadata"].__setitem__("labels", {"-bad": "v"}),
+            lambda p: p["spec"].__setitem__("nodeSelector", {"k": "bad value!"}),
+            lambda p: p["spec"].__setitem__(
+                "tolerations", [{"operator": "Sometimes"}]
+            ),
+            lambda p: p["spec"].__setitem__(
+                "tolerations", [{"operator": "Exists", "value": "v"}]
+            ),
+            lambda p: p["spec"].__setitem__(
+                "tolerations", [{"operator": "Equal", "effect": "Eventually"}]
+            ),
+            lambda p: p["spec"].__setitem__(
+                "topologySpreadConstraints",
+                [{"maxSkew": 0, "topologyKey": "z", "whenUnsatisfiable": "DoNotSchedule"}],
+            ),
+            lambda p: p["spec"].__setitem__(
+                "topologySpreadConstraints",
+                [{"maxSkew": 1, "whenUnsatisfiable": "DoNotSchedule"}],
+            ),
+            lambda p: p["spec"].__setitem__(
+                "topologySpreadConstraints",
+                [{"maxSkew": 1, "topologyKey": "z", "whenUnsatisfiable": "Maybe"}],
+            ),
+            lambda p: p["spec"].__setitem__(
+                "affinity",
+                {
+                    "nodeAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": {
+                            "nodeSelectorTerms": [
+                                {"matchExpressions": [{"key": "k", "operator": "Near"}]}
+                            ]
+                        }
+                    }
+                },
+            ),
+            lambda p: p["spec"].__setitem__(
+                "affinity",
+                {
+                    "nodeAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": {
+                            "nodeSelectorTerms": [
+                                {
+                                    "matchExpressions": [
+                                        {"key": "k", "operator": "Gt", "values": ["x"]}
+                                    ]
+                                }
+                            ]
+                        }
+                    }
+                },
+            ),
+            lambda p: p["spec"].__setitem__(
+                "affinity",
+                {
+                    "podAntiAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {"labelSelector": {"matchLabels": {"app": "x"}}}
+                        ]
+                    }
+                },
+            ),
+            lambda p: p["spec"]["containers"][0].__setitem__(
+                "ports", [{"hostPort": 70000}]
+            ),
+            lambda p: p["spec"]["containers"][0].__setitem__(
+                "ports", [{"hostPort": "web"}]
+            ),
+            lambda p: p["spec"]["containers"][0].__setitem__(
+                "ports", [{"hostPort": 80, "protocol": "ICMP"}]
+            ),
+            lambda p: p["metadata"].__setitem__("labels", {"/app": "v"}),
+            lambda p: p["spec"].__setitem__(
+                "affinity",
+                {
+                    "nodeAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": {
+                            "nodeSelectorTerms": [
+                                {
+                                    "matchExpressions": [
+                                        {"key": "-bad!", "operator": "Exists"}
+                                    ]
+                                }
+                            ]
+                        }
+                    }
+                },
+            ),
+            lambda p: p["spec"].__setitem__(
+                "affinity",
+                {
+                    "nodeAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": {
+                            "nodeSelectorTerms": [
+                                {
+                                    "matchFields": [
+                                        {
+                                            "key": "metadata.name",
+                                            "operator": "NotIn",
+                                            "values": ["n1"],
+                                        }
+                                    ]
+                                }
+                            ]
+                        }
+                    }
+                },
+            ),
+        ],
+    )
+    def test_malformed_pod_rejected(self, mutate):
+        from simtpu.workloads.validate import ValidationError, validate_pod
+
+        pod = self._pod()
+        mutate(pod)
+        with pytest.raises(ValidationError):
+            validate_pod(pod)
+
+    def test_malformed_node_quantities_rejected(self):
+        from simtpu.workloads.validate import ValidationError, validate_node
+
+        node = {"metadata": {"name": "n"}, "status": {"allocatable": {"cpu": "banana"}}}
+        with pytest.raises(ValidationError):
+            validate_node(node)
+        node = {"metadata": {"name": "n"}, "status": {"capacity": {"cpu": "-2"}}}
+        with pytest.raises(ValidationError):
+            validate_node(node)
+
+    def test_expansion_rejects_malformed_template(self):
+        """The gate sits where the reference's is: expansion validates every
+        generated pod, so a malformed workload template fails loudly."""
+        from simtpu.core.objects import ResourceTypes
+        from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
+        from simtpu.workloads.validate import ValidationError
+
+        from .fixtures import make_fake_deployment
+
+        dep = make_fake_deployment("d", "default", 2, "1", "1Gi")
+        dep["spec"]["template"]["spec"]["tolerations"] = [{"operator": "Sometimes"}]
+        res = ResourceTypes()
+        res.deployments = [dep]
+        with pytest.raises(ValidationError):
+            get_valid_pods_exclude_daemonset(res)
