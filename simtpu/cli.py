@@ -175,17 +175,26 @@ def build_parser() -> argparse.ArgumentParser:
     doc_p = sub.add_parser("gen-doc", help="generate CLI markdown docs")
     doc_p.add_argument("--output", default="docs/commandline", help="output directory")
     doc_p.set_defaults(func=cmd_gen_doc)
+    # gen-doc walks the command tree; hand it the subparsers action rather
+    # than having it spelunk argparse privates
+    parser._simtpu_subcommands = sub
     return parser
 
 
 def cmd_gen_doc(args: argparse.Namespace) -> int:
-    """Markdown docs from the parser tree (`cmd/doc/generate_markdown.go`)."""
+    """Markdown docs from the parser tree — one page per command, like the
+    reference's cobra doc generator (`cmd/doc/generate_markdown.go` →
+    simon.md + simon_<cmd>.md)."""
     parser = build_parser()
     os.makedirs(args.output, exist_ok=True)
-    path = os.path.join(args.output, "simtpu.md")
-    with open(path, "w") as f:
-        f.write(f"## simtpu\n\n```\n{parser.format_help()}\n```\n")
-    print(f"wrote {path}")
+    pages = [("simtpu.md", "simtpu", parser)]
+    for name, sub in parser._simtpu_subcommands.choices.items():
+        pages.append((f"simtpu_{name}.md", f"simtpu {name}", sub))
+    for fname, title, p in pages:
+        path = os.path.join(args.output, fname)
+        with open(path, "w") as f:
+            f.write(f"## {title}\n\n```\n{p.format_help()}\n```\n")
+        print(f"wrote {path}")
     return 0
 
 
