@@ -63,7 +63,7 @@ def _assert_no_storage_gpu_overcommit(result):
 
 @pytest.mark.parametrize(
     "seed",
-    [11, 22] + [pytest.param(s, marks=pytest.mark.slow) for s in (33, 77, 123)],
+    [11] + [pytest.param(s, marks=pytest.mark.slow) for s in (22, 33, 77, 123)],
 )
 def test_scan_vs_bulk_equivalence_extended_resources(seed):
     """VERDICT r1 task 2: storage/GPU-demanding runs must flow through the
@@ -203,7 +203,7 @@ def _assert_anti_satisfied(result):
 
 @pytest.mark.parametrize(
     "seed",
-    [7, 19] + [pytest.param(s, marks=pytest.mark.slow) for s in (55, 91)],
+    [7] + [pytest.param(s, marks=pytest.mark.slow) for s in (19, 55, 91)],
 )
 def test_scan_vs_bulk_hard_constraints(seed):
     """VERDICT r2 task 2: DoNotSchedule spread and required self-anti-affinity
@@ -268,7 +268,7 @@ def test_scan_vs_bulk_hard_constraints(seed):
 
 @pytest.mark.parametrize(
     "seed",
-    [13, 29] + [pytest.param(s, marks=pytest.mark.slow) for s in (47, 88, 131)],
+    [13] + [pytest.param(s, marks=pytest.mark.slow) for s in (29, 47, 88, 131)],
 )
 def test_scan_vs_bulk_matrix_extended(seed):
     """VERDICT r3 task 1: multi-GPU (gpu_count > 1) and multi-claim LVM runs
@@ -406,7 +406,7 @@ def _assert_colocated(result):
 
 @pytest.mark.parametrize(
     "seed",
-    [17, 41] + [pytest.param(s, marks=pytest.mark.slow) for s in (73, 109)],
+    [17] + [pytest.param(s, marks=pytest.mark.slow) for s in (41, 73, 109)],
 )
 def test_scan_vs_bulk_self_affinity(seed):
     """VERDICT r3 task 1: required colocate-with-self runs must ride the
@@ -523,7 +523,7 @@ def test_scan_vs_bulk_preset_gpu_index():
 
 @pytest.mark.parametrize(
     "seed",
-    [101, 202] + [pytest.param(s, marks=pytest.mark.slow) for s in (303, 404)],
+    [101] + [pytest.param(s, marks=pytest.mark.slow) for s in (202, 303, 404)],
 )
 def test_scan_vs_bulk_equivalence(seed):
     rng = np.random.default_rng(seed)
