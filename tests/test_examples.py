@@ -75,3 +75,39 @@ def test_gen_doc(tmp_path, capsys):
 def test_version(capsys):
     assert main(["version"]) == 0
     assert "simtpu version" in capsys.readouterr().out
+
+
+def test_apply_engine_flags_plumb_through(capsys, monkeypatch):
+    """The tri-state engine flags must reach the Applier intact: absent →
+    None (auto), --bulk → True, --no-bulk → False, --search passes its
+    choice — and the auto path stays silent at conformance scale.  Only
+    the first (default) case runs the plan; the flag-override cases stop
+    at the spy so the fast tier doesn't pay three full applies."""
+    import simtpu.plan.capacity as cap
+
+    seen = {}
+    orig = cap._resolve_engines
+    full = True
+
+    def spy(opts, cluster, apps):
+        seen["search"], seen["bulk"] = opts.search, opts.bulk
+        if not full:
+            # ValueError is cmd_apply's clean-exit path (rc=1)
+            raise ValueError("flag-plumb probe stop")
+        return orig(opts, cluster, apps)
+
+    monkeypatch.setattr(cap, "_resolve_engines", spy)
+
+    rc = main(["apply", "-f", "examples/simtpu-config.yaml"])
+    assert rc == 0
+    assert (seen["search"], seen["bulk"]) == (None, None)
+    assert "auto-selected" not in capsys.readouterr().err
+
+    full = False
+    rc = main(["apply", "-f", "examples/simtpu-config.yaml", "--no-bulk", "--search", "linear"])
+    assert rc == 1
+    assert (seen["search"], seen["bulk"]) == ("linear", False)
+
+    rc = main(["apply", "-f", "examples/simtpu-config.yaml", "--bulk"])
+    assert rc == 1
+    assert seen["bulk"] is True
