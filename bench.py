@@ -306,16 +306,28 @@ def time_plan():
         wall = time.perf_counter() - t0
         t = plan.timings
         search = t.get("tensorize", 0) + t.get("base", 0) + t.get("probes", 0)
+        compiles = {
+            phase: sum(counts.values()) for phase, counts in plan.compiles.items()
+        }
         note(
             f"plan {label}: nodes_added={plan.nodes_added} wall={wall:.1f}s "
             f"search={search:.1f}s verify={t.get('verify', 0):.1f}s "
-            f"probes={plan.probes}"
+            f"probes={plan.probes} compiles={plan.compiles}"
         )
         if label == "cold":
+            # distinct-executable accounting (trajectory files track the
+            # cold-path target through these): total jit traces, plus the
+            # probe-sweep round-body count the bucketing pins at <= 2
+            probe_rounds = plan.compiles.get("probes", {}).get("rounds", 0) + (
+                plan.compiles.get("verify", {}).get("rounds", 0)
+            )
             out["plan_cold_s"] = round(wall, 2)
+            out["plan_cold_compiles"] = sum(compiles.values())
+            out["plan_cold_probe_round_compiles"] = probe_rounds
         else:
             out["plan_s"] = round(search, 2)
             out["plan_verified_s"] = round(wall, 2)
+            out["plan_warm_compiles"] = sum(compiles.values())
         out["plan_nodes_added"] = plan.nodes_added
         assert plan.success, "plan scenario must be feasible"
     return out

@@ -10,6 +10,7 @@ is one compiled scan.
 
 from __future__ import annotations
 
+import logging
 from typing import List, Optional, Sequence
 
 from . import constants as C
@@ -63,6 +64,13 @@ from .workloads.expand import (
     make_valid_pods_by_daemonset,
 )
 
+log = logging.getLogger("simtpu.api")
+
+#: reason suffix for pods finalized by the preemption wave cap — a tripped
+#: cap is a termination-insurance abort, not a genuine verify failure, and
+#: must be distinguishable in the report (ADVICE r5, `waves_left`)
+PREEMPT_WAVE_CAP_NOTE = "preemption retry aborted: wave cap exhausted"
+
 
 def _anti_topo_keys(pod: dict) -> set:
     """topologyKeys of the pod's REQUIRED anti-affinity terms."""
@@ -111,6 +119,10 @@ def _sort_app_pods(pods: List[dict], nodes: Sequence[dict] = (), use_greed: bool
 
 class Simulator:
     """One in-memory cluster simulation."""
+
+    #: slack term of the preemption wave-loop termination cap
+    #: (`_preempt_failed_batch`): waves_left = WAVE_CAP_SLACK + 2 * len(failed)
+    WAVE_CAP_SLACK = 4
 
     def __init__(
         self,
@@ -189,8 +201,10 @@ class Simulator:
         )
         self._placed_prio.append(pod_priority(pod))
 
-    def _record_failed(self, pod: dict, reason: int) -> None:
+    def _record_failed(self, pod: dict, reason: int, note: str = "") -> None:
         msg = REASON_TEXT.get(int(reason), "unschedulable")
+        if note:
+            msg = f"{msg} ({note})"
         self._unscheduled.append(
             UnscheduledPod(
                 pod=pod,
@@ -351,14 +365,33 @@ class Simulator:
         # finalizes FRESH-attempt failures, so an adversarial geometry
         # could in principle ping-pong demotions between already-retried
         # pods; the serial flow's work is O(failed), so is this cap
-        waves_left = 4 + 2 * len(failed)
+        # (WAVE_CAP_SLACK is an attribute so tests can force the abort path)
+        waves_left = self.WAVE_CAP_SLACK + 2 * len(failed)
         while pending:
             waves_left -= 1
             if waves_left < 0:
+                # termination-insurance abort: these pods were still PENDING
+                # (the serial evict/retry order might yet have placed them),
+                # so their original failure reason is stale — tag it so a
+                # tripped cap is observable, and say how many pods it cut off
+                log.warning(
+                    "preemption wave cap exhausted with %d pod(s) still "
+                    "pending; recording them unscheduled with their original "
+                    "failure reasons",
+                    len(pending),
+                )
+                n_aborted = len(pending)
                 for pod, reason, preev, _ in pending:
                     if preev:
                         self._restore_victims(preev)
-                    self._record_failed(pod, reason)
+                    self._record_failed(
+                        pod,
+                        reason,
+                        note=(
+                            f"{PREEMPT_WAVE_CAP_NOTE}, "
+                            f"{n_aborted} pod(s) unresolved"
+                        ),
+                    )
                 return
             model = self._build_preempt_model()
             wave = []  # (pod, reason, new victims, prior records, retried)

@@ -9,6 +9,13 @@ compilation cache lets a fresh process reuse executables compiled by any
 earlier run on the same machine/topology, collapsing cold → warm + a few
 seconds of cache reads.
 
+The planner's shape bucketing (`plan/incremental.py`, `engine/rounds.py
+RoundsEngine.snap_shapes`) is the other half of the cold-path attack: probe
+and verify executables are padded into the same deterministic shape buckets
+on every run, so a cold `simtpu apply` finds the whole probe sweep's
+round/scan bodies already in this cache instead of compiling
+per-candidate-size specializations the previous process never produced.
+
 Enabled by default for the CLI, the bench, and the test suite. Knobs:
 
 - ``SIMTPU_COMPILATION_CACHE``: cache directory; ``0``/``off`` disables.

@@ -50,6 +50,28 @@ def _interactive_select(names: List[str]) -> List[str]:
     return picked
 
 
+def _plan_json(plan) -> str:
+    """Machine-readable plan summary for scripted/CI consumers: includes
+    the engine record (search/bulk/shards + auto flags), so the
+    non-reference-exact fast path is detectable from the OUTPUT, not just
+    a stderr notice that pipelines routinely drop."""
+    import json
+
+    doc = {
+        "success": plan.success,
+        "nodes_added": plan.nodes_added,
+        "message": plan.message,
+        "engine": plan.engine,
+        "probes": {str(k): v for k, v in sorted(plan.probes.items())},
+        "timings": {k: round(v, 3) for k, v in plan.timings.items()},
+        "compiles": plan.compiles,
+        "unscheduled": (
+            len(plan.result.unscheduled_pods) if plan.result is not None else None
+        ),
+    }
+    return json.dumps(doc)
+
+
 def cmd_apply(args: argparse.Namespace) -> int:
     opts = ApplierOptions(
         simon_config=args.simon_config,
@@ -59,23 +81,45 @@ def cmd_apply(args: argparse.Namespace) -> int:
         extended_resources=args.extended_resources or [],
         search=args.search,
         bulk=args.bulk,
+        shard=args.shard,
         corrected_ds_overhead=args.corrected_ds_overhead,
     )
+    def fail_early(exc: Exception) -> int:
+        # the --json contract holds on EVERY exit: config/load failures
+        # still emit a parseable document on stdout
+        if args.json:
+            import json
+
+            print(json.dumps({"success": False, "message": str(exc)}))
+        print(exc, file=sys.stderr)
+        return 1
+
+    if args.json and opts.interactive:
+        # the selection menu and input prompt write to stdout, which --json
+        # reserves for the machine-readable document
+        return fail_early(
+            ValueError("--json and --interactive are mutually exclusive")
+        )
     try:
         applier = Applier(opts)
     except (ValueError, FileNotFoundError) as exc:
-        print(exc, file=sys.stderr)
-        return 1
+        return fail_early(exc)
     select = _interactive_select if opts.interactive else None
 
+    # with --json, stdout is the machine-readable document — progress
+    # narration moves to stderr so the stream stays parseable end-to-end
+    progress_stream = sys.stderr if args.json else sys.stdout
+
     def progress(msg: str) -> None:
-        print(f"{C.COLOR_YELLOW}{msg}{C.COLOR_RESET}")
+        print(f"{C.COLOR_YELLOW}{msg}{C.COLOR_RESET}", file=progress_stream)
 
     try:
         plan = applier.run(select_apps=select, progress=progress)
     except (ValueError, FileNotFoundError) as exc:
-        print(exc, file=sys.stderr)
-        return 1
+        return fail_early(exc)
+    if args.json:
+        print(_plan_json(plan))
+        return 0 if plan.success else 1
     if plan.success:
         print(f"{C.COLOR_GREEN}Success!{C.COLOR_RESET}")
         print(C.COLOR_GREEN, end="")
@@ -84,6 +128,9 @@ def cmd_apply(args: argparse.Namespace) -> int:
         if plan.timings:
             phases = "  ".join(f"{k}={v:.2f}s" for k, v in plan.timings.items())
             print(f"phase timings: {phases}")
+        if plan.engine:
+            eng = " ".join(f"{k}={v}" for k, v in plan.engine.items())
+            print(f"engine selection: {eng}")
         return 0
     print(f"{C.COLOR_RED}{plan.message}{C.COLOR_RESET}")
     if plan.result is not None:
@@ -159,6 +206,28 @@ def build_parser() -> argparse.ArgumentParser:
         dest="bulk",
         action="store_false",
         help="force the serial scan engine even at scale",
+    )
+    apply_p.add_argument(
+        "--shard",
+        dest="shard",
+        action="store_true",
+        default=None,
+        help="shard the incremental planner's node axis over all visible "
+        "devices (default: auto — sharded on multi-device accelerator "
+        "backends; placements are identical to single-device execution)",
+    )
+    apply_p.add_argument(
+        "--no-shard",
+        dest="shard",
+        action="store_false",
+        help="force single-device execution of the incremental planner",
+    )
+    apply_p.add_argument(
+        "--json",
+        action="store_true",
+        help="print a machine-readable JSON plan summary (success, "
+        "nodes added, probes, timings, and the engine/search selection) "
+        "instead of the report tables",
     )
     apply_p.add_argument(
         "--corrected-ds-overhead",

@@ -94,6 +94,7 @@ from .scan import (
     StepFlags,
     _pow2_up,
     add_rows,
+    count_trace,
     filter_and_score,
     pad_pods_pow2,
     score_pod,
@@ -795,6 +796,7 @@ def _round_place_many(
     self_aff: bool = False,
     ext_mats: bool = False,
 ):
+    count_trace("rounds")
     return rounds_scan(
         statics, state, seg_pods, ks, n_domains, k_cap, flags, quota,
         self_aff, ext_mats,
@@ -859,6 +861,7 @@ def _round_place_many_sliced(
     self_aff: bool = False,
     ext_mats: bool = False,
 ):
+    count_trace("rounds")
     return rounds_scan_sliced(
         statics, state, rows, g_terms_c, term_topo_c, ip_of_c, seg_pods,
         ks, n_domains, k_cap, flags, quota, self_aff, ext_mats,
@@ -878,6 +881,30 @@ class RoundsEngine(Engine):
     #: maximum pods per bulk round — longer runs split into consecutive
     #: rounds (bounds the [S, k_cap] output and keeps score slopes fresh)
     MAX_RUN = 4096
+
+    def __init__(self, tensorizer):
+        super().__init__(tensorizer)
+        # Shape-bucket registry: variant key → set of (s_pad, k_cap, r_pad)
+        # bulk-chunk shapes this engine (or any engine SHARING the dict —
+        # the incremental planner hands one registry to its base, probe and
+        # verify engines) has already dispatched, i.e. shapes whose
+        # executables are warm. With `snap_shapes`, `_bulk_chunk` pads a
+        # chunk UP into the cheapest dominating registered shape instead of
+        # compiling its natural pow2 shape — the candidate probe sweep then
+        # reuses one executable across every candidate count instead of
+        # shape-specializing per probe.
+        self.bulk_shapes: dict = {}
+        self.snap_shapes: bool = False
+
+    #: snap guard: never pad a chunk into a bucket more than this many times
+    #: its natural pow2 segment count (each padded segment is a k=0 no-op
+    #: round, which still costs a round of device work)
+    SNAP_S_BLOWUP = 8
+    #: snap guard on the round capacity: k_cap inflation is cheaper than
+    #: segment inflation (the threshold search is k-independent; only the
+    #: [k_cap] slot expansion and the [S, k_cap(, V)] outputs grow), but an
+    #: unbounded pick could marry a tiny chunk to a MAX_RUN-sized bucket
+    SNAP_K_BLOWUP = 64
 
     # group bulk-path classification codes (`_group_bulk_kind`)
     KIND_SERIAL = 0  # pod-by-pod serial scan only
@@ -1102,16 +1129,17 @@ class RoundsEngine(Engine):
         if chunk:
             yield chunk, self._pad_rows(sorted(rows), t)
 
-    def _pad_rows(self, rows, t):
-        """Pad the row list to a power of two with DISTINCT unused term ids
-        (their gathered values pass through the scan unchanged, so the
-        scatter-back is a no-op for them; duplicate indices in a scatter
-        would let a stale copy overwrite the updated row). Returns None when
-        the next power of two cannot fit in t: a clamped, non-pow2 row count
-        would defeat the shape bucketing and recompile per chunk — carrying
-        the full plane keeps the compiled-shape set bounded."""
+    def _pad_rows(self, rows, t, floor: int = 1):
+        """Pad the row list to a power of two (at least `floor`) with
+        DISTINCT unused term ids (their gathered values pass through the
+        scan unchanged, so the scatter-back is a no-op for them; duplicate
+        indices in a scatter would let a stale copy overwrite the updated
+        row). Returns None when the target cannot fit in t: a clamped,
+        non-pow2 row count would defeat the shape bucketing and recompile
+        per chunk — carrying the full plane keeps the compiled-shape set
+        bounded."""
         rows = np.asarray(rows, np.int32)
-        u_pad = self._pow2(len(rows))
+        u_pad = max(self._pow2(len(rows)), floor)
         if u_pad >= t:  # padding to >= the full plane = just carry the plane
             return None
         pad = u_pad - len(rows)
@@ -1133,6 +1161,37 @@ class RoundsEngine(Engine):
         firsts = np.array([i0 for _, i0, _ in chunk], np.int32)
         ks = np.array([j0 - i0 for _, i0, j0 in chunk], np.int32)
         k_cap = self._pow2(int(ks.max()))
+
+        # shape bucketing: snap the chunk's natural pow2 shape UP into the
+        # cheapest already-compiled dominating bucket of the same variant,
+        # so planner probes reuse warm executables across candidate sizes
+        # instead of shape-specializing per probe (padded segments are k=0
+        # no-op rounds; padded term rows ride along unchanged)
+        t = int(tensors.n_terms)
+        variant = (quota, self_aff, ext_mats, rows_p is not None, flags)
+        r_nat = 0 if rows_p is None else len(rows_p)
+        shapes = self.bulk_shapes.setdefault(variant, set())
+        if self.snap_shapes:
+            cand = [
+                (s, k, rr)
+                for (s, k, rr) in shapes
+                if s >= s_pad
+                and k >= k_cap
+                and rr >= r_nat
+                and s <= max(8, self.SNAP_S_BLOWUP * s_pad)
+                and k <= max(self.MIN_RUN, self.SNAP_K_BLOWUP * k_cap)
+            ]
+            if cand:
+                s_b, k_b, r_b = min(cand, key=lambda c: (c[0], c[2], c[1]))
+                if rows_p is not None and r_b > r_nat:
+                    grown = self._pad_rows(rows_p, t, floor=r_b)
+                    if grown is not None and len(grown) == r_b:
+                        rows_p = grown
+                        s_pad, k_cap = s_b, k_b
+                elif rows_p is None or r_b == r_nat:
+                    s_pad, k_cap = s_b, k_b
+        shapes.add((s_pad, k_cap, 0 if rows_p is None else len(rows_p)))
+
         firsts = np.pad(firsts, (0, s_pad - s_real), constant_values=firsts[-1])
         ks = np.pad(ks, (0, s_pad - s_real))  # k=0 rounds are no-ops
         # pods stay host-side (build_pod_arrays): the gather is a cheap

@@ -63,6 +63,23 @@ FAIL_VOLUME = 9  # exclusive volume (EBS/GCE-PD/ISCSI/RBD) conflict everywhere
 FAIL_ATTACH = 10  # node volume attach limits exceeded everywhere
 FAIL_VOLUME_BIND = 11  # PVC missing / not bindable / PV zone mismatch
 
+# Jit-trace counters: the traced bodies of the engine executables bump these
+# once per (re)trace, i.e. once per distinct compiled shape signature — the
+# observability behind the planner's compile accounting (PlanResult.compiles)
+# and the compile-count regression tests. Host-side state mutated at trace
+# time only; steady-state dispatches never touch it.
+TRACE_COUNTS = {"scan": 0, "rounds": 0}
+
+
+def count_trace(kind: str) -> None:
+    TRACE_COUNTS[kind] = TRACE_COUNTS.get(kind, 0) + 1
+
+
+def trace_counts() -> dict:
+    """Snapshot of the per-kind jit-trace counters."""
+    return dict(TRACE_COUNTS)
+
+
 REASON_TEXT = {
     FAIL_STATIC: "node(s) didn't match node selector/affinity or had untolerated taints",
     FAIL_RESOURCES: "insufficient cpu/memory/extended resources on every feasible node",
@@ -917,6 +934,7 @@ def schedule_step(
 
 @partial(jax.jit, static_argnums=(3,), donate_argnums=(1,))
 def _run_scan(statics: StaticArrays, state: SchedState, pods, flags: StepFlags = StepFlags()):
+    count_trace("scan")
     return jax.lax.scan(partial(schedule_step, statics, flags=flags), state, pods)
 
 

@@ -14,8 +14,10 @@ from .mesh import (
     initialize_multihost,
     make_mesh,
     node_shard_count,
+    planner_mesh,
 )
 from .sharded import (
+    MaskedShardedRoundsEngine,
     ShardedEngine,
     ShardedRoundsEngine,
     build_sharded_scan,
@@ -27,6 +29,7 @@ from .sweep import plan_capacity_batched, sweep_feasibility
 __all__ = [
     "NODE_AXIS",
     "SWEEP_AXIS",
+    "MaskedShardedRoundsEngine",
     "ShardedEngine",
     "ShardedRoundsEngine",
     "build_sharded_scan",
@@ -36,5 +39,6 @@ __all__ = [
     "pad_state",
     "pad_statics",
     "plan_capacity_batched",
+    "planner_mesh",
     "sweep_feasibility",
 ]

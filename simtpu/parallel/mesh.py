@@ -47,6 +47,19 @@ def make_mesh(
     return Mesh(grid, (SWEEP_AXIS, NODE_AXIS))
 
 
+def planner_mesh() -> Optional[Mesh]:
+    """The mesh the capacity planner shards over when left on auto: every
+    visible device on the flat "nodes" axis (sweep=1 — one plan is one
+    simulation at a time; the candidate axis is searched, not vmapped).
+    None on single-device topologies — the caller should then stay on the
+    unsharded engines rather than pay mesh-layout overhead for no
+    parallelism."""
+    devices = jax.devices()
+    if len(devices) < 2:
+        return None
+    return make_mesh(devices, sweep=1)
+
+
 def node_sharding(mesh: Mesh, rank_after_node: int = 0) -> NamedSharding:
     """Sharding for an array whose LEADING axis is the node axis."""
     return NamedSharding(mesh, P(NODE_AXIS, *([None] * rank_after_node)))

@@ -16,6 +16,7 @@ select, so placement results are bit-identical to the unsharded engine.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from functools import partial
 from typing import Tuple
 
@@ -25,7 +26,14 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine.rounds import RoundsEngine
-from ..engine.scan import Engine, SchedState, StaticArrays, StepFlags, schedule_step
+from ..engine.scan import (
+    Engine,
+    SchedState,
+    StaticArrays,
+    StepFlags,
+    count_trace,
+    schedule_step,
+)
 from .mesh import NODE_AXIS, node_shard_count
 
 
@@ -155,6 +163,32 @@ def state_sharding(mesh: Mesh) -> SchedState:
     )
 
 
+# Compiled-callable cache shared by every sharded engine on the same mesh.
+# The per-instance caches this replaces made compiled executables die with
+# their engine: the incremental planner builds a FRESH engine per candidate
+# probe, so each probe re-jitted (and re-compiled) every scan and round
+# body.  jax.jit callables internally cache per input shape, so one callable
+# per (mesh, static config) shared across instances is exactly the reuse the
+# probe sweep needs.  Keyed by the Mesh object itself (hashable; equal
+# meshes share).  LRU-capped: keys carry per-workload statics (k_cap,
+# n_domains), so a long-lived process running many different simulations
+# would otherwise grow compiled-executable memory monotonically — one plan's
+# working set is a handful of entries, far under the cap.
+_SHARDED_JITS: OrderedDict = OrderedDict()
+_SHARDED_JITS_CAP = 64
+
+
+def _cached_jit(key, build):
+    fn = _SHARDED_JITS.get(key)
+    if fn is None:
+        fn = _SHARDED_JITS[key] = build()
+        while len(_SHARDED_JITS) > _SHARDED_JITS_CAP:
+            _SHARDED_JITS.popitem(last=False)
+    else:
+        _SHARDED_JITS.move_to_end(key)
+    return fn
+
+
 def build_sharded_scan(mesh: Mesh, flags: StepFlags = StepFlags()):
     """Compile the placement scan with the node axis laid out over `mesh`."""
     st_spec = statics_sharding(mesh)
@@ -163,6 +197,7 @@ def build_sharded_scan(mesh: Mesh, flags: StepFlags = StepFlags()):
     pods_rep = None  # resolved at call time: every per-pod array is replicated
 
     def _scan_fn(statics, state, pods):
+        count_trace("scan")
         return jax.lax.scan(partial(schedule_step, statics, flags=flags), state, pods)
 
     return jax.jit(
@@ -175,12 +210,11 @@ def build_sharded_scan(mesh: Mesh, flags: StepFlags = StepFlags()):
 
 class _MeshMixin:
     """Shared mesh plumbing for the sharded engines: input padding/layout and
-    the per-flags compiled-scan cache."""
+    the mesh-wide compiled-scan cache."""
 
     def _init_mesh(self, mesh: Mesh) -> None:
         self.mesh = mesh
         self._shards = node_shard_count(mesh)
-        self._scan_jits = {}  # StepFlags → compiled sharded serial scan
 
     def _shard_inputs(self, statics: StaticArrays, state: SchedState):
         statics, _ = pad_statics(statics, self._shards)
@@ -191,10 +225,10 @@ class _MeshMixin:
         return statics, state
 
     def _sharded_scan_for(self, flags: StepFlags):
-        fn = self._scan_jits.get(flags)
-        if fn is None:
-            fn = self._scan_jits[flags] = build_sharded_scan(self.mesh, flags)
-        return fn
+        return _cached_jit(
+            ("scan", self.mesh, flags),
+            lambda: build_sharded_scan(self.mesh, flags),
+        )
 
 
 class ShardedEngine(_MeshMixin, Engine):
@@ -236,6 +270,7 @@ def build_sharded_rounds(
     rep = NamedSharding(mesh, P())
 
     def fn(statics, state, seg_pods, ks):
+        count_trace("rounds")
         return rounds_scan(
             statics, state, seg_pods, ks, n_domains, k_cap, flags, quota,
             self_aff, ext_mats,
@@ -267,6 +302,7 @@ def build_sharded_rounds_sliced(
     rep = NamedSharding(mesh, P())
 
     def fn(statics, state, rows, g_terms_c, term_topo_c, ip_of_c, seg_pods, ks):
+        count_trace("rounds")
         return rounds_scan_sliced(
             statics, state, rows, g_terms_c, term_topo_c, ip_of_c,
             seg_pods, ks, n_domains, k_cap, flags, quota, self_aff,
@@ -291,7 +327,6 @@ class ShardedRoundsEngine(_MeshMixin, RoundsEngine):
     def __init__(self, tensorizer, mesh: Mesh):
         super().__init__(tensorizer)
         self._init_mesh(mesh)
-        self._bulk_jits = {}
 
     def _dispatch(self, statics, state, pods, flags):
         statics, state = self._shard_inputs(statics, state)
@@ -306,12 +341,13 @@ class ShardedRoundsEngine(_MeshMixin, RoundsEngine):
         self, statics, state, seg_pods, ks, n_domains, k_cap, flags,
         quota=False, self_aff=False, ext_mats=False,
     ):
-        key = (n_domains, k_cap, flags, quota, self_aff, ext_mats)
-        fn = self._bulk_jits.get(key)
-        if fn is None:
-            fn = self._bulk_jits[key] = build_sharded_rounds(
+        fn = _cached_jit(
+            ("rounds", self.mesh, n_domains, k_cap, flags, quota, self_aff,
+             ext_mats),
+            lambda: build_sharded_rounds(
                 self.mesh, n_domains, k_cap, flags, quota, self_aff, ext_mats
-            )
+            ),
+        )
         return fn(statics, state, seg_pods, ks)
 
     def _bulk_call_sliced(
@@ -319,10 +355,31 @@ class ShardedRoundsEngine(_MeshMixin, RoundsEngine):
         seg_pods, ks, n_domains, k_cap, flags,
         quota=False, self_aff=False, ext_mats=False,
     ):
-        key = ("sliced", n_domains, k_cap, flags, quota, self_aff, ext_mats)
-        fn = self._bulk_jits.get(key)
-        if fn is None:
-            fn = self._bulk_jits[key] = build_sharded_rounds_sliced(
+        fn = _cached_jit(
+            ("rounds_sliced", self.mesh, n_domains, k_cap, flags, quota,
+             self_aff, ext_mats),
+            lambda: build_sharded_rounds_sliced(
                 self.mesh, n_domains, k_cap, flags, quota, self_aff, ext_mats
-            )
+            ),
+        )
         return fn(statics, state, rows, g_terms_c, term_topo_c, ip_of_c, seg_pods, ks)
+
+
+class MaskedShardedRoundsEngine(ShardedRoundsEngine):
+    """`ShardedRoundsEngine` restricted to a candidate cluster: the planner's
+    `node_valid` mask (dead rows for clone nodes beyond the candidate's
+    size) composes with the statics BEFORE the shard padding, so the
+    sharding's own dead-node pad mask stacks on top and placements stay
+    bit-identical to the single-device `MaskedRoundsEngine` path.  The
+    mesh-sharded counterpart the incremental planner uses for base
+    placement, completion probes, and the `verify=True` fresh re-runs."""
+
+    def __init__(self, tensorizer, mesh: Mesh, node_valid: np.ndarray):
+        super().__init__(tensorizer, mesh)
+        self.node_valid = np.asarray(node_valid, bool)
+
+    def _shard_inputs(self, statics: StaticArrays, state: SchedState):
+        statics = statics._replace(
+            node_valid=statics.node_valid & jnp.asarray(self.node_valid)
+        )
+        return super()._shard_inputs(statics, state)

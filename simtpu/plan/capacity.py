@@ -55,6 +55,18 @@ class PlanResult:
     # reference lacks (SURVEY.md §5: vendored metrics exist but are never
     # exported)
     timings: Dict[str, float] = field(default_factory=dict)
+    # the engines that actually ran (search strategy, bulk placement,
+    # node-shard count, and whether the choice was automatic): auto engine
+    # selection can change results vs the reference-exact path (bulk
+    # tie-breaks, incremental's no-preemption semantics), and a stderr-only
+    # notice is invisible to scripted/CI consumers — this rides the result
+    # and the CLI's --json output
+    engine: Dict[str, object] = field(default_factory=dict)
+    # per-phase jit-trace counts from the incremental planner (base /
+    # probes / verify, each {"rounds": n, "scan": m}) — the compile
+    # observability behind the shape-bucketed probe sweep and bench.py's
+    # cold-path tracking
+    compiles: Dict[str, Dict[str, int]] = field(default_factory=dict)
 
 
 def new_fake_nodes(template: dict, count: int) -> List[dict]:
@@ -324,6 +336,11 @@ class ApplierOptions:
     extended_resources: Sequence[str] = ()
     search: Optional[str] = None  # None = auto; binary | linear | incremental
     bulk: Optional[bool] = None  # None = auto; place replica runs bulk
+    # None = auto: shard the incremental planner's node axis over the device
+    # mesh when more than one accelerator device is visible (placements are
+    # bit-identical to the single-device path; CPU backends stay unsharded
+    # unless forced — virtual CPU "devices" share one host's FLOPs)
+    shard: Optional[bool] = None
     # account daemonset overhead on the template node in the can-ever-fit
     # diagnostic (off = faithful to the reference's NewNodeNamePrefix quirk)
     corrected_ds_overhead: bool = False
@@ -363,11 +380,13 @@ def _resolve_engines(
     opts: ApplierOptions,
     cluster: ResourceTypes,
     apps: Sequence[AppResource],
-) -> Tuple[str, bool]:
-    """Fill in auto (None) search/bulk choices from the problem size and
-    say so loudly on stderr — the user should never need to know the flags
-    to get the fast path, but must be able to see (and override) what was
-    picked."""
+) -> Tuple[str, bool, Optional[object]]:
+    """Fill in auto (None) search/bulk/shard choices from the problem size
+    (and device topology) and say so loudly on stderr — the user should
+    never need to know the flags to get the fast path, but must be able to
+    see (and override) what was picked.  Returns (search, bulk, mesh) where
+    mesh is a node-sharding device mesh for the incremental planner or
+    None."""
     import sys
 
     n_nodes = len(cluster.nodes)
@@ -383,7 +402,43 @@ def _resolve_engines(
             "for the serial reference-exact engines",
             file=sys.stderr,
         )
-    return search, bulk
+    mesh = None
+    if search == "incremental" and opts.shard is not False:
+        import jax
+
+        devices = jax.devices()
+        # auto: only real accelerator meshes (virtual CPU devices split one
+        # host's FLOPs — sharding there is a test vehicle, not a speedup)
+        want = opts.shard is True or (
+            opts.shard is None
+            and len(devices) > 1
+            and jax.default_backend() != "cpu"
+        )
+        if want:
+            from ..parallel.mesh import planner_mesh
+
+            mesh = planner_mesh()  # None on single-device topologies
+            if mesh is not None and opts.shard is None:
+                print(
+                    f"simtpu: sharding the incremental plan's node axis over "
+                    f"{len(devices)} devices; pass --no-shard for "
+                    "single-device execution",
+                    file=sys.stderr,
+                )
+    if opts.shard is True and mesh is None:
+        # an explicit --shard that cannot be honored must be LOUD — a CI
+        # job forcing the sharded path would otherwise silently validate
+        # the unsharded one (same contract as the auto-engine notice)
+        why = (
+            "the search strategy is not 'incremental'"
+            if search != "incremental"
+            else "only one device is visible"
+        )
+        print(
+            f"simtpu: --shard ignored ({why}); the plan runs unsharded",
+            file=sys.stderr,
+        )
+    return search, bulk, mesh
 
 
 class Applier:
@@ -459,7 +514,7 @@ class Applier:
             import jax
 
             ctx = jax.profiler.trace(trace_dir)
-        search, bulk = _resolve_engines(self.opts, cluster, apps)
+        search, bulk, mesh = _resolve_engines(self.opts, cluster, apps)
         t0 = _time.perf_counter()
         with ctx:
             if search == "incremental":
@@ -473,6 +528,7 @@ class Applier:
                     progress=progress,
                     sched_config=self._sched_config(),
                     corrected_ds_overhead=self.opts.corrected_ds_overhead,
+                    mesh=mesh,
                 )
             else:
                 plan = plan_capacity(
@@ -488,4 +544,17 @@ class Applier:
                 )
         timings["plan"] = _time.perf_counter() - t0
         plan.timings = timings
+        # machine-readable record of what actually ran (ADVICE r5: the
+        # stderr notice alone is invisible to scripted consumers —
+        # "search"/"bulk" distinguish the non-reference-exact fast path)
+        from ..parallel.mesh import NODE_AXIS
+
+        plan.engine = {
+            "search": search,
+            "bulk": bool(bulk) if search != "incremental" else True,
+            "shards": int(mesh.shape[NODE_AXIS]) if mesh is not None else 0,
+            "auto_search": self.opts.search is None,
+            "auto_bulk": self.opts.bulk is None,
+            "reference_exact": search == "linear" and not bulk,
+        }
         return plan

@@ -36,6 +36,25 @@ end. Preemption does not run inside probes — capacity planning asks whether
 everything fits, and evicting lower-priority pods does not change cluster
 capacity (the serial planner inherits preemption from `simulate()`; use it
 when priority-eviction semantics matter).
+
+Two cross-candidate performance levers ride on top (the ISSUE-1 tentpole):
+
+- MESH SHARDING: with `mesh=`, base placement, completion probes, and the
+  verify re-runs all execute with the node axis sharded over the mesh
+  (`MaskedShardedRoundsEngine`) — the candidate mask composes with the
+  sharding's dead-node pad mask and placements stay bit-identical to the
+  single-device path.  The compiled mesh executables live in a mesh-wide
+  cache (`parallel.sharded._SHARDED_JITS`), so the fresh engine each
+  candidate gets does NOT re-jit.
+- SHAPE BUCKETING: every engine of one plan shares a bulk-chunk shape
+  registry; probe chunks snap UP into (segment count, round capacity,
+  carried term rows) buckets the base run already compiled
+  (`RoundsEngine.snap_shapes`), so the whole linear/binary probe sweep and
+  the verify run reuse warm round-body executables instead of
+  shape-specializing per candidate — and the shapes stay deterministic
+  across processes, which is what lets the persistent compilation cache
+  (`simtpu/cache.py`) collapse the cold path on accelerator backends.
+  `PlanResult.compiles` records the per-phase jit-trace counts.
 """
 
 from __future__ import annotations
@@ -66,7 +85,9 @@ from .capacity import PlanResult, _env_cap, meet_resource_requests
 class MaskedRoundsEngine(RoundsEngine):
     """Bulk rounds engine restricted to a candidate cluster: `node_valid`
     masks out clone nodes beyond the candidate's size (dead rows no pod can
-    select, exactly like the sweep's vmapped membership masks)."""
+    select, exactly like the sweep's vmapped membership masks).  The
+    mesh-sharded counterpart is `parallel.sharded.MaskedShardedRoundsEngine`
+    (same mask, composed before the shard padding)."""
 
     def __init__(self, tensorizer, node_valid: np.ndarray):
         super().__init__(tensorizer)
@@ -173,6 +194,7 @@ def plan_capacity_incremental(
     corrected_ds_overhead: bool = False,
     verify: bool = True,
     materialize: bool = True,
+    mesh=None,
 ) -> PlanResult:
     """Minimum clone count of `new_node` deploying everything, via the
     incremental probe strategy described in the module docstring.
@@ -180,15 +202,38 @@ def plan_capacity_incremental(
     Matches `plan_capacity`'s contract (candidates 0..max_new_nodes-1,
     occupancy caps, can-never-help diagnostics, PlanResult shape); the
     per-candidate oracle differs as documented. `PlanResult.timings` carries
-    the phase breakdown (tensorize / base / probes / verify / materialize).
+    the phase breakdown (tensorize / base / probes / verify / materialize)
+    and `PlanResult.compiles` the per-phase jit-trace counts (the shape-
+    bucketed probe sweep is expected to trace the round body at most twice
+    across every candidate size).
+
+    With `mesh` (a jax.sharding.Mesh), every placement — base, completion
+    probes, and the fresh verify re-runs — executes node-sharded over the
+    mesh's "nodes" axis (`MaskedShardedRoundsEngine`); the candidate
+    node_valid mask composes with the sharding's dead-node pad mask, so
+    placements are bit-identical to the single-device path.
     """
-    from ..engine.scan import statics_from
+    from ..engine.scan import statics_from, trace_counts
     from ..parallel.sweep import assemble_planning_problem
 
     say = progress or (lambda s: None)
     timings: Dict[str, float] = {}
+    compiles: Dict[str, Dict[str, int]] = {}
     probes: Dict[int, int] = {}
     fail_msg = f"we have added {max_new_nodes} nodes but it still failed!!"
+
+    def mark_compiles(phase: str, before: dict) -> None:
+        after = trace_counts()
+        prev = compiles.get(phase, {})
+        compiles[phase] = {
+            k: prev.get(k, 0) + after.get(k, 0) - before.get(k, 0)
+            for k in after
+        }
+
+    def finalize(out: PlanResult) -> PlanResult:
+        out.timings = timings
+        out.compiles = compiles
+        return out
 
     t0 = time.perf_counter()
     max_new = max(max_new_nodes - 1, 0)  # reference walks i in [0, max)
@@ -203,26 +248,47 @@ def plan_capacity_incremental(
     clone_of = pin - n_base  # >= 0 for clone-pinned (DaemonSet) pods
     timings["tensorize"] = time.perf_counter() - t0
 
+    # one shape-bucket registry for every engine of this plan: probes snap
+    # their bulk chunks into buckets the base run (or an earlier probe)
+    # already compiled, so the whole candidate sweep stays on warm
+    # executables (engine/rounds.py `_bulk_chunk`)
+    shape_registry: Dict = {}
+
+    def make_engine(node_valid: np.ndarray):
+        if mesh is not None:
+            from ..parallel.sharded import MaskedShardedRoundsEngine
+
+            eng = MaskedShardedRoundsEngine(tz, mesh, node_valid)
+        else:
+            eng = MaskedRoundsEngine(tz, node_valid)
+        eng.sched_config = sched_config
+        eng.bulk_shapes = shape_registry
+        eng.snap_shapes = True
+        return eng
+
     def valid_mask(i: int) -> np.ndarray:
         m = np.ones(len(all_nodes), bool)
         m[n_base + i :] = False
         return m
 
-    def fresh_run(i: int):
+    def fresh_run(i: int, phase: str = "verify"):
         """Full placement of every pod against base + i clones (the
         reference's per-candidate semantics, minus re-tensorization)."""
-        eng = MaskedRoundsEngine(tz, valid_mask(i))
-        eng.sched_config = sched_config
+        c0 = trace_counts()
+        eng = make_engine(valid_mask(i))
         nodes, reasons, extras = eng.place(batch)
         phantom = clone_of >= i
         failed = (nodes < 0) & ~phantom
         probes[i] = int(failed.sum())
+        mark_compiles(phase, c0)
         return eng, nodes, reasons, failed, extras["gpu_shares"]
 
     # -- base candidate: i = 0 -------------------------------------------
     t0 = time.perf_counter()
     say("add 0 node(s)")
-    base_eng, base_nodes_arr, base_reasons, base_failed, base_gpu = fresh_run(0)
+    base_eng, base_nodes_arr, base_reasons, base_failed, base_gpu = fresh_run(
+        0, phase="base"
+    )
     timings["base"] = time.perf_counter() - t0
 
     def finish(i, eng, nodes_arr, reasons, gpu_shares_arr):
@@ -247,9 +313,7 @@ def plan_capacity_incremental(
                 clone_of, i, eng.ext_log, gpu_shares_arr,
             )
             timings["materialize"] = time.perf_counter() - t1
-        out = PlanResult(True, i, result, "Success!", probes)
-        out.timings = timings
-        return out
+        return finalize(PlanResult(True, i, result, "Success!", probes))
 
     if probes[0] == 0:
         done = finish(0, base_eng, base_nodes_arr, base_reasons, base_gpu)
@@ -285,15 +349,11 @@ def plan_capacity_incremental(
 
     msg = diagnose(u0)
     if msg:
-        out = PlanResult(False, 0, None, msg, probes)
-        out.timings = timings
-        return out
+        return finalize(PlanResult(False, 0, None, msg, probes))
     if max_new == 0:
         # no candidate beyond 0 exists (max_new_nodes <= 1, apply.go's
         # exclusive upper bound) — the base failure is terminal
-        out = PlanResult(False, max_new_nodes, None, fail_msg, probes)
-        out.timings = timings
-        return out
+        return finalize(PlanResult(False, max_new_nodes, None, fail_msg, probes))
 
     # -- snapshot + cheap probes ------------------------------------------
     t0 = time.perf_counter()
@@ -304,15 +364,16 @@ def plan_capacity_incremental(
         DaemonSet pods for clones < i plus every base failure, in original
         order. Feasible iff all of them place."""
         say(f"add {i} node(s)")
+        c0 = trace_counts()
         idx = np.flatnonzero(base_failed | ((clone_of >= 0) & (clone_of < i)))
-        eng = MaskedRoundsEngine(tz, valid_mask(i))
-        eng.sched_config = sched_config
+        eng = make_engine(valid_mask(i))
         eng.last_state = _copy_state(snapshot)
         eng._last_vocab = vocab
         eng._state_dirty = False
         nodes, reasons, extras = eng.place(_slice_batch(batch, idx))
         failed = nodes < 0
         probes[i] = int(failed.sum())
+        mark_compiles("probes", c0)
         return eng, idx, nodes, reasons, failed, extras["gpu_shares"]
 
     # resource lower bound: the base failures must at least FIT the added
@@ -346,14 +407,12 @@ def plan_capacity_incremental(
             lo = max(lo, cand)
             msg = diagnose(idx_i[failed_i])
             if msg:
-                out = PlanResult(False, cand, None, msg, probes)
-                out.timings = timings
-                return out
+                return finalize(PlanResult(False, cand, None, msg, probes))
         if hi is None:
             if cand >= max_new:
-                out = PlanResult(False, max_new_nodes, None, fail_msg, probes)
-                out.timings = timings
-                return out
+                return finalize(
+                    PlanResult(False, max_new_nodes, None, fail_msg, probes)
+                )
             cand = min(cand * 2, max_new)
         elif hi == first_cand and lo == 0 and hi - 1 > lo:
             cand = hi - 1  # tight-bound fast path
@@ -379,13 +438,9 @@ def plan_capacity_incremental(
                 continue
             msg = diagnose(np.flatnonzero(failed_v))
             if msg:
-                out = PlanResult(False, i, None, msg, probes)
-                out.timings = timings
-                return out
+                return finalize(PlanResult(False, i, None, msg, probes))
             i += 1
-        out = PlanResult(False, max_new_nodes, None, fail_msg, probes)
-        out.timings = timings
-        return out
+        return finalize(PlanResult(False, max_new_nodes, None, fail_msg, probes))
 
     # -- incremental result: base placements + winning probe -------------
     eng_w, idx_w, nodes_w, gpu_w = hi_run
@@ -419,9 +474,7 @@ def plan_capacity_incremental(
                 if done is not None:
                     return done
             i += 1
-        out = PlanResult(False, max_new_nodes, None, fail_msg, probes)
-        out.timings = timings
-        return out
+        return finalize(PlanResult(False, max_new_nodes, None, fail_msg, probes))
     result = None
     if materialize:
         t1 = time.perf_counter()
@@ -430,9 +483,7 @@ def plan_capacity_incremental(
             clone_of, hi, ext_log, gpu_all,
         )
         timings["materialize"] = time.perf_counter() - t1
-    out = PlanResult(True, hi, result, "Success!", probes)
-    out.timings = timings
-    return out
+    return finalize(PlanResult(True, hi, result, "Success!", probes))
 
 
 def _materialize(

@@ -80,9 +80,13 @@ def test_version(capsys):
 def test_apply_engine_flags_plumb_through(capsys, monkeypatch):
     """The tri-state engine flags must reach the Applier intact: absent →
     None (auto), --bulk → True, --no-bulk → False, --search passes its
-    choice — and the auto path stays silent at conformance scale.  Only
-    the first (default) case runs the plan; the flag-override cases stop
-    at the spy so the fast tier doesn't pay three full applies."""
+    choice, --shard/--no-shard likewise — and the auto path stays silent
+    at conformance scale.  Only the first (default) case runs the plan
+    (as --json, pinning the machine-readable engine record ADVICE r5
+    asked for); the flag-override cases stop at the spy so the fast tier
+    doesn't pay several full applies."""
+    import json as _json
+
     import simtpu.plan.capacity as cap
 
     seen = {}
@@ -90,7 +94,9 @@ def test_apply_engine_flags_plumb_through(capsys, monkeypatch):
     full = True
 
     def spy(opts, cluster, apps):
-        seen["search"], seen["bulk"] = opts.search, opts.bulk
+        seen["search"], seen["bulk"], seen["shard"] = (
+            opts.search, opts.bulk, opts.shard,
+        )
         if not full:
             # ValueError is cmd_apply's clean-exit path (rc=1)
             raise ValueError("flag-plumb probe stop")
@@ -98,10 +104,20 @@ def test_apply_engine_flags_plumb_through(capsys, monkeypatch):
 
     monkeypatch.setattr(cap, "_resolve_engines", spy)
 
-    rc = main(["apply", "-f", "examples/simtpu-config.yaml"])
+    rc = main(["apply", "-f", "examples/simtpu-config.yaml", "--json"])
     assert rc == 0
-    assert (seen["search"], seen["bulk"]) == (None, None)
-    assert "auto-selected" not in capsys.readouterr().err
+    assert (seen["search"], seen["bulk"], seen["shard"]) == (None, None, None)
+    captured = capsys.readouterr()
+    assert "auto-selected" not in captured.err
+    # stdout must be EXACTLY the JSON document (progress goes to stderr),
+    # so `simtpu apply --json | jq .` works
+    doc = _json.loads(captured.out.strip())
+    assert doc["success"] is True
+    # the engine record rides the OUTPUT (not stderr): scripted consumers
+    # can detect the non-reference-exact fast path from here
+    assert doc["engine"]["search"] in ("binary", "linear", "incremental")
+    assert {"auto_search", "auto_bulk", "shards"} <= set(doc["engine"])
+    assert doc["engine"]["auto_search"] is True
 
     full = False
     rc = main(["apply", "-f", "examples/simtpu-config.yaml", "--no-bulk", "--search", "linear"])
@@ -111,3 +127,11 @@ def test_apply_engine_flags_plumb_through(capsys, monkeypatch):
     rc = main(["apply", "-f", "examples/simtpu-config.yaml", "--bulk"])
     assert rc == 1
     assert seen["bulk"] is True
+
+    rc = main(["apply", "-f", "examples/simtpu-config.yaml", "--shard"])
+    assert rc == 1
+    assert seen["shard"] is True
+
+    rc = main(["apply", "-f", "examples/simtpu-config.yaml", "--no-shard"])
+    assert rc == 1
+    assert seen["shard"] is False

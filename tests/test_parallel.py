@@ -153,6 +153,92 @@ class TestShardedMatrixRounds:
         assert len(base.unscheduled_pods) == len(sharded.unscheduled_pods)
 
 
+class TestShardedIncrementalPlanner:
+    """The flagship min-node-add workflow node-sharded over the mesh: base
+    placement, completion probes, and the fresh verify re-runs all execute
+    under GSPMD (`MaskedShardedRoundsEngine`), with the candidate
+    `node_valid` mask composed with the sharding's dead-node pad mask.
+    The gate: chosen count AND placement set bit-identical to the
+    single-device incremental planner."""
+
+    def _scenario(self):
+        cluster = ResourceTypes()
+        cluster.nodes = [
+            make_node(
+                f"node-{i:06d}",
+                8000,
+                16,
+                {
+                    "topology.kubernetes.io/zone": f"zone-{i % 2}",
+                    "kubernetes.io/hostname": f"node-{i:06d}",
+                },
+            )
+            for i in range(3)
+        ]
+        apps = synth_apps(
+            160,
+            seed=6,
+            zones=2,
+            pods_per_deployment=20,
+            selector_frac=0.0,
+            anti_affinity_frac=0.2,
+            spread_frac=0.4,
+            spread_hard_frac=0.5,
+        )
+        template = make_node(
+            "tmpl",
+            16000,
+            64,
+            {
+                "kubernetes.io/hostname": "tmpl",
+                "topology.kubernetes.io/zone": "zone-0",
+            },
+        )
+        return cluster, apps, template
+
+    def test_sharded_plan_matches_single_device(self):
+        from simtpu.plan.incremental import plan_capacity_incremental
+
+        cluster, apps, template = self._scenario()
+        seed_name_hashes(5)
+        single = plan_capacity_incremental(cluster, apps, template, max_new_nodes=60)
+        mesh = make_mesh(sweep=1)  # 8-way node sharding
+        seed_name_hashes(5)
+        sharded = plan_capacity_incremental(
+            cluster, apps, template, max_new_nodes=60, mesh=mesh
+        )
+        assert sharded.success == single.success
+        assert sharded.nodes_added == single.nodes_added
+        assert sharded.probes == single.probes
+        assert _placements(sharded.result) == _placements(single.result)
+        assert len(sharded.result.unscheduled_pods) == len(
+            single.result.unscheduled_pods
+        )
+
+    def test_sharded_probe_sweep_reuses_executables(self):
+        """Per-probe engine instances must NOT re-jit the mesh executables:
+        the compiled-callable cache is mesh-wide, so the probe sweep and
+        the verify run trace at most two round bodies (the same budget the
+        single-device sweep is pinned to)."""
+        import jax
+
+        from simtpu.plan.incremental import plan_capacity_incremental
+
+        cluster, apps, template = self._scenario()
+        mesh = make_mesh(sweep=1)
+        seed_name_hashes(5)
+        jax.clear_caches()
+        plan = plan_capacity_incremental(
+            cluster, apps, template, max_new_nodes=60, mesh=mesh
+        )
+        assert plan.success
+        rounds = {
+            phase: counts.get("rounds", 0)
+            for phase, counts in plan.compiles.items()
+        }
+        assert rounds.get("probes", 0) + rounds.get("verify", 0) <= 2, plan.compiles
+
+
 class TestBatchedSweep:
     def test_matches_serial_planner(self, scenario):
         """The one-shot vmapped sweep must find the same minimum node count

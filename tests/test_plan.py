@@ -300,6 +300,66 @@ class TestIncrementalPlanner:
         assert "still failed" in plan.message
 
 
+class TestProbeCompileBudget:
+    """Shape-bucketed probe compilation: the candidate probe sweep must not
+    shape-specialize the bulk round body per candidate size.  The scenario
+    strands a PARTIAL run (failure-suffix shorter than the full run), so the
+    probes' natural pow2 shapes differ from the base run's — without the
+    bucket snapping (`RoundsEngine.snap_shapes`) the sweep compiles a second
+    round body; with it the probes and the verify re-run ride the base
+    executables."""
+
+    def _scenario(self):
+        from simtpu.synth import make_deployment, make_node
+
+        cluster = ResourceTypes()
+        cluster.nodes = [
+            make_node(
+                f"node-{i:06d}", 8000, 32, {"kubernetes.io/hostname": f"node-{i:06d}"}
+            )
+            for i in range(6)
+        ]
+        res = ResourceTypes()
+        res.deployments = [
+            make_deployment(f"dep-{j}", 40, 1000, 512) for j in range(3)
+        ]
+        template = make_node("tmpl", 16000, 64, {"kubernetes.io/hostname": "tmpl"})
+        return cluster, [AppResource(name="a", resource=res)], template
+
+    def test_probe_sweep_compiles_at_most_two_round_bodies(self):
+        import jax
+
+        from simtpu.plan.incremental import plan_capacity_incremental
+
+        cluster, apps, template = self._scenario()
+        seed_name_hashes(5)
+        jax.clear_caches()  # compile accounting must start cold
+        plan = plan_capacity_incremental(cluster, apps, template, max_new_nodes=60)
+        assert plan.success
+        assert len(plan.probes) >= 3  # base + at least two candidate sizes
+        rounds = {
+            phase: counts.get("rounds", 0)
+            for phase, counts in plan.compiles.items()
+        }
+        # the acceptance pin: across every candidate size, the probe sweep
+        # (and the verify fresh re-run) traces the round body at most twice
+        assert rounds.get("probes", 0) + rounds.get("verify", 0) <= 2, plan.compiles
+        # and with the bucket snapping the expected number is zero: every
+        # probe chunk snaps into a bucket the base run already compiled
+        assert rounds.get("probes", 0) == 0, plan.compiles
+        assert rounds.get("verify", 0) == 0, plan.compiles
+
+    def test_plan_reports_compile_accounting(self):
+        from simtpu.plan.incremental import plan_capacity_incremental
+
+        cluster, apps, template = self._scenario()
+        seed_name_hashes(5)
+        plan = plan_capacity_incremental(cluster, apps, template, max_new_nodes=60)
+        assert {"base", "probes"} <= set(plan.compiles)
+        for counts in plan.compiles.values():
+            assert {"rounds", "scan"} <= set(counts)
+
+
 class TestAutoEngines:
     """Scale-aware engine defaults (VERDICT r4 task 2): `simtpu apply` is one
     command that is always its fastest — serial/binary at conformance scale,
@@ -310,8 +370,8 @@ class TestAutoEngines:
         from simtpu.plan.capacity import ApplierOptions, _resolve_engines
 
         cluster = _small_cluster()
-        search, bulk = _resolve_engines(ApplierOptions(), cluster, [_app(3)])
-        assert (search, bulk) == ("binary", False)
+        search, bulk, mesh = _resolve_engines(ApplierOptions(), cluster, [_app(3)])
+        assert (search, bulk, mesh) == ("binary", False, None)
         assert capsys.readouterr().err == ""
 
     def test_large_node_count_selects_fast_engines(self, capsys):
@@ -321,14 +381,14 @@ class TestAutoEngines:
         cluster.nodes = [
             make_fake_node(f"n{i}", "4", "8Gi") for i in range(AUTO_ENGINE_NODES)
         ]
-        search, bulk = _resolve_engines(ApplierOptions(), cluster, [_app(3)])
+        search, bulk, _ = _resolve_engines(ApplierOptions(), cluster, [_app(3)])
         assert (search, bulk) == ("incremental", True)
         assert "auto-selected" in capsys.readouterr().err
 
     def test_large_declared_pod_count_selects_fast_engines(self):
         from simtpu.plan.capacity import AUTO_ENGINE_PODS, ApplierOptions, _resolve_engines
 
-        search, bulk = _resolve_engines(
+        search, bulk, _ = _resolve_engines(
             ApplierOptions(), _small_cluster(), [_app(AUTO_ENGINE_PODS)]
         )
         assert (search, bulk) == ("incremental", True)
@@ -337,8 +397,8 @@ class TestAutoEngines:
         from simtpu.plan.capacity import AUTO_ENGINE_PODS, ApplierOptions, _resolve_engines
 
         opts = ApplierOptions(search="linear", bulk=False)
-        search, bulk = _resolve_engines(opts, _small_cluster(), [_app(AUTO_ENGINE_PODS)])
-        assert (search, bulk) == ("linear", False)
+        search, bulk, mesh = _resolve_engines(opts, _small_cluster(), [_app(AUTO_ENGINE_PODS)])
+        assert (search, bulk, mesh) == ("linear", False, None)
         assert capsys.readouterr().err == ""
 
     def test_auto_path_plans_documented_config(self, example_dir, monkeypatch):

@@ -424,3 +424,42 @@ def test_preemption_at_scale():
     # generous envelope: the pre-vectorization search alone took minutes
     if _PERF_ASSERT:
         assert wall < 120, f"preemption path too slow: {wall:.1f}s"
+
+
+def test_wave_cap_abort_tags_failures_distinctly(caplog):
+    """ADVICE r5 (`api.py` waves_left): when the termination cap trips, the
+    still-pending preemptors are finalized with their ORIGINAL (stale)
+    failure reason — the report must distinguish a cap abort from a genuine
+    verify failure, and a warning must carry the remaining-pod count."""
+    import logging
+
+    from simtpu.api import PREEMPT_WAVE_CAP_NOTE, Simulator
+
+    node = make_fake_node("n0", "10", "16Gi")
+    fillers = [
+        _prio(make_fake_pod(f"low{i}", "default", "4", "1Gi"), 0) for i in range(2)
+    ]
+    vip = _prio(make_fake_pod("vip", "default", "6", "1Gi"), 1000)
+
+    sim = Simulator()
+    sim.WAVE_CAP_SLACK = -100  # trip the cap on the first wave
+    with caplog.at_level(logging.WARNING, logger="simtpu.api"):
+        result = sim.run_cluster(
+            ResourceTypes(nodes=[node], pods=fillers + [vip])
+        )
+    # the vip WOULD have preempted (test_high_priority_pod_preempts_lower);
+    # the forced cap abort records it unscheduled with the distinct tag
+    assert len(result.unscheduled_pods) == 1
+    reason = result.unscheduled_pods[0].reason
+    assert PREEMPT_WAVE_CAP_NOTE in reason
+    assert "1 pod(s) unresolved" in reason
+    assert any(
+        "preemption wave cap exhausted with 1 pod(s)" in rec.getMessage()
+        for rec in caplog.records
+    )
+    # the untagged path stays untagged
+    sim2 = Simulator()
+    result2 = sim2.run_cluster(
+        ResourceTypes(nodes=[node], pods=fillers + [vip])
+    )
+    assert not result2.unscheduled_pods

@@ -1,7 +1,12 @@
 #!/usr/bin/env python
 """One process of a multi-process (DCN-style) simtpu run.
 
-Usage: multihost_worker.py PROC_ID NUM_PROCS COORD_PORT OUT_JSON
+Usage: multihost_worker.py PROC_ID NUM_PROCS COORD_PORT OUT_JSON [ENGINE]
+
+ENGINE selects the sharded engine under test: "scan" (default) runs the
+serial-equivalent `ShardedEngine`, "rounds" the bulk `ShardedRoundsEngine`
+(same-spec pod runs placed in bulk rounds, node axis sharded — the engine
+behind the sharded incremental planner).
 
 Each process contributes 4 virtual CPU devices
 (--xla_force_host_platform_device_count), joins the cluster through
@@ -28,6 +33,7 @@ def main() -> int:
         sys.argv[3],
         sys.argv[4],
     )
+    engine = sys.argv[5] if len(sys.argv) > 5 else "scan"
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
@@ -43,7 +49,7 @@ def main() -> int:
     jax.config.update("jax_platforms", "cpu")
 
     from simtpu.api import simulate
-    from simtpu.parallel import ShardedEngine
+    from simtpu.parallel import ShardedEngine, ShardedRoundsEngine
     from simtpu.parallel.mesh import initialize_multihost
     from simtpu.synth import synth_apps, synth_cluster
     from simtpu.workloads.expand import seed_name_hashes
@@ -71,11 +77,12 @@ def main() -> int:
         storage_frac=0.2,
     )
     seed_name_hashes(0)
+    engine_cls = {"scan": ShardedEngine, "rounds": ShardedRoundsEngine}[engine]
     result = simulate(
         cluster,
         apps,
         extended_resources=("open-local", "gpu"),
-        engine_factory=lambda t: ShardedEngine(t, mesh),
+        engine_factory=lambda t: engine_cls(t, mesh),
     )
     placements = {}
     for status in result.node_status:
@@ -92,6 +99,7 @@ def main() -> int:
                     "unscheduled": len(result.unscheduled_pods),
                     "process_count": jax.process_count(),
                     "global_devices": len(jax.devices()),
+                    "engine": engine,
                 },
                 f,
             )
