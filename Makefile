@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all install lint test test-all test-perf bench clean
+.PHONY: all install lint test test-all test-perf bench bench-cold clean
 
 all: test
 
@@ -31,6 +31,17 @@ test-perf:
 	SIMTPU_PERF_ASSERT=1 $(PY) tools/run_tests.py
 
 bench:
+	$(PY) bench.py
+
+# cold-start smoke at a small shape with the persistent compilation cache
+# OFF: every executable really compiles, so the JSON line's expand/
+# tensorize/compile/first-dispatch breakdown (and compile wall < serial
+# overlap) measures the AOT pipeline itself, not cache reads.  Compare
+# against SIMTPU_BENCH_PRECOMPILE=0 for the serialized-compile baseline.
+bench-cold:
+	SIMTPU_COMPILATION_CACHE=off SIMTPU_BENCH_NODES=2000 \
+	SIMTPU_BENCH_PODS=20000 SIMTPU_BENCH_SMALL=0 SIMTPU_BENCH_HARD=0 \
+	SIMTPU_BENCH_MATRIX=0 SIMTPU_BENCH_PLAN=0 SIMTPU_BENCH_BIG=0 \
 	$(PY) bench.py
 
 clean:

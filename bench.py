@@ -33,11 +33,21 @@ vs_target > 1 means the target is met on this chip alone (the target names
 a v5e-8; the sharded engines split the node axis over chips, so single-chip
 is the conservative bound).
 
+The cold path additionally splits into `expand_s` / `tensorize_s` /
+`compile_s` (AOT pipeline wall) / `compile_serial_s` (summed per-executable
+compile seconds — wall < serial shows the parallel-compile overlap) /
+`first_dispatch_s`, and warm runs report `fetches` (blocking device→host
+round-trips; `matrix_point_fetches` tracks the coalesced stretch-group
+fetch floor).
+
 Env knobs: SIMTPU_BENCH_NODES (default 100000), SIMTPU_BENCH_PODS (default
 1000000), SIMTPU_BENCH_SCAN_PODS (scan-rate slice, default 2000),
 SIMTPU_BENCH_BASELINE_PODS (default 300), SIMTPU_BENCH_SMALL=0 /
 SIMTPU_BENCH_HARD=0 / SIMTPU_BENCH_MATRIX=0 / SIMTPU_BENCH_PLAN=0 /
-SIMTPU_BENCH_BIG=0 to skip the extra points.
+SIMTPU_BENCH_BIG=0 to skip the extra points, SIMTPU_BENCH_PRECOMPILE=0/1
+to force the background AOT precompile pipeline off/on (unset = auto: on
+for accelerator backends; `make bench-cold` runs a small-shape cold-start
+smoke with the persistent cache off).
 """
 
 from __future__ import annotations
@@ -52,6 +62,20 @@ import numpy as np
 
 def note(msg):
     print(f"# {msg}", file=sys.stderr, flush=True)
+
+
+def _bench_precompile() -> bool:
+    """Whether to AOT-precompile the cold run's executables on a background
+    pool (engine/precompile.py).  SIMTPU_BENCH_PRECOMPILE=0/1 forces;
+    unset = auto, on for accelerator backends only — on CPU the compiles
+    contend with the placement compute for the same host cores (the same
+    gating `simtpu apply` auto applies)."""
+    env = os.environ.get("SIMTPU_BENCH_PRECOMPILE")
+    if env is not None:
+        return env != "0"
+    import jax
+
+    return jax.default_backend() != "cpu"
 
 
 def build_problem(n_nodes: int, n_pods: int, mix: str = "north", with_state: bool = True):
@@ -198,12 +222,18 @@ def time_serial_baseline(tensors, batch, req, limit: int) -> float:
     return (time.perf_counter() - t0) / max(n_pods, 1)
 
 
-def time_bulk(tensors, batch):
+def time_bulk(tensors, batch, precompile: bool = False):
     """Seconds for a full bulk (rounds-engine) placement of the batch: the
     best of two fresh-engine runs, so the reported rate is the steady state a
     capacity-planning sweep sees after the first jit compilation. Also
-    returns the first (cold) run's wall-clock and the reason codes."""
+    returns the first (cold) run's wall-clock, the reason codes, and an
+    `extra` dict: the cold breakdown (`first_dispatch_s` = the first
+    place() wall, `compile_s`/`compile_serial_s` = the AOT pipeline's
+    wall/summed compile seconds when `precompile` is on — wall < serial is
+    the parallel-compile overlap) and the final run's blocking-fetch count
+    (`fetches`, one per device→host round-trip)."""
     from simtpu.engine.rounds import RoundsEngine
+    from simtpu.engine.scan import fetch_counts
 
     class _TZ:
         def freeze(self):
@@ -211,16 +241,46 @@ def time_bulk(tensors, batch):
 
     nodes = reasons = None
     best, cold = float("inf"), None
+    extra = {}
+    pipe = None
     for i in range(2):
         eng = RoundsEngine(_TZ())
         t0 = time.perf_counter()
+        if precompile and i == 0:
+            from simtpu.engine.precompile import precompile_place
+
+            pipe = precompile_place(eng, batch)
+        elif pipe is not None:
+            # warm runs share the registry the way the planner's probe and
+            # verify engines do — an AOT executable does not warm the jit
+            # path's own cache, so a pipeline-less rerun would recompile
+            eng.pipeline = pipe
+        t_dispatch = time.perf_counter()
+        f0 = fetch_counts()["get"]
         nodes, reasons, _ = eng.place(batch)
         run_s = time.perf_counter() - t0
+        extra["fetches"] = fetch_counts()["get"] - f0
         note(f"bulk run {i}: {run_s:.1f}s")
         if cold is None:
             cold = run_s
+            extra["first_dispatch_s"] = round(
+                time.perf_counter() - t_dispatch, 2
+            )
+            if pipe is not None:
+                pipe.wait_all()
+                s = pipe.stats()
+                extra["compile_s"] = round(s["compile_wall_s"], 2)
+                extra["compile_serial_s"] = round(s["compile_serial_s"], 2)
+                note(
+                    f"precompile: {s['submitted']} executables, wall "
+                    f"{s['compile_wall_s']:.1f}s vs serial "
+                    f"{s['compile_serial_s']:.1f}s, hits {s['hits']} "
+                    f"misses {s['misses']} failures {s['failures']}"
+                )
         best = min(best, run_s)
-    return best, cold, nodes, reasons
+    if pipe is not None:
+        pipe.shutdown()
+    return best, cold, nodes, reasons, extra
 
 
 def reason_histogram(nodes, reasons) -> dict:
@@ -244,7 +304,7 @@ def big_point() -> dict:
     LAST, so the GB-scale tensors (and the device statics memoized on
     them) are unreachable while the headline points run."""
     tensors, batch = build_problem(400_000, 1_000_000, with_state=False)
-    wall, _, nodes, reasons = time_bulk(tensors, batch)
+    wall, _, nodes, reasons, _ = time_bulk(tensors, batch)
     placed = int((nodes >= 0).sum())
     total = len(batch.group)
     note(
@@ -295,13 +355,21 @@ def time_plan():
         },
         storage_gib=(4000, 4000),
     )
+    # ONE shared pipeline across the cold and warm plans: AOT executables
+    # never warm the jit path's own cache, so a per-call pipeline would
+    # make the warm plan recompile everything it just compiled
+    pipe = None
+    if _bench_precompile():
+        from simtpu.engine.precompile import AotPipeline
+
+        pipe = AotPipeline()
     out = {}
     for label in ("cold", "warm"):
         seed_name_hashes(7)
         t0 = time.perf_counter()
         plan = plan_capacity_incremental(
             cluster, apps, template, max_new_nodes=128,
-            materialize=False, verify=True,
+            materialize=False, verify=True, pipeline=pipe,
         )
         wall = time.perf_counter() - t0
         t = plan.timings
@@ -324,12 +392,19 @@ def time_plan():
             out["plan_cold_s"] = round(wall, 2)
             out["plan_cold_compiles"] = sum(compiles.values())
             out["plan_cold_probe_round_compiles"] = probe_rounds
+            # the plan's AOT-pipeline split (wall < serial = the probe
+            # sweep's compiles overlapped each other and the host work)
+            if "compile_wall" in t:
+                out["plan_compile_s"] = round(t["compile_wall"], 2)
+                out["plan_compile_serial_s"] = round(t["compile_serial"], 2)
         else:
             out["plan_s"] = round(search, 2)
             out["plan_verified_s"] = round(wall, 2)
             out["plan_warm_compiles"] = sum(compiles.values())
         out["plan_nodes_added"] = plan.nodes_added
         assert plan.success, "plan scenario must be feasible"
+    if pipe is not None:
+        pipe.shutdown()
     return out
 
 
@@ -355,12 +430,13 @@ def main() -> int:
         if os.environ.get(env, "1") == "0" or not north_star:
             return
         p_tensors, p_batch = build_problem(20_000, 100_000, mix=mix, with_state=False)
-        wall, _, p_nodes, p_reasons = time_bulk(p_tensors, p_batch)
+        wall, _, p_nodes, p_reasons, p_extra = time_bulk(p_tensors, p_batch)
         placed = int((p_nodes >= 0).sum())
         total = len(p_batch.group)
         note(
             f"{label} nodes=20000 pods={total} bulk-wall={wall:.2f}s "
-            f"rate={total / wall:.0f} pods/s placed={placed}"
+            f"rate={total / wall:.0f} pods/s placed={placed} "
+            f"fetches={p_extra['fetches']}"
         )
         hist = reason_histogram(p_nodes, p_reasons)
         for reason, cnt in hist.items():
@@ -368,6 +444,10 @@ def main() -> int:
         if record_to is not None:
             record_to[f"{mix}_point_s"] = round(wall, 2)
             record_to[f"{mix}_point_rate"] = round(total / wall)
+            # blocking device→host round-trips of one warm placement (the
+            # matrix point's measured floor was its ~54 per-stretch
+            # fetches; stretch-group coalescing is the lever)
+            record_to[f"{mix}_point_fetches"] = p_extra["fetches"]
 
     side_records = {}
     # the r01-continuity point: same constraint mix at 20k x 100k
@@ -392,6 +472,7 @@ def main() -> int:
 
     from simtpu.engine.scan import flags_from
 
+    precompile = _bench_precompile()
     note("problem built; timing scan slice")
     scan_slice = tuple(arr[:scan_pods] for arr in pod_arrays)
     engine_s, _ = time_engine(
@@ -405,7 +486,9 @@ def main() -> int:
     scan_rate = scan_pods / engine_s
     note(f"scan={scan_rate:.0f} pods/s; timing bulk")
 
-    bulk_s, cold_run_s, placed_nodes, reasons = time_bulk(tensors, batch)
+    bulk_s, cold_run_s, placed_nodes, reasons, cold_extra = time_bulk(
+        tensors, batch, precompile=precompile
+    )
     placed = int((placed_nodes >= 0).sum())
     unplaced = len(batch.group) - placed
     pods_per_sec = len(batch.group) / bulk_s
@@ -443,6 +526,17 @@ def main() -> int:
         # compilation (or, with a warm persistent cache, cache loading)
         "cold_compile_s": round(cold_run_s - bulk_s, 2),
         "cold_run_s": round(cold_run_s, 2),
+        # cold-path breakdown (ISSUE 2): expand → tensorize → parallel AOT
+        # compile (wall vs the summed per-executable seconds serializing
+        # them would cost) → first dispatch; plus the warm run's blocking
+        # device→host round-trip count
+        "expand_s": round(gen_s, 2),
+        "tensorize_s": round(tensorize_s, 2),
+        "first_dispatch_s": cold_extra.get("first_dispatch_s"),
+        "compile_s": cold_extra.get("compile_s"),
+        "compile_serial_s": cold_extra.get("compile_serial_s"),
+        "precompile": precompile,
+        "fetches": cold_extra.get("fetches"),
         "compilation_cache": bool(cache_dir),
         "placed": placed,
         "unplaced": unplaced,

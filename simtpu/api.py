@@ -84,6 +84,30 @@ def _anti_topo_keys(pod: dict) -> set:
     }
 
 
+def _head_affinity_depends_on(pod: dict, wave_pods) -> bool:
+    """True when `pod`'s REQUIRED positive pod-affinity selects another
+    wave pod's labels — the case where finalizing the pod's retry failure
+    would be unsound: a fresh-retried head verifies FIRST in its wave, so
+    its verdict never saw the selected pod placed, and that pod (demoted or
+    later in the wave) may yet place.  Mirrors the demote predicate's
+    conservatism (namespace scoping is deliberately ignored: a false
+    positive just defers finality, bounded by the wave cap; a false
+    negative would finalize a failure the serial evict/retry order could
+    avoid — ADVICE r5 #3)."""
+    from .core.match import match_label_selector
+    from .core.objects import labels_of, pod_affinity
+
+    aff = pod_affinity(pod).get("podAffinity") or {}
+    terms = aff.get("requiredDuringSchedulingIgnoredDuringExecution") or []
+    if not terms:
+        return False
+    return any(
+        match_label_selector(t.get("labelSelector"), labels_of(dp))
+        for t in terms
+        for dp in wave_pods
+    )
+
+
 def _restore_topo_keys(pod: dict) -> set:
     """topologyKeys along which re-adding previously evicted pods can turn
     this pod's filter verdict from pass to fail on a node the victims do
@@ -130,10 +154,16 @@ class Simulator:
         engine_factory=None,
         use_greed: bool = False,
         sched_config=None,
+        precompile: bool = False,
     ):
         self._extra_resources = extra_resources
         self._use_greed = use_greed
         self._sched_config = sched_config
+        # AOT-precompile each batch's jit executables on a background
+        # thread pool before dispatching it (engine/precompile.py); the
+        # pipeline registry persists across batches of one simulation
+        self._precompile = precompile
+        self._pipeline = None
         self._engine_factory = engine_factory or Engine
         self._tensorizer: Optional[Tensorizer] = None
         self._engine: Optional[Engine] = None
@@ -188,6 +218,11 @@ class Simulator:
         return self._result()
 
     def close(self) -> None:
+        if self._pipeline is not None:
+            # cancel enumerated-but-undispatched background compiles so a
+            # one-shot run doesn't linger at exit finishing unused work
+            self._pipeline.shutdown()
+            self._pipeline = None
         self._tensorizer = None
         self._engine = None
 
@@ -237,6 +272,12 @@ class Simulator:
         if not pods:
             return
         batch = self._tensorizer.add_pods(pods)
+        if self._precompile:
+            from .engine.precompile import precompile_place
+
+            self._pipeline = precompile_place(
+                self._engine, batch, self._pipeline
+            )
         nodes, reasons, extras = self._engine.place(batch)
         # record every batch outcome FIRST so _scheduled/_placed_prio stay
         # index-parallel with the engine's placement log (Engine.place logged
@@ -361,6 +402,12 @@ class Simulator:
             return
         # (pod, reason, saved victim records or None, fresh-retry used)
         pending = [(pod, reason, None, False) for pod, reason in failed]
+        # heads already granted the affinity-dependence finality deferral
+        # (ADVICE r5 #3): one deferral per pod — enough for a placeable
+        # anchor to land before the head's next fresh attempt, while two
+        # mutually-dependent unplaceable pods finalize with their true
+        # reasons instead of ping-ponging into the wave cap
+        affinity_deferred: set = set()
         # termination insurance: the retried-finality rule below only
         # finalizes FRESH-attempt failures, so an adversarial geometry
         # could in principle ping-pong demotions between already-retried
@@ -523,7 +570,25 @@ class Simulator:
                 self._engine.remove_placements(revert)  # permanent, no undo
             self._restore_victims(saved_per_pod[f])
             pod_f, reason_f, _, preev_f, retried_f = wave[f]
-            if retried_f and preev_f is None:
+            # retry-finality exemption (ADVICE r5 #3): a fresh-retried head
+            # whose required positive affinity selects another wave pod is
+            # NOT finalized — the head verifies first in its wave, so its
+            # verdict never saw that pod placed, and the serial evict/retry
+            # order could still place both.  The exempted head re-queues
+            # BEHIND the pods it depends on (deliberately trading the
+            # victim-node re-grab protection below for the chance that the
+            # anchor pod lands first); termination stays bounded by the
+            # wave cap.
+            affinity_dependent = id(pod_f) not in affinity_deferred and (
+                _head_affinity_depends_on(
+                    pod_f, [wave[w][0] for w in range(len(wave)) if w != f]
+                )
+            )
+            if affinity_dependent and retried_f and preev_f is None:
+                # this exemption actually skipped finality — consume the
+                # pod's one deferral (ordering-only moves don't)
+                affinity_deferred.add(id(pod_f))
+            if retried_f and preev_f is None and not affinity_dependent:
                 # the failed attempt was a FRESH proposal against the true
                 # wave-start log state — the verify verdict is
                 # serial-authoritative.  (A retried pod failing a
@@ -538,15 +603,13 @@ class Simulator:
             # of it could re-grab the head's victim node (wave evictions
             # apply before every verify), wrongly finalizing the head's
             # failure; demoted pods re-verify right after, before after-f
-            # pods, keeping their relative serial order.  (Known bounded
-            # divergence: if the head's verdict depends on a demoted pod
-            # BEING placed — a required positive affinity to it — the retry
-            # can finalize a failure the serial order would not; favoring
-            # finality keeps the wave loop's termination bound.)
-            pending = head + [
+            # pods, keeping their relative serial order.  (Exception: an
+            # affinity-dependent head queues LAST, see above.)
+            rest = [
                 (wave[w][0], wave[w][1], saved_per_pod[w], wave[w][4])
                 for w in [*sorted(demote), *range(f + 1, len(wave))]
             ]
+            pending = rest + head if affinity_dependent else head + rest
 
     def _restore_victims(self, records) -> None:
         """Re-insert evicted victims (a failed preemptor's) at the END of
@@ -1005,6 +1068,7 @@ def simulate(
     use_greed: bool = False,
     bulk: bool = False,
     sched_config=None,
+    precompile: bool = False,
 ) -> SimulateResult:
     """One-shot simulation (`pkg/simulator/core.go:64-103`): expand cluster
     workloads, run the cluster, then schedule each app in configured order.
@@ -1014,7 +1078,9 @@ def simulate(
     node axis sharded over a device mesh (simtpu/parallel), or `bulk=True`
     to place same-spec pod runs in bulk rounds (engine/rounds.py —
     feasibility-exact, tie-breaking may differ from the serial scan). The two
-    are mutually exclusive.
+    are mutually exclusive. `precompile=True` AOT-compiles each batch's jit
+    executables on a background thread pool before dispatching
+    (engine/precompile.py; placements are bit-identical either way).
 
     Result pods are copied at the levels the simulation wrote (top level,
     metadata incl. labels/annotations, spec, status); deeper sub-structures
@@ -1033,6 +1099,7 @@ def simulate(
         engine_factory=engine_factory,
         use_greed=use_greed,
         sched_config=sched_config,
+        precompile=precompile,
     )
     cluster = ResourceTypes(**{k: list(v) for k, v in vars(cluster).items()})
     cluster_pods = get_valid_pods_exclude_daemonset(cluster)

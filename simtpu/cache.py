@@ -38,26 +38,41 @@ anyway; the 2-minute cold path the cache exists for is the TPU one.
 from __future__ import annotations
 
 import os
+import sys
 
 _DEFAULT_DIR = os.path.join(
     os.path.expanduser("~"), ".cache", "simtpu", "xla"
 )
 
 
+def _skip_note(reason: str) -> None:
+    """One stderr line whenever the persistent cache stays off — a silently
+    disabled cache looks exactly like a slow cold path, and cold-path
+    triage should never have to guess which one it is."""
+    print(f"simtpu: persistent compilation cache off ({reason})", file=sys.stderr)
+
+
 def enable_compilation_cache(path: str = None) -> str | None:
     """Point JAX's persistent compilation cache at `path` (default:
     $SIMTPU_COMPILATION_CACHE or ~/.cache/simtpu/xla). Returns the cache
     directory, or None when disabled — via SIMTPU_COMPILATION_CACHE=0/off
-    or because the backend is CPU (see module docstring)."""
+    or because the backend is CPU (see module docstring); every disabled
+    exit says so on stderr."""
     import jax
 
     env = os.environ.get("SIMTPU_COMPILATION_CACHE", "")
     if env.lower() in ("0", "off", "false", "none", "no", "disabled"):
+        _skip_note(f"SIMTPU_COMPILATION_CACHE={env}")
         return None
     try:
         if jax.default_backend() == "cpu":
+            # ACCELERATOR ONLY — the XLA:CPU deserialize segfault (module
+            # docstring); the note keeps the gating observable
+            _skip_note("CPU backend: the XLA:CPU executable loader "
+                       "segfaults on cache deserialization")
             return None
-    except Exception:
+    except Exception as exc:
+        _skip_note(f"backend probe failed: {type(exc).__name__}")
         return None
     cache_dir = path or env or _DEFAULT_DIR
     try:
@@ -69,6 +84,7 @@ def enable_compilation_cache(path: str = None) -> str | None:
         # the dir flag LAST: it alone activates the cache, so a partial
         # failure above leaves the cache fully off and the None return honest
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-    except Exception:  # cache is an optimization — never fail the run
+    except Exception as exc:  # cache is an optimization — never fail the run
+        _skip_note(f"setup failed: {type(exc).__name__}: {exc}")
         return None
     return cache_dir

@@ -82,6 +82,7 @@ def cmd_apply(args: argparse.Namespace) -> int:
         search=args.search,
         bulk=args.bulk,
         shard=args.shard,
+        precompile=args.precompile,
         corrected_ds_overhead=args.corrected_ds_overhead,
     )
     def fail_early(exc: Exception) -> int:
@@ -221,6 +222,26 @@ def build_parser() -> argparse.ArgumentParser:
         dest="shard",
         action="store_false",
         help="force single-device execution of the incremental planner",
+    )
+    apply_p.add_argument(
+        "--precompile",
+        dest="precompile",
+        action="store_true",
+        default=None,
+        help="AOT-precompile the run's jit executables on a background "
+        "thread pool as soon as the shapes are known, so the cold first "
+        "run overlaps compilation with host work instead of serializing "
+        "compiles at first dispatch (default: auto — on for accelerator "
+        "backends, off on CPU where the compiles would contend with the "
+        "placement compute for the same cores; placements are identical "
+        "either way)",
+    )
+    apply_p.add_argument(
+        "--no-precompile",
+        dest="precompile",
+        action="store_false",
+        help="compile each executable at its first dispatch (the "
+        "pre-pipeline cold path)",
     )
     apply_p.add_argument(
         "--json",

@@ -95,6 +95,7 @@ from .scan import (
     _pow2_up,
     add_rows,
     count_trace,
+    fetch_outputs,
     filter_and_score,
     pad_pods_pow2,
     score_pod,
@@ -1032,16 +1033,42 @@ class RoundsEngine(Engine):
     _pad_pods = staticmethod(pad_pods_pow2)
     _pow2 = staticmethod(_pow2_up)
 
+    def _aot_bulk(
+        self, n_domains, k_cap, flags, quota=False, self_aff=False,
+        ext_mats=False,
+    ):
+        """(pipeline key name, jit callable, static argument tail) for the
+        multi-round bulk executable — the contract `Engine._aot_scan`
+        documents, for the bulk path.  Overridden by the sharded subclass
+        with its mesh-compiled callables (statics baked into the build)."""
+        return "rounds", _round_place_many, (
+            n_domains, k_cap, flags, quota, self_aff, ext_mats,
+        )
+
+    def _aot_bulk_sliced(
+        self, n_domains, k_cap, flags, quota=False, self_aff=False,
+        ext_mats=False,
+    ):
+        """The row-sliced counterpart of `_aot_bulk`."""
+        return "rounds_sliced", _round_place_many_sliced, (
+            n_domains, k_cap, flags, quota, self_aff, ext_mats,
+        )
+
     def _bulk_call(
         self, statics, state, seg_pods, ks, n_domains, k_cap, flags,
         quota=False, self_aff=False, ext_mats=False,
     ):
-        """Dispatch one multi-round bulk call (overridden by the sharded
-        subclass to run on a mesh)."""
-        return _round_place_many(
-            statics, state, seg_pods, ks, n_domains, k_cap, flags, quota,
-            self_aff, ext_mats,
+        """Dispatch one multi-round bulk call — through the precompile
+        pipeline's registry when one is attached, else the plain jit."""
+        name, fn, tail = self._aot_bulk(
+            n_domains, k_cap, flags, quota, self_aff, ext_mats
         )
+        args = (statics, state, seg_pods, ks)
+        if self.pipeline is not None:
+            return self.pipeline.call(
+                name, tail, args, lambda: fn(*args, *tail)
+            )
+        return fn(*args, *tail)
 
     def _bulk_call_sliced(
         self, statics, state, rows, g_terms_c, term_topo_c, ip_of_c,
@@ -1049,13 +1076,19 @@ class RoundsEngine(Engine):
         quota=False, self_aff=False, ext_mats=False,
     ):
         """Dispatch one row-sliced multi-round bulk call — slice, rounds
-        and scatter-back fused into one device call (overridden by the
-        sharded subclass to run on a mesh)."""
-        return _round_place_many_sliced(
-            statics, state, rows, g_terms_c, term_topo_c, ip_of_c,
-            seg_pods, ks, n_domains, k_cap, flags, quota, self_aff,
-            ext_mats,
+        and scatter-back fused into one device call."""
+        name, fn, tail = self._aot_bulk_sliced(
+            n_domains, k_cap, flags, quota, self_aff, ext_mats
         )
+        args = (
+            statics, state, rows, g_terms_c, term_topo_c, ip_of_c,
+            seg_pods, ks,
+        )
+        if self.pipeline is not None:
+            return self.pipeline.call(
+                name, tail, args, lambda: fn(*args, *tail)
+            )
+        return fn(*args, *tail)
 
     def _run_scan_segment(self, statics, state, pods, a, b, flags):
         # chunked + term-row-sliced (scan.run_scan_chunked): serial
@@ -1071,6 +1104,7 @@ class RoundsEngine(Engine):
             self._current_tensors,
             np.asarray(self._current_batch.group)[a:b],
             scan_call=self._scan_call,
+            prefetch=self._prefetch_pods,
         )
 
     #: carried-row budget per bulk chunk (padded to the next power of two):
@@ -1150,23 +1184,62 @@ class RoundsEngine(Engine):
             rows = np.concatenate([rows, unused])
         return rows
 
-    def _bulk_chunk(
-        self, statics, state, chunk, rows_p, pods, tensors, flags,
-        quota=False, self_aff=False, ext_mats=False,
-    ):
-        """Run one chunk of bulk runs through _bulk_call, carrying only the
-        chunk's cnt-plane rows when rows_p is given."""
-        s_real = len(chunk)
-        s_pad = self._pow2(s_real)
-        firsts = np.array([i0 for _, i0, _ in chunk], np.int32)
-        ks = np.array([j0 - i0 for _, i0, j0 in chunk], np.int32)
-        k_cap = self._pow2(int(ks.max()))
+    @staticmethod
+    def _kind_flags(bkind: str):
+        """(quota, self_aff, ext_mats) for a bulk segment kind — the single
+        mapping both the dispatcher and the AOT enumerator use."""
+        return (
+            bkind in ("bulkq", "bulkqm"),
+            bkind in ("bulka", "bulkam"),
+            bkind.endswith("m"),
+        )
 
-        # shape bucketing: snap the chunk's natural pow2 shape UP into the
-        # cheapest already-compiled dominating bucket of the same variant,
-        # so planner probes reuse warm executables across candidate sizes
-        # instead of shape-specializing per probe (padded segments are k=0
-        # no-op rounds; padded term rows ride along unchanged)
+    @staticmethod
+    def _stretch_group(segments, idx: int):
+        """Consume the maximal run of consecutive NON-scan segments at
+        `idx` into [(bulk kind, [same-kind segments]), ...]; returns
+        (group_runs, next idx).  Shared by `_dispatch` and the AOT
+        enumerator — the two walks must agree for the registry to hit."""
+        group_runs = []
+        while idx < len(segments) and segments[idx][0] != "scan":
+            bkind = segments[idx][0]
+            run = []
+            while idx < len(segments) and segments[idx][0] == bkind:
+                run.append(segments[idx])
+                idx += 1
+            group_runs.append((bkind, run))
+        return group_runs, idx
+
+    def _group_work_items(self, group_runs, batch, tensors):
+        """Yield (chunk, rows_p, quota, self_aff, ext_mats) per bulk chunk
+        of one stretch group, in dispatch order — the work list both the
+        dispatcher executes and the AOT enumerator compiles ahead of it."""
+        for bkind, run in group_runs:
+            quota, self_aff, ext_mats = self._kind_flags(bkind)
+            for chunk, rows_p in self._chunk_runs(
+                run, batch, tensors,
+                max_segs=self.MATS_CHUNK if ext_mats else None,
+            ):
+                yield chunk, rows_p, quota, self_aff, ext_mats
+
+    def _chunk_shape(
+        self, chunk, rows_p, tensors, flags,
+        quota=False, self_aff=False, ext_mats=False, ks=None,
+    ):
+        """The (s_pad, k_cap, rows_p) shape one chunk of bulk runs will
+        dispatch at, with bucket snapping and registry bookkeeping: snap
+        the chunk's natural pow2 shape UP into the cheapest
+        already-compiled dominating bucket of the same variant, so planner
+        probes reuse warm executables across candidate sizes instead of
+        shape-specializing per probe (padded segments are k=0 no-op
+        rounds; padded term rows ride along unchanged).  Deterministic
+        given the registry state — the AOT precompiler walks the same
+        sequence ahead of the dispatches, so every shape it registers here
+        is one the dispatch path can land on."""
+        s_pad = self._pow2(len(chunk))
+        if ks is None:
+            ks = np.array([j0 - i0 for _, i0, j0 in chunk], np.int32)
+        k_cap = self._pow2(int(ks.max()))
         t = int(tensors.n_terms)
         variant = (quota, self_aff, ext_mats, rows_p is not None, flags)
         r_nat = 0 if rows_p is None else len(rows_p)
@@ -1191,30 +1264,80 @@ class RoundsEngine(Engine):
                 elif rows_p is None or r_b == r_nat:
                     s_pad, k_cap = s_b, k_b
         shapes.add((s_pad, k_cap, 0 if rows_p is None else len(rows_p)))
+        return s_pad, k_cap, rows_p
 
+    def _prepare_bulk_chunk(
+        self, chunk, rows_p, pods, tensors, flags,
+        quota=False, self_aff=False, ext_mats=False,
+    ):
+        """Everything one bulk chunk's dispatch needs, with the
+        host→device transfers already started (non-blocking
+        `_prefetch_pods`): building chunk i+1's work item right after
+        chunk i dispatches overlaps its transfer with chunk i's round
+        execution — the double-buffer half of the cold-start pipeline."""
+        s_real = len(chunk)
+        firsts = np.array([i0 for _, i0, _ in chunk], np.int32)
+        ks = np.array([j0 - i0 for _, i0, j0 in chunk], np.int32)
+        s_pad, k_cap, rows_p = self._chunk_shape(
+            chunk, rows_p, tensors, flags, quota, self_aff, ext_mats, ks=ks
+        )
         firsts = np.pad(firsts, (0, s_pad - s_real), constant_values=firsts[-1])
         ks = np.pad(ks, (0, s_pad - s_real))  # k=0 rounds are no-ops
         # pods stay host-side (build_pod_arrays): the gather is a cheap
-        # numpy fancy-index and _bulk_call's jit transfers the [S, ...]
+        # numpy fancy-index and the bulk call transfers the [S, ...]
         # representatives — never the full batch
         seg_pods = tuple(arr[firsts] for arr in pods)
-
+        work = {
+            "chunk": chunk,
+            "k_cap": k_cap,
+            "ks": ks,
+            "rows": rows_p,
+            "quota": quota,
+            "self_aff": self_aff,
+            "ext_mats": ext_mats,
+        }
         if rows_p is None:
-            state, outs = self._bulk_call(
-                statics, state, seg_pods, ks,
-                tensors.n_domains, k_cap, flags, quota, self_aff, ext_mats,
-            )
+            work["seg_pods"] = self._prefetch_pods(seg_pods)
         else:
             from .scan import remap_term_ids
 
             g_terms, term_topo, ip_of = self._host_term_maps(tensors)
             g_terms_chunk = remap_term_ids(g_terms, rows_p, tensors.n_terms)
-            state, outs = self._bulk_call_sliced(
-                statics, state, rows_p, g_terms_chunk,
-                term_topo[rows_p], ip_of[rows_p], seg_pods, ks,
-                tensors.n_domains, k_cap, flags, quota, self_aff, ext_mats,
+            sliced = (
+                rows_p, g_terms_chunk, term_topo[rows_p], ip_of[rows_p],
+                seg_pods,
             )
-        return state, outs
+            (
+                work["rows"], work["g_terms_c"], work["term_topo_c"],
+                work["ip_of_c"], work["seg_pods"],
+            ) = self._prefetch_pods(sliced)
+        return work
+
+    def _dispatch_bulk_chunk(self, statics, state, work, tensors, flags):
+        """Dispatch one prepared bulk chunk through _bulk_call(_sliced)."""
+        if work.get("g_terms_c") is None:
+            return self._bulk_call(
+                statics, state, work["seg_pods"], work["ks"],
+                tensors.n_domains, work["k_cap"], flags, work["quota"],
+                work["self_aff"], work["ext_mats"],
+            )
+        return self._bulk_call_sliced(
+            statics, state, work["rows"], work["g_terms_c"],
+            work["term_topo_c"], work["ip_of_c"], work["seg_pods"],
+            work["ks"], tensors.n_domains, work["k_cap"], flags,
+            work["quota"], work["self_aff"], work["ext_mats"],
+        )
+
+    def _bulk_chunk(
+        self, statics, state, chunk, rows_p, pods, tensors, flags,
+        quota=False, self_aff=False, ext_mats=False,
+    ):
+        """Run one chunk of bulk runs through _bulk_call, carrying only the
+        chunk's cnt-plane rows when rows_p is given."""
+        work = self._prepare_bulk_chunk(
+            chunk, rows_p, pods, tensors, flags, quota, self_aff, ext_mats
+        )
+        return self._dispatch_bulk_chunk(statics, state, work, tensors, flags)
 
     @staticmethod
     def _record_chunk(
@@ -1305,30 +1428,45 @@ class RoundsEngine(Engine):
             # simulation — the dominant device cost at 100k nodes. Rows are
             # gathered before and scattered back after each chunk (in
             # place, donated).
-            bkind = kind
-            quota = bkind in ("bulkq", "bulkqm")
-            self_aff = bkind in ("bulka", "bulkam")
-            ext_mats = bkind.endswith("m")
-            run = []
-            while idx < len(segments) and segments[idx][0] == bkind:
-                run.append(segments[idx])
-                idx += 1
+            #
+            # Consecutive bulk STRETCHES of different kinds (a matrix run
+            # next to a plain run next to a quota run, the shape of the
+            # matrix mix) form one STRETCH GROUP: every chunk of every kind
+            # dispatches back-to-back — the inter-chunk state dependency
+            # stays device-side, the compiled bodies just alternate — and
+            # ONE device_get materializes the whole group's outputs.  Each
+            # blocking fetch costs a full tunnel round-trip (~100ms)
+            # regardless of payload, and the per-stretch fetches were the
+            # matrix point's measured floor (docs/status.md).  Leftovers
+            # re-probe after the whole group — the same divergence class as
+            # the pre-existing per-stretch deferral (reasons reflect the
+            # more-constrained final state; a leftover that PLACES sees the
+            # neighboring stretches' placements first).
+            group_runs, idx = self._stretch_group(segments, idx)
             leftovers = []
             lvm_sizes = np.asarray(ext["lvm_size"])
             dev_sizes = np.asarray(ext["dev_size"])
-            # dispatch every chunk first — jit calls are async and the
-            # inter-chunk state dependency stays device-side, so the tunnel
-            # pipelines all rounds; outputs materialize afterwards, and the
-            # host record work overlaps the device queue instead of
-            # synchronizing once per chunk
+
+            # dispatch every chunk first — jit calls are async, so the
+            # tunnel pipelines all rounds; outputs materialize afterwards,
+            # and the host record work overlaps the device queue instead of
+            # synchronizing once per chunk.  Preparation runs one chunk
+            # AHEAD of the dispatch point (double buffer): chunk i+1's pod
+            # representatives start their non-blocking transfer while chunk
+            # i's rounds execute.
             pending = []
-            for chunk, rows_p in self._chunk_runs(
-                run, batch, tensors,
-                max_segs=self.MATS_CHUNK if ext_mats else None,
-            ):
-                state, outs_dev = self._bulk_chunk(
-                    statics, state, chunk, rows_p, pods, tensors, flags,
-                    quota, self_aff, ext_mats,
+            items = self._group_work_items(group_runs, batch, tensors)
+            nxt = next(items, None)
+            work = (
+                self._prepare_bulk_chunk(
+                    nxt[0], nxt[1], pods, tensors, flags, *nxt[2:]
+                )
+                if nxt is not None
+                else None
+            )
+            while work is not None:
+                state, outs_dev = self._dispatch_bulk_chunk(
+                    statics, state, work, tensors, flags
                 )
                 # start the device→host copies NOW: the transfers ride the
                 # tunnel concurrently with later dispatches, so the fetch
@@ -1337,15 +1475,21 @@ class RoundsEngine(Engine):
                 for o in outs_dev:
                     if hasattr(o, "copy_to_host_async"):
                         o.copy_to_host_async()
-                pending.append((chunk, outs_dev))
-            # ONE device_get for every chunk: each call pays a full tunnel
-            # round-trip (~100ms on the tunneled backend) regardless of how
-            # much data it moves, and the device queue has already drained
-            # by the first fetch
-            fetched = jax.device_get([outs for _, outs in pending])
-            for (chunk, _), outs_host in zip(pending, fetched):
+                pending.append((work["chunk"], work["ext_mats"], outs_dev))
+                nxt = next(items, None)
+                work = (
+                    self._prepare_bulk_chunk(
+                        nxt[0], nxt[1], pods, tensors, flags, *nxt[2:]
+                    )
+                    if nxt is not None
+                    else None
+                )
+            # ONE device_get for the whole stretch group: the device queue
+            # has already drained by the first fetch
+            fetched = fetch_outputs([outs for _, _, outs in pending])
+            for (chunk, ext_mats_c, _), outs_host in zip(pending, fetched):
                 hosts = tuple(np.asarray(o) for o in outs_host)
-                if ext_mats:
+                if ext_mats_c:
                     self._record_chunk_mats(
                         chunk, hosts, nodes, reasons, lvm_alloc, dev_take,
                         gpu_shares, dev_sizes, leftovers,
@@ -1355,8 +1499,8 @@ class RoundsEngine(Engine):
                         chunk, hosts, nodes, reasons, lvm_alloc, dev_take,
                         gpu_shares, gpu_mem, lvm_sizes, dev_sizes, leftovers,
                     )
-            # Leftovers re-check after the whole bulk stretch, so their
-            # reasons reflect the (more-constrained) final state. Leftover
+            # Leftovers re-check after the whole bulk stretch group, so
+            # their reasons reflect the (more-constrained) final state. Leftover
             # pods of one run are IDENTICAL, and a failed serial step leaves
             # the state untouched, so ONE probe per run decides its whole
             # remainder (the all-fail case is O(1) probes per run; at
@@ -1476,6 +1620,6 @@ class RoundsEngine(Engine):
             tuple(arr[idx] for arr in pods), self._pow2(len(idx))
         )
         state, outs = self._scan_call(statics, state, seg, flags)
-        outs = jax.device_get(outs)
+        outs = fetch_outputs(outs)
         return state, tuple(np.asarray(o)[: len(idx)] for o in outs)
 

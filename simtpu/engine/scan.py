@@ -16,6 +16,7 @@ deterministic, and placement-set-equivalent for conformance purposes
 
 from __future__ import annotations
 
+import threading
 from functools import partial
 from typing import List, NamedTuple, Tuple
 
@@ -67,17 +68,42 @@ FAIL_VOLUME_BIND = 11  # PVC missing / not bindable / PV zone mismatch
 # once per (re)trace, i.e. once per distinct compiled shape signature — the
 # observability behind the planner's compile accounting (PlanResult.compiles)
 # and the compile-count regression tests. Host-side state mutated at trace
-# time only; steady-state dispatches never touch it.
+# time only; steady-state dispatches never touch it.  (With the background
+# precompile pipeline, engine/precompile.py, AOT lowering on worker threads
+# bumps these too — the counts then attribute a trace to whatever phase is
+# active when the background lowering happens to run; the lock keeps
+# concurrent worker-thread traces from losing increments.)
 TRACE_COUNTS = {"scan": 0, "rounds": 0}
+_TRACE_LOCK = threading.Lock()
 
 
 def count_trace(kind: str) -> None:
-    TRACE_COUNTS[kind] = TRACE_COUNTS.get(kind, 0) + 1
+    with _TRACE_LOCK:
+        TRACE_COUNTS[kind] = TRACE_COUNTS.get(kind, 0) + 1
 
 
 def trace_counts() -> dict:
     """Snapshot of the per-kind jit-trace counters."""
     return dict(TRACE_COUNTS)
+
+
+# Blocking device→host fetch counter: every engine-path jax.device_get goes
+# through fetch_outputs, so the bench can report how many tunnel round-trips
+# a placement paid (each costs fixed wire latency regardless of payload —
+# the matrix point's measured floor, docs/status.md).
+FETCH_COUNTS = {"get": 0}
+
+
+def fetch_outputs(tree):
+    """jax.device_get with round-trip accounting (one bump per blocking
+    fetch, however much data it moves)."""
+    FETCH_COUNTS["get"] += 1
+    return jax.device_get(tree)
+
+
+def fetch_counts() -> dict:
+    """Snapshot of the blocking-fetch counter."""
+    return dict(FETCH_COUNTS)
 
 
 REASON_TEXT = {
@@ -1054,32 +1080,38 @@ def pad_row_ids(rows: np.ndarray, t: int):
     return rows
 
 
-def run_scan_chunked(
-    statics: StaticArrays,
-    state: SchedState,
-    pods,
-    flags: StepFlags,
-    tensors,
+def _sliced_statics_fields(statics, rows_p):
+    """The group-axis statics fields a chunk context actually gathers:
+    `g_terms` is excluded when a host-remapped copy replaces it (row-sliced
+    contexts) and [1, N]-collapsed constant planes are never gathered.
+    Shared by run_scan_chunked and the precompile shape enumerator
+    (engine/precompile.py) — the two must agree on the sliced shapes or the
+    AOT executables would never match a dispatch signature."""
+    fields = _GROUP_FIELDS
+    if rows_p is not None:
+        fields = tuple(f for f in fields if f != "g_terms")
+    return tuple(f for f in fields if getattr(statics, f).shape[0] > 1)
+
+
+def plan_scan_chunks(
     groups: np.ndarray,
-    scan_call=None,
+    tensors,
+    flags: StepFlags,
     chunk: int = None,
     row_budget: int = None,
 ):
-    """Serial-equivalent scan over `pods`, dispatched in pow2 chunks whose
-    count planes are sliced to each chunk's term-row union.
+    """The deterministic chunk plan of a chunked serial scan: yields
+    (c0, c1, gs_p, rows_p) per dispatch, where gs_p is the padded group set
+    the chunk's statics are sliced to (None = full planes) and rows_p the
+    padded term-row list its count planes carry (None = full plane).
 
-    `groups` is the host-side group id per pod (drives the row unions).
-    `scan_call(statics, state, seg, flags)` defaults to the compiled
-    `_run_scan`; engines pass their sharded variants.  Returns
-    (final_state, host output tuple) — outputs are numpy, truncated to the
-    real pod count."""
-    call = scan_call or _run_scan
+    Single source of truth for the chunk contexts — `run_scan_chunked`
+    executes this plan, and the AOT precompiler (engine/precompile.py)
+    walks the same plan to enumerate the executables a run will need
+    before the first dispatch."""
     chunk = _SCAN_CHUNK if chunk is None else chunk
     row_budget = _SCAN_ROW_BUDGET if row_budget is None else row_budget
     n = groups.shape[0]
-    if n == 0:  # preserve _run_scan's total contract (empty outputs)
-        state, outs = call(statics, state, pods, flags)
-        return state, tuple(np.asarray(o) for o in jax.device_get(outs))
     t = int(tensors.n_terms)
     use_topo = (
         flags.spread_hard
@@ -1092,6 +1124,69 @@ def run_scan_chunked(
     g_total = len(tensors.groups)  # statics planes may be [1, N]-collapsed
     group_sliceable = _pow2_up(min(g_total, _SCAN_GROUP_BUDGET)) < g_total
     g_terms_host = _compact_terms(tensors)[0] if row_sliceable else None
+    for c0 in range(0, n, chunk):
+        c1 = min(c0 + chunk, n)
+        gs = np.unique(groups[c0:c1])
+        gs_p = None
+        if group_sliceable and len(gs) <= _SCAN_GROUP_BUDGET:
+            # duplicate padding is fine here: the group axis is read-only
+            pad = _pow2_up(len(gs)) - len(gs)
+            gs_p = np.concatenate([gs, np.repeat(gs[-1:], pad)]).astype(np.int32)
+        rows_p = None
+        if row_sliceable:
+            rows = np.unique(g_terms_host[gs])
+            rows = rows[rows >= 0]
+            if len(rows) <= row_budget:
+                rows_p = pad_row_ids(np.sort(rows), t)
+        yield c0, c1, gs_p, rows_p
+
+
+def run_scan_chunked(
+    statics: StaticArrays,
+    state: SchedState,
+    pods,
+    flags: StepFlags,
+    tensors,
+    groups: np.ndarray,
+    scan_call=None,
+    chunk: int = None,
+    row_budget: int = None,
+    prefetch=None,
+):
+    """Serial-equivalent scan over `pods`, dispatched in pow2 chunks whose
+    count planes are sliced to each chunk's term-row union.
+
+    `groups` is the host-side group id per pod (drives the row unions).
+    `scan_call(statics, state, seg, flags)` defaults to the compiled
+    `_run_scan`; engines pass their sharded variants.  `prefetch` (a
+    pytree→pytree callable, typically a non-blocking jax.device_put) is
+    applied to chunk i+1's pod segment right after chunk i dispatches, so
+    the host→device transfer of the next segment rides the queue while the
+    current chunk executes (double-buffered streaming — at most one
+    prepared segment is in flight ahead of the dispatch point).  Returns
+    (final_state, host output tuple) — outputs are numpy, truncated to the
+    real pod count."""
+    call = scan_call or _run_scan
+    n = groups.shape[0]
+    if n == 0:  # preserve _run_scan's total contract (empty outputs)
+        state, outs = call(statics, state, pods, flags)
+        return state, tuple(np.asarray(o) for o in fetch_outputs(outs))
+    t = int(tensors.n_terms)
+    g_total = len(tensors.groups)
+    plan = list(plan_scan_chunks(groups, tensors, flags, chunk, row_budget))
+
+    def prep_seg(i):
+        """Host-gather + pad + (optionally) start the device transfer of
+        plan chunk i's pod segment.  Pure function of the plan — safe to
+        run one chunk ahead of the dispatch point."""
+        c0, c1, gs_p, _ = plan[i]
+        seg_arrays = [arr[c0:c1] for arr in pods]
+        if gs_p is not None:
+            inv_g = np.zeros(g_total, np.int32)
+            inv_g[gs_p] = np.arange(len(gs_p), dtype=np.int32)
+            seg_arrays[0] = inv_g[np.asarray(seg_arrays[0])]
+        seg = pad_pods_pow2(tuple(seg_arrays), _pow2_up(c1 - c0))
+        return prefetch(seg) if prefetch is not None else seg
 
     # active slice context: the (group set, term-row set) the current
     # eff_statics / sliced count planes were built for
@@ -1113,21 +1208,9 @@ def run_scan_chunked(
 
     outs_dev = []
     eff_statics = statics
-    inv_g = None
-    for c0 in range(0, n, chunk):
-        c1 = min(c0 + chunk, n)
-        gs = np.unique(groups[c0:c1])
-        gs_p = None
-        if group_sliceable and len(gs) <= _SCAN_GROUP_BUDGET:
-            # duplicate padding is fine here: the group axis is read-only
-            pad = _pow2_up(len(gs)) - len(gs)
-            gs_p = np.concatenate([gs, np.repeat(gs[-1:], pad)]).astype(np.int32)
-        rows_p = None
-        if row_sliceable:
-            rows = np.unique(g_terms_host[gs])
-            rows = rows[rows >= 0]
-            if len(rows) <= row_budget:
-                rows_p = pad_row_ids(np.sort(rows), t)
+    g_terms_host = _compact_terms(tensors)[0]
+    next_seg = prep_seg(0)
+    for i, (c0, c1, gs_p, rows_p) in enumerate(plan):
         key = (
             None if gs_p is None else gs_p.tobytes(),
             None if rows_p is None else rows_p.tobytes(),
@@ -1139,16 +1222,7 @@ def run_scan_chunked(
             eff_statics = statics
             if gs_p is not None:
                 gs_dev = jnp.asarray(gs_p)
-                fields = _GROUP_FIELDS
-                if rows_p is not None:
-                    # g_terms gets the host-remapped copy below — skip its
-                    # device gather
-                    fields = tuple(f for f in fields if f != "g_terms")
-                # constant planes are already [1, N]-collapsed (row-clamp
-                # reads); gathering them would just materialize copies
-                fields = tuple(
-                    f for f in fields if getattr(statics, f).shape[0] > 1
-                )
+                fields = _sliced_statics_fields(statics, rows_p)
                 sliced = _gather_rows_tuple(
                     tuple(getattr(statics, f) for f in fields), gs_dev
                 )
@@ -1159,16 +1233,12 @@ def run_scan_chunked(
                             remap_term_ids(g_terms_host[gs_p], rows_p, t)
                         )
                     )
-                inv_g = np.zeros(g_total, np.int32)
-                inv_g[gs_p] = np.arange(len(gs_p), dtype=np.int32)
-            else:
-                inv_g = None
-                if rows_p is not None:
-                    eff_statics = eff_statics._replace(
-                        g_terms=jnp.asarray(
-                            remap_term_ids(g_terms_host, rows_p, t)
-                        )
+            elif rows_p is not None:
+                eff_statics = eff_statics._replace(
+                    g_terms=jnp.asarray(
+                        remap_term_ids(g_terms_host, rows_p, t)
                     )
+                )
             if rows_p is not None:
                 ip_of = interpod_term_index(tensors)
                 eff_statics = eff_statics._replace(
@@ -1183,17 +1253,18 @@ def run_scan_chunked(
                 )
                 ctx_rows = rows_p
             ctx_key = key
-        seg_arrays = [arr[c0:c1] for arr in pods]
-        if inv_g is not None:
-            seg_arrays[0] = inv_g[np.asarray(seg_arrays[0])]
-        seg = pad_pods_pow2(tuple(seg_arrays), _pow2_up(c1 - c0))
+        seg = next_seg
         state, outs = call(eff_statics, state, seg, flags)
+        # double buffer: chunk i+1's segment starts its transfer while
+        # chunk i executes (the dispatch above is async)
+        if i + 1 < len(plan):
+            next_seg = prep_seg(i + 1)
         # keep outputs on device: a per-chunk device_get would sync the
         # tunnel once per chunk; all dispatches queue first and one
         # batched transfer materializes everything afterwards
         outs_dev.append((outs, c1 - c0))
     state = flush(state)
-    fetched = jax.device_get([o for o, _ in outs_dev])
+    fetched = fetch_outputs([o for o, _ in outs_dev])
     outs_host = [
         tuple(np.asarray(o)[:real] for o in chunk_outs)
         for chunk_outs, (_, real) in zip(fetched, outs_dev)
@@ -1290,6 +1361,10 @@ class Engine:
         self.tensorizer = tensorizer
         #: optional schedconfig.SchedulerConfig (score-weight overrides)
         self.sched_config = None
+        #: optional engine.precompile.AotPipeline — when set, dispatches
+        #: route through its registry of background-compiled executables
+        #: (engine/precompile.py); None = plain jit dispatch
+        self.pipeline = None
         self.placed_group: List[int] = []
         self.placed_node: List[int] = []
         self.placed_req: List[np.ndarray] = []
@@ -1320,10 +1395,40 @@ class Engine:
             int((interpod_term_index(tensors) >= 0).sum()),
         )
 
+    def _aot_scan(self, flags: StepFlags):
+        """(pipeline key name, jit callable, static argument tail) for the
+        serial-scan executable.  The AOT precompiler and `_scan_call` must
+        agree on this triple: the pipeline lowers `fn.lower(*dynamic,
+        *tail)` on a worker thread and the dispatch path calls the compiled
+        result with the dynamic args alone.  The sharded engines override
+        it with their mesh-compiled callables (tail already closed over)."""
+        return "scan", _run_scan, (flags,)
+
+    @staticmethod
+    def _prefetch_pods(tree):
+        """Start the (non-blocking) host→device transfer of a prepared pod
+        segment — the double-buffer lever of run_scan_chunked and the bulk
+        chunk loop.  The sharded engines override this with a no-op: their
+        jits shard replicated inputs on entry, and a copy committed to one
+        device would fight the mesh layout."""
+        return jax.device_put(tree)
+
+    def _precompile_shapes(self, statics_sds, state_sds):
+        """Map (statics, state) ShapeDtypeStruct trees to the shapes
+        `_dispatch` actually sees — identity here; the mesh engines pad the
+        node axis to the shard multiple (parallel/sharded.py)."""
+        return statics_sds, state_sds
+
     def _scan_call(self, statics, state, seg, flags):
-        """Dispatch one compiled scan segment (overridden by the sharded
-        engines to run on a mesh)."""
-        return _run_scan(statics, state, seg, flags)
+        """Dispatch one compiled scan segment — through the precompile
+        pipeline's registry when one is attached, else the plain jit."""
+        name, fn, tail = self._aot_scan(flags)
+        args = (statics, state, seg)
+        if self.pipeline is not None:
+            return self.pipeline.call(
+                name, tail, args, lambda: fn(*args, *tail)
+            )
+        return fn(*args, *tail)
 
     def _dispatch(
         self, statics: StaticArrays, state: SchedState, pods, flags: StepFlags
@@ -1340,6 +1445,7 @@ class Engine:
             self._current_tensors,
             np.asarray(self._current_batch.group),
             scan_call=self._scan_call,
+            prefetch=self._prefetch_pods,
         )
 
     def place(self, batch: PodBatch):

@@ -37,9 +37,16 @@ from ..engine.scan import (
 from .mesh import NODE_AXIS, node_shard_count
 
 
-def _pad_axis(x: jnp.ndarray, axis: int, pad: int, value) -> jnp.ndarray:
+def _pad_axis(x, axis: int, pad: int, value):
     if pad == 0:
         return x
+    if isinstance(x, jax.ShapeDtypeStruct):
+        # shape-only padding: the precompiler (engine/precompile.py) runs
+        # pad_statics/pad_state over ShapeDtypeStruct trees to enumerate
+        # the shard-padded executable signatures without touching a device
+        shape = list(x.shape)
+        shape[axis] += pad
+        return jax.ShapeDtypeStruct(tuple(shape), x.dtype)
     widths = [(0, 0)] * x.ndim
     widths[axis] = (0, pad)
     return jnp.pad(x, widths, constant_values=value)
@@ -230,6 +237,28 @@ class _MeshMixin:
             lambda: build_sharded_scan(self.mesh, flags),
         )
 
+    def _aot_scan(self, flags: StepFlags):
+        # flags are baked into the mesh-compiled callable; the pipeline key
+        # carries them through the name (the mesh itself is engine-fixed)
+        return ("sharded_scan", flags), self._sharded_scan_for(flags), ()
+
+    @staticmethod
+    def _prefetch_pods(tree):
+        # no-op: the sharded jits shard replicated pod inputs on entry; a
+        # prefetch committed to one device would fight the mesh layout
+        return tree
+
+    def _precompile_shapes(self, statics_sds, state_sds):
+        """Shard-padded executable signatures for the precompiler: the
+        node axis grows to the shard multiple exactly as `_shard_inputs`
+        pads the concrete arrays."""
+        statics_sds, _ = pad_statics(statics_sds, self._shards)
+        state_sds = pad_state(
+            state_sds,
+            statics_sds.alloc.shape[0] - state_sds.free.shape[0],
+        )
+        return statics_sds, state_sds
+
 
 class ShardedEngine(_MeshMixin, Engine):
     """Engine whose scan runs with the node axis sharded over a mesh.
@@ -248,9 +277,6 @@ class ShardedEngine(_MeshMixin, Engine):
         # routes every chunk through the mesh-compiled scan
         statics, state = self._shard_inputs(statics, state)
         return super()._dispatch(statics, state, pods, flags)
-
-    def _scan_call(self, statics, state, seg, flags):
-        return self._sharded_scan_for(flags)(statics, state, seg)
 
 
 def build_sharded_rounds(
@@ -334,12 +360,9 @@ class ShardedRoundsEngine(_MeshMixin, RoundsEngine):
         # replicated inputs on entry
         return super()._dispatch(statics, state, pods, flags)
 
-    def _scan_call(self, statics, state, seg, flags):
-        return self._sharded_scan_for(flags)(statics, state, seg)
-
-    def _bulk_call(
-        self, statics, state, seg_pods, ks, n_domains, k_cap, flags,
-        quota=False, self_aff=False, ext_mats=False,
+    def _aot_bulk(
+        self, n_domains, k_cap, flags, quota=False, self_aff=False,
+        ext_mats=False,
     ):
         fn = _cached_jit(
             ("rounds", self.mesh, n_domains, k_cap, flags, quota, self_aff,
@@ -348,12 +371,15 @@ class ShardedRoundsEngine(_MeshMixin, RoundsEngine):
                 self.mesh, n_domains, k_cap, flags, quota, self_aff, ext_mats
             ),
         )
-        return fn(statics, state, seg_pods, ks)
+        name = (
+            "sharded_rounds", n_domains, k_cap, flags, quota, self_aff,
+            ext_mats,
+        )
+        return name, fn, ()
 
-    def _bulk_call_sliced(
-        self, statics, state, rows, g_terms_c, term_topo_c, ip_of_c,
-        seg_pods, ks, n_domains, k_cap, flags,
-        quota=False, self_aff=False, ext_mats=False,
+    def _aot_bulk_sliced(
+        self, n_domains, k_cap, flags, quota=False, self_aff=False,
+        ext_mats=False,
     ):
         fn = _cached_jit(
             ("rounds_sliced", self.mesh, n_domains, k_cap, flags, quota,
@@ -362,7 +388,11 @@ class ShardedRoundsEngine(_MeshMixin, RoundsEngine):
                 self.mesh, n_domains, k_cap, flags, quota, self_aff, ext_mats
             ),
         )
-        return fn(statics, state, rows, g_terms_c, term_topo_c, ip_of_c, seg_pods, ks)
+        name = (
+            "sharded_rounds_sliced", n_domains, k_cap, flags, quota,
+            self_aff, ext_mats,
+        )
+        return name, fn, ()
 
 
 class MaskedShardedRoundsEngine(ShardedRoundsEngine):

@@ -203,6 +203,7 @@ def plan_capacity(
     bulk: bool = False,
     sched_config=None,
     corrected_ds_overhead: bool = False,
+    precompile: bool = False,
 ) -> PlanResult:
     """Find the minimum clone count of `new_node` that deploys everything."""
     say = progress or (lambda s: None)
@@ -221,6 +222,7 @@ def plan_capacity(
             extended_resources=extended_resources,
             bulk=bulk,
             sched_config=sched_config,
+            precompile=precompile,
         )
         probes[i] = len(result.unscheduled_pods)
         return result
@@ -341,6 +343,16 @@ class ApplierOptions:
     # bit-identical to the single-device path; CPU backends stay unsharded
     # unless forced — virtual CPU "devices" share one host's FLOPs)
     shard: Optional[bool] = None
+    # None = auto: AOT-precompile each run's jit executables on a
+    # background thread pool as soon as the shapes are known, so the cold
+    # `simtpu apply` path overlaps compilation with host work instead of
+    # serializing compiles at first dispatch (engine/precompile.py).  Auto
+    # is ON for accelerator backends only — on CPU the "device" computes on
+    # the same host cores the compiles need, so backgrounding them is pure
+    # contention (measured slower), the same reasoning as the persistent
+    # cache's CPU gating.  Placements are bit-identical either way;
+    # --precompile forces it anywhere, --no-precompile disables.
+    precompile: Optional[bool] = None
     # account daemonset overhead on the template node in the can-ever-fit
     # diagnostic (off = faithful to the reference's NewNodeNamePrefix quirk)
     corrected_ds_overhead: bool = False
@@ -507,14 +519,23 @@ class Applier:
         new_node = self.load_new_node()
         timings["ingest"] = timings.get("ingest", 0.0) + _time.perf_counter() - t0
 
+        import jax
+
         # SIMTPU_TRACE=<dir> captures a jax.profiler trace of the plan phase
         trace_dir = os.environ.get("SIMTPU_TRACE", "")
         ctx = contextlib.nullcontext()
         if trace_dir:
-            import jax
-
             ctx = jax.profiler.trace(trace_dir)
         search, bulk, mesh = _resolve_engines(self.opts, cluster, apps)
+        # auto-ON for apply on accelerator backends: the one-shot CLI user
+        # always pays the cold path, which is exactly what the background
+        # AOT pipeline attacks.  CPU backends stay off under auto (the
+        # compiles would contend with the placement compute for the same
+        # host cores; ApplierOptions.precompile documents the measurement)
+        # — an explicit --precompile forces it anywhere.
+        precompile = self.opts.precompile is True or (
+            self.opts.precompile is None and jax.default_backend() != "cpu"
+        )
         t0 = _time.perf_counter()
         with ctx:
             if search == "incremental":
@@ -529,6 +550,7 @@ class Applier:
                     sched_config=self._sched_config(),
                     corrected_ds_overhead=self.opts.corrected_ds_overhead,
                     mesh=mesh,
+                    precompile=precompile,
                 )
             else:
                 plan = plan_capacity(
@@ -541,6 +563,7 @@ class Applier:
                     bulk=bulk,
                     sched_config=self._sched_config(),
                     corrected_ds_overhead=self.opts.corrected_ds_overhead,
+                    precompile=precompile,
                 )
         timings["plan"] = _time.perf_counter() - t0
         plan.timings = timings
@@ -553,6 +576,7 @@ class Applier:
             "search": search,
             "bulk": bool(bulk) if search != "incremental" else True,
             "shards": int(mesh.shape[NODE_AXIS]) if mesh is not None else 0,
+            "precompile": precompile,
             "auto_search": self.opts.search is None,
             "auto_bulk": self.opts.bulk is None,
             "reference_exact": search == "linear" and not bulk,

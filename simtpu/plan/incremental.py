@@ -195,6 +195,8 @@ def plan_capacity_incremental(
     verify: bool = True,
     materialize: bool = True,
     mesh=None,
+    precompile: bool = False,
+    pipeline=None,
 ) -> PlanResult:
     """Minimum clone count of `new_node` deploying everything, via the
     incremental probe strategy described in the module docstring.
@@ -212,7 +214,52 @@ def plan_capacity_incremental(
     mesh's "nodes" axis (`MaskedShardedRoundsEngine`); the candidate
     node_valid mask composes with the sharding's dead-node pad mask, so
     placements are bit-identical to the single-device path.
+
+    With `precompile`, one shared AOT pipeline (engine/precompile.py)
+    background-compiles every executable the base run will need as soon as
+    tensorization fixes the shape buckets — and each probe/verify engine
+    re-enumerates against its own batch, deduplicating through the shared
+    registry (probe chunks snap into base buckets, so they mostly find the
+    base executables).  Placements are bit-identical either way; the
+    per-phase `compiles` counts then attribute background traces to
+    whatever phase is active when they run (timings gain
+    compile_wall/compile_serial).  An internally-created pipeline is shut
+    down on EVERY exit (cancelling enumerated-but-undispatched compiles —
+    a raised plan must not leave the process lingering at exit finishing
+    unused work); pass `pipeline=` (an AotPipeline, implies precompile) to
+    share one registry across several plans — the caller then owns its
+    lifecycle.
     """
+    own_pipeline = None
+    if pipeline is None and precompile:
+        from ..engine.precompile import AotPipeline
+
+        pipeline = own_pipeline = AotPipeline()
+    try:
+        return _plan_capacity_incremental(
+            cluster, apps, new_node, max_new_nodes, extended_resources,
+            progress, sched_config, corrected_ds_overhead, verify,
+            materialize, mesh, pipeline,
+        )
+    finally:
+        if own_pipeline is not None:
+            own_pipeline.shutdown()
+
+
+def _plan_capacity_incremental(
+    cluster: ResourceTypes,
+    apps: Sequence[AppResource],
+    new_node: dict,
+    max_new_nodes: int,
+    extended_resources: Sequence[str],
+    progress,
+    sched_config,
+    corrected_ds_overhead: bool,
+    verify: bool,
+    materialize: bool,
+    mesh,
+    pipeline,
+) -> PlanResult:
     from ..engine.scan import statics_from, trace_counts
     from ..parallel.sweep import assemble_planning_problem
 
@@ -231,6 +278,10 @@ def plan_capacity_incremental(
         }
 
     def finalize(out: PlanResult) -> PlanResult:
+        if pipeline is not None:
+            s = pipeline.stats()
+            timings["compile_wall"] = s["compile_wall_s"]
+            timings["compile_serial"] = s["compile_serial_s"]
         out.timings = timings
         out.compiles = compiles
         return out
@@ -253,8 +304,13 @@ def plan_capacity_incremental(
     # already compiled, so the whole candidate sweep stays on warm
     # executables (engine/rounds.py `_bulk_chunk`)
     shape_registry: Dict = {}
+    # ... and one AOT pipeline (when the wrapper created or was handed
+    # one): every engine enumerates its batch's executables into the same
+    # background-compile registry, so the base run's compiles start before
+    # its first dispatch and the probe/verify engines find them finished
+    # (engine/precompile.py)
 
-    def make_engine(node_valid: np.ndarray):
+    def make_engine(node_valid: np.ndarray, plan_batch=None):
         if mesh is not None:
             from ..parallel.sharded import MaskedShardedRoundsEngine
 
@@ -264,6 +320,10 @@ def plan_capacity_incremental(
         eng.sched_config = sched_config
         eng.bulk_shapes = shape_registry
         eng.snap_shapes = True
+        if pipeline is not None and plan_batch is not None:
+            from ..engine.precompile import precompile_place
+
+            precompile_place(eng, plan_batch, pipeline)
         return eng
 
     def valid_mask(i: int) -> np.ndarray:
@@ -275,7 +335,7 @@ def plan_capacity_incremental(
         """Full placement of every pod against base + i clones (the
         reference's per-candidate semantics, minus re-tensorization)."""
         c0 = trace_counts()
-        eng = make_engine(valid_mask(i))
+        eng = make_engine(valid_mask(i), plan_batch=batch)
         nodes, reasons, extras = eng.place(batch)
         phantom = clone_of >= i
         failed = (nodes < 0) & ~phantom
@@ -366,11 +426,12 @@ def plan_capacity_incremental(
         say(f"add {i} node(s)")
         c0 = trace_counts()
         idx = np.flatnonzero(base_failed | ((clone_of >= 0) & (clone_of < i)))
-        eng = make_engine(valid_mask(i))
+        probe_batch = _slice_batch(batch, idx)
+        eng = make_engine(valid_mask(i), plan_batch=probe_batch)
         eng.last_state = _copy_state(snapshot)
         eng._last_vocab = vocab
         eng._state_dirty = False
-        nodes, reasons, extras = eng.place(_slice_batch(batch, idx))
+        nodes, reasons, extras = eng.place(probe_batch)
         failed = nodes < 0
         probes[i] = int(failed.sum())
         mark_compiles("probes", c0)

@@ -1,0 +1,52 @@
+"""Persistent-compilation-cache gating tests (`simtpu/cache.py`): the cache
+must stay OFF on the CPU backend (the documented XLA:CPU deserialize
+segfault), honor the env kill-switch, and say so on stderr either way —
+cold-path triage must never have to guess whether the cache was silently
+disabled.
+"""
+
+from __future__ import annotations
+
+import simtpu.cache as cache_mod
+
+
+def test_cpu_backend_leaves_cache_off(capsys, monkeypatch):
+    # the test process runs on the CPU backend (conftest pins it), so the
+    # accelerator-only gate must refuse without touching jax.config
+    monkeypatch.delenv("SIMTPU_COMPILATION_CACHE", raising=False)
+    called = []
+
+    import jax
+
+    monkeypatch.setattr(jax.config, "update", lambda *a: called.append(a))
+    assert cache_mod.enable_compilation_cache() is None
+    assert called == []  # never partially configured
+    err = capsys.readouterr().err
+    assert "persistent compilation cache off" in err
+    assert "CPU backend" in err
+
+
+def test_env_kill_switch_wins(capsys, monkeypatch):
+    monkeypatch.setenv("SIMTPU_COMPILATION_CACHE", "off")
+    assert cache_mod.enable_compilation_cache() is None
+    err = capsys.readouterr().err
+    assert "persistent compilation cache off" in err
+    assert "SIMTPU_COMPILATION_CACHE=off" in err
+
+
+def test_accelerator_backend_enables(tmp_path, capsys, monkeypatch):
+    """With a non-CPU backend the cache configures and returns its dir (the
+    jax.config writes are captured, not applied — this process IS on CPU)."""
+    import jax
+
+    monkeypatch.delenv("SIMTPU_COMPILATION_CACHE", raising=False)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    updates = {}
+    monkeypatch.setattr(
+        jax.config, "update", lambda k, v: updates.__setitem__(k, v)
+    )
+    out = cache_mod.enable_compilation_cache(str(tmp_path / "xla"))
+    assert out == str(tmp_path / "xla")
+    assert updates["jax_compilation_cache_dir"] == out
+    assert updates["jax_persistent_cache_min_compile_time_secs"] == 0.5
+    assert "persistent compilation cache off" not in capsys.readouterr().err

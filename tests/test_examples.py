@@ -97,6 +97,7 @@ def test_apply_engine_flags_plumb_through(capsys, monkeypatch):
         seen["search"], seen["bulk"], seen["shard"] = (
             opts.search, opts.bulk, opts.shard,
         )
+        seen["precompile"] = opts.precompile
         if not full:
             # ValueError is cmd_apply's clean-exit path (rc=1)
             raise ValueError("flag-plumb probe stop")
@@ -107,6 +108,7 @@ def test_apply_engine_flags_plumb_through(capsys, monkeypatch):
     rc = main(["apply", "-f", "examples/simtpu-config.yaml", "--json"])
     assert rc == 0
     assert (seen["search"], seen["bulk"], seen["shard"]) == (None, None, None)
+    assert seen["precompile"] is None  # tri-state: absent = auto (ON)
     captured = capsys.readouterr()
     assert "auto-selected" not in captured.err
     # stdout must be EXACTLY the JSON document (progress goes to stderr),
@@ -118,6 +120,10 @@ def test_apply_engine_flags_plumb_through(capsys, monkeypatch):
     assert doc["engine"]["search"] in ("binary", "linear", "incremental")
     assert {"auto_search", "auto_bulk", "shards"} <= set(doc["engine"])
     assert doc["engine"]["auto_search"] is True
+    # the precompile resolution is recorded in the machine-readable engine
+    # block; auto is OFF here because the test env pins the CPU backend
+    # (accelerator backends auto-enable it)
+    assert doc["engine"]["precompile"] is False
 
     full = False
     rc = main(["apply", "-f", "examples/simtpu-config.yaml", "--no-bulk", "--search", "linear"])
@@ -127,6 +133,14 @@ def test_apply_engine_flags_plumb_through(capsys, monkeypatch):
     rc = main(["apply", "-f", "examples/simtpu-config.yaml", "--bulk"])
     assert rc == 1
     assert seen["bulk"] is True
+
+    rc = main(["apply", "-f", "examples/simtpu-config.yaml", "--no-precompile"])
+    assert rc == 1
+    assert seen["precompile"] is False
+
+    rc = main(["apply", "-f", "examples/simtpu-config.yaml", "--precompile"])
+    assert rc == 1
+    assert seen["precompile"] is True
 
     rc = main(["apply", "-f", "examples/simtpu-config.yaml", "--shard"])
     assert rc == 1

@@ -15,7 +15,13 @@ from simtpu.api import simulate
 _PERF_ASSERT = os.environ.get("SIMTPU_PERF_ASSERT", "").lower() in ("1", "true", "yes", "on")
 from simtpu.core.objects import ResourceTypes
 
-from .fixtures import make_fake_node, make_fake_pod
+from .fixtures import (
+    make_fake_node,
+    make_fake_pod,
+    with_node_labels,
+    with_pod_affinity,
+    with_pod_labels,
+)
 
 
 def _prio(pod, p):
@@ -151,6 +157,59 @@ def test_wave_commit_never_rides_restored_victims():
             used[name] = used.get(name, 0.0) + float(cpu)
     for name, total in used.items():
         assert total <= cap[name] + 1e-9, (name, total)
+
+
+def test_affinity_dependent_head_not_finalized():
+    """ADVICE r5 #3 regression: a retried head whose verify success depends
+    on another wave pod BEING placed (required positive affinity to it)
+    must not be finalized by retry finality — the head verifies FIRST in
+    its wave, so its fresh attempt never sees the anchor pod placed.
+
+    Construction: both nodes are full of prio-0 fillers.  X (needs
+    colocation with app=anchor on a hostname domain) and D (carries
+    app=anchor) both fail on resources and wave together, X first.  X's
+    verify keeps failing on inter-pod affinity until D lands; the old
+    finality rule recorded X unscheduled on its second fresh failure.  With
+    the exemption, X re-queues BEHIND D, D places, and X colocates."""
+    n0 = make_fake_node(
+        "n0", "10", "16Gi", with_node_labels({"kubernetes.io/hostname": "n0"})
+    )
+    n1 = make_fake_node(
+        "n1", "10", "16Gi", with_node_labels({"kubernetes.io/hostname": "n1"})
+    )
+    f0 = _prio(make_fake_pod("f0", "default", "10", "1Gi"), 0)
+    f0["spec"]["nodeName"] = "n0"
+    f1 = _prio(make_fake_pod("f1", "default", "10", "1Gi"), 0)
+    f1["spec"]["nodeName"] = "n1"
+    x = _prio(
+        make_fake_pod(
+            "x", "default", "5", "1Gi",
+            with_pod_affinity({
+                "podAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "labelSelector": {"matchLabels": {"app": "anchor"}},
+                            "topologyKey": "kubernetes.io/hostname",
+                        }
+                    ]
+                }
+            }),
+        ),
+        100,
+    )
+    d = _prio(
+        make_fake_pod(
+            "d", "default", "5", "1Gi", with_pod_labels({"app": "anchor"})
+        ),
+        100,
+    )
+    result = simulate(ResourceTypes(nodes=[n0, n1], pods=[f0, f1, x, d]))
+    placed = _placements(result)
+    assert not result.unscheduled_pods, [
+        u.reason for u in result.unscheduled_pods
+    ]
+    # the affinity actually binds: x shares d's node
+    assert placed.get("x") == placed.get("d")
 
 
 def test_preempts_port_holder():

@@ -52,6 +52,7 @@ def main() -> int:
 
     totals = {"passed": 0, "failed": 0, "errors": 0, "skipped": 0, "deselected": 0}
     failures = []
+    timings = []  # (seconds, module) for the slowest-modules summary
     t_all = time.perf_counter()
     for mod in modules:
         rel = os.path.relpath(mod, REPO)
@@ -64,6 +65,7 @@ def main() -> int:
             text=True,
         )
         dt = time.perf_counter() - t0
+        timings.append((dt, rel))
         tail = proc.stdout.strip().splitlines()
         summary = tail[-1] if tail else ""
         for key in totals:
@@ -84,6 +86,13 @@ def main() -> int:
         f"{totals['deselected']} deselected in {wall:.1f}s "
         f"across {len(modules)} modules =="
     )
+    # where the suite's wall-clock goes — the target list for anyone
+    # shaving CI time (or spotting a module whose runtime regressed)
+    slowest = sorted(timings, reverse=True)[:10]
+    if slowest:
+        print("slowest modules:")
+        for dt, rel in slowest:
+            print(f"  {dt:7.1f}s  {rel}  ({dt / max(wall, 1e-9) * 100:.0f}%)")
     if failures:
         print("failing modules: " + ", ".join(failures))
         return 1
