@@ -40,6 +40,13 @@ compile seconds — wall < serial shows the parallel-compile overlap) /
 round-trips; `matrix_point_fetches` tracks the coalesced stretch-group
 fetch floor).
 
+The exact-scan slice is timed twice: `scan_pods_per_s` (the pod-at-a-time
+floor) and `scan_wavefront_pods_per_s` (the speculative wavefront
+dispatcher, engine/scan.py — bit-identical placements), with
+`scan_wavefront_speedup`, the speculation acceptance rate
+(`wavefront_accept_rate`) and rollback volume
+(`wavefront_rollbacks`/`wavefront_rollback_pods`) alongside.
+
 Env knobs: SIMTPU_BENCH_NODES (default 100000), SIMTPU_BENCH_PODS (default
 1000000), SIMTPU_BENCH_SCAN_PODS (scan-rate slice, default 2000),
 SIMTPU_BENCH_BASELINE_PODS (default 300), SIMTPU_BENCH_SMALL=0 /
@@ -160,11 +167,16 @@ def build_problem(n_nodes: int, n_pods: int, mix: str = "north", with_state: boo
     return tensors, batch, statics, state, pod_arrays, req, gen_s, tensorize_s
 
 
-def time_engine(statics, state, pod_arrays, flags=None, tensors=None, groups=None):
+def time_engine(
+    statics, state, pod_arrays, flags=None, tensors=None, groups=None,
+    speculate=False,
+):
     """(seconds, placed_nodes) for one full placement scan (compiled,
     post-warmup) through the engine's chunked + term-row-sliced dispatch
     (run_scan_chunked) — the path `Engine.place` actually uses for
-    serial-only shapes.
+    serial-only shapes.  `speculate` routes eligible same-group runs
+    through the speculative wavefront dispatcher (bit-identical
+    placements; the A/B behind `scan_wavefront_pods_per_s`).
 
     Timing runs to full host materialization of the placement vector:
     `block_until_ready` alone under-reports on tunneled TPU backends (it can
@@ -175,13 +187,14 @@ def time_engine(statics, state, pod_arrays, flags=None, tensors=None, groups=Non
     import jax
     import jax.numpy as jnp
 
-    from simtpu.engine.scan import StepFlags, run_scan_chunked
+    from simtpu.engine.scan import StepFlags, default_wave_call, run_scan_chunked
 
     step_flags = flags if flags is not None else StepFlags()
 
     def run(st):
         _, outs = run_scan_chunked(
-            statics, st, pod_arrays, step_flags, tensors, groups
+            statics, st, pod_arrays, step_flags, tensors, groups,
+            wave_call=default_wave_call if speculate else None,
         )
         return outs[0]
 
@@ -470,21 +483,41 @@ def main() -> int:
         tensorize_s,
     ) = build_problem(n_nodes, n_pods)
 
-    from simtpu.engine.scan import flags_from
+    from simtpu.engine.scan import flags_from, wave_counts
 
     precompile = _bench_precompile()
-    note("problem built; timing scan slice")
+    note("problem built; timing scan slice (pod-at-a-time floor)")
     scan_slice = tuple(arr[:scan_pods] for arr in pod_arrays)
-    engine_s, _ = time_engine(
-        statics,
-        state,
-        scan_slice,
-        flags_from(tensors, batch.ext),
-        tensors=tensors,
-        groups=np.asarray(batch.group)[:scan_pods],
+    scan_flags = flags_from(tensors, batch.ext)
+    scan_groups = np.asarray(batch.group)[:scan_pods]
+    engine_s, scan_nodes = time_engine(
+        statics, state, scan_slice, scan_flags,
+        tensors=tensors, groups=scan_groups,
     )
     scan_rate = scan_pods / engine_s
-    note(f"scan={scan_rate:.0f} pods/s; timing bulk")
+    note(f"scan={scan_rate:.0f} pods/s; timing speculative wavefront scan")
+    # the same slice through the speculative wavefront dispatcher
+    # (engine/scan.py wavefronts): the exact engine's batched
+    # verify-and-rollback path — placements are pinned bit-identical, the
+    # acceptance/rollback counters ride the same run
+    w0 = wave_counts()
+    wave_s, wave_nodes = time_engine(
+        statics, state, scan_slice, scan_flags,
+        tensors=tensors, groups=scan_groups, speculate=True,
+    )
+    w1 = wave_counts()
+    wave_rate = scan_pods / wave_s
+    wave_stats = {k: w1[k] - w0[k] for k in w1}
+    # two timed runs (warm+timed each): normalize counters to one pass
+    wave_stats = {k: v // 2 for k, v in wave_stats.items()}
+    if not np.array_equal(np.asarray(scan_nodes), np.asarray(wave_nodes)):
+        note("WARNING: wavefront scan diverged from the pod-at-a-time scan")
+    note(
+        f"wavefront scan={wave_rate:.0f} pods/s "
+        f"({wave_rate / max(scan_rate, 1e-9):.1f}x the serial floor); "
+        f"accept={wave_stats['accepted']}/{wave_stats['pods']} "
+        f"rollbacks={wave_stats['rollbacks']}; timing bulk"
+    )
 
     bulk_s, cold_run_s, placed_nodes, reasons, cold_extra = time_bulk(
         tensors, batch, precompile=precompile
@@ -504,7 +537,8 @@ def main() -> int:
     note(
         f"nodes={n_nodes} pods={n_pods} placed={placed} "
         f"gen={gen_s:.1f}s tensorize={tensorize_s:.1f}s "
-        f"scan={scan_rate:.0f} pods/s bulk={pods_per_sec:.0f} pods/s "
+        f"scan={scan_rate:.0f} pods/s wavefront={wave_rate:.0f} pods/s "
+        f"bulk={pods_per_sec:.0f} pods/s "
         f"bulk-wall={bulk_s:.1f}s cold-run={cold_run_s:.1f}s "
         f"serial-baseline={base_pods_per_sec:.0f} pods/s "
         f"backend={jax.default_backend()}"
@@ -538,6 +572,18 @@ def main() -> int:
         "precompile": precompile,
         "fetches": cold_extra.get("fetches"),
         "compilation_cache": bool(cache_dir),
+        # exact-scan throughput: the pod-at-a-time floor vs the speculative
+        # wavefront dispatcher on the same slice (bit-identical placements;
+        # ISSUE 3 — acceptance rate and rollback volume ride along)
+        "scan_pods_per_s": round(scan_rate, 1),
+        "scan_wavefront_pods_per_s": round(wave_rate, 1),
+        "scan_wavefront_speedup": round(wave_rate / max(scan_rate, 1e-9), 2),
+        "wavefront_pods": wave_stats["pods"],
+        "wavefront_accept_rate": round(
+            wave_stats["accepted"] / max(wave_stats["pods"], 1), 4
+        ),
+        "wavefront_rollbacks": wave_stats["rollbacks"],
+        "wavefront_rollback_pods": wave_stats["rollback_pods"],
         "placed": placed,
         "unplaced": unplaced,
         "unplaced_reasons": hist,

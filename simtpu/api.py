@@ -32,6 +32,10 @@ from .core.objects import (
 )
 from .core.quantity import parse_quantity
 from .core.tensorize import Tensorizer, _group_of_pod
+from .workloads.expand import (
+    get_valid_pods_exclude_daemonset,
+    make_valid_pods_by_daemonset,
+)
 from .engine.scan import (
     FAIL_ATTACH,
     FAIL_GPU,
@@ -41,7 +45,6 @@ from .engine.scan import (
     FAIL_SPREAD,
     FAIL_STORAGE,
     FAIL_VOLUME,
-    OK,
     REASON_TEXT,
     Engine,
 )
@@ -59,11 +62,6 @@ _PREEMPTIBLE_REASONS = {
     FAIL_VOLUME,
     FAIL_ATTACH,
 }
-from .workloads.expand import (
-    get_valid_pods_exclude_daemonset,
-    make_valid_pods_by_daemonset,
-)
-
 log = logging.getLogger("simtpu.api")
 
 #: reason suffix for pods finalized by the preemption wave cap — a tripped

@@ -19,7 +19,6 @@ a chart outside the subset fails loudly rather than mis-rendering.
 
 from __future__ import annotations
 
-import io
 import os
 import re
 import tarfile
@@ -423,8 +422,8 @@ _FUNCS = {
     "empty": lambda a: not _truthy(a),
     "required": _required,
     "fail": lambda msg: (_ for _ in ()).throw(ValueError(str(msg))),
-    "indent": lambda n, s: "\n".join(" " * int(n) + l for l in str(s).splitlines()),
-    "nindent": lambda n, s: "\n" + "\n".join(" " * int(n) + l for l in str(s).splitlines()),
+    "indent": lambda n, s: "\n".join(" " * int(n) + ln for ln in str(s).splitlines()),
+    "nindent": lambda n, s: "\n" + "\n".join(" " * int(n) + ln for ln in str(s).splitlines()),
     "printf": lambda fmt, *a: _go_printf(fmt, *a),
     "print": lambda *a: "".join(_format(x) for x in a),
     "println": lambda *a: "".join(_format(x) for x in a) + "\n",

@@ -24,7 +24,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import constants as C
-from .objects import annotations_of, labels_of, name_of
+from .objects import annotations_of, name_of
 from .quantity import parse_quantity
 from .vocab import Interner
 

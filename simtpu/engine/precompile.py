@@ -69,8 +69,8 @@ def tree_sig(tree) -> tuple:
 
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     return treedef, tuple(
-        (tuple(np.shape(l)), jax.dtypes.canonicalize_dtype(l.dtype).name)
-        for l in leaves
+        (tuple(np.shape(leaf)), jax.dtypes.canonicalize_dtype(leaf.dtype).name)
+        for leaf in leaves
     )
 
 
@@ -260,18 +260,35 @@ def _pods_sds(pods, rows: int):
 
 def _plan_scan_jobs(
     pipe: AotPipeline, engine, tensors, st_sds, state_tree, pods,
-    groups: np.ndarray, flags,
+    groups: np.ndarray, flags, pods_rows=None,
 ) -> None:
-    """Enumerate + submit the scan executables `run_scan_chunked` will
-    dispatch for `groups` — the same chunk plan, turned into signatures."""
-    from .scan import _pow2_up, _sliced_statics_fields, plan_scan_chunks
+    """Enumerate + submit the scan AND wavefront executables
+    `run_scan_chunked` will dispatch for `groups` — the same chunk plan
+    (incl. its wavefront sub-plan), turned into signatures.  `pods_rows`
+    is the host pod-tuple slice aligned with `groups` (defaults to `pods`
+    whole) — the wavefront eligibility mask reads its pins/demands."""
+    from .scan import (
+        _pow2_up,
+        _sliced_statics_fields,
+        flatten_wave_segments,
+        plan_scan_chunks,
+        wave_pod_mask,
+        wave_static_spec,
+    )
 
     if groups.shape[0] == 0:
         return
     n = state_tree.cnt_match.shape[1]
     t_cap = st_sds.g_terms.shape[1]
     name, fn, tail = engine._aot_scan(flags)
-    for c0, c1, gs_p, rows_p in plan_scan_chunks(groups, tensors, flags):
+    wave_ok = None
+    if getattr(engine, "speculate", False):
+        wave_ok = wave_pod_mask(
+            pods if pods_rows is None else pods_rows, groups, tensors
+        )
+    for c0, c1, gs_p, rows_p, waves in plan_scan_chunks(
+        groups, tensors, flags, wave_ok=wave_ok
+    ):
         eff = st_sds
         if gs_p is not None:
             fields = _sliced_statics_fields(st_sds, rows_p)
@@ -297,8 +314,15 @@ def _plan_scan_jobs(
                 cnt_match=_sds((r, n), np.float32),
                 cnt_total=_sds((r,), np.float32),
             )
-        seg = _pods_sds(pods, _pow2_up(c1 - c0))
-        pipe.submit(name, tail, fn, (eff, state_c, seg))
+        for kind, a, b, w_mode in flatten_wave_segments(c0, c1, waves):
+            seg = _pods_sds(pods, _pow2_up(b - a))
+            if kind == "wave":
+                w_name, w_fn, w_tail = engine._aot_wave(
+                    flags, wave_static_spec(tensors, w_mode[0], w_mode[1])
+                )
+                pipe.submit(w_name, w_tail, w_fn, (eff, state_c, seg))
+            else:
+                pipe.submit(name, tail, fn, (eff, state_c, seg))
 
 
 def _plan_bulk_jobs(
@@ -321,6 +345,7 @@ def _plan_bulk_jobs(
             _plan_scan_jobs(
                 pipe, engine, tensors, st_sds, state_tree, pods,
                 groups[a:b], flags,
+                pods_rows=tuple(np.asarray(p)[a:b] for p in pods),
             )
             idx += 1
             continue

@@ -1105,6 +1105,7 @@ class RoundsEngine(Engine):
             np.asarray(self._current_batch.group)[a:b],
             scan_call=self._scan_call,
             prefetch=self._prefetch_pods,
+            wave_call=self._wave_call if self.speculate else None,
         )
 
     #: carried-row budget per bulk chunk (padded to the next power of two):

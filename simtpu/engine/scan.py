@@ -41,13 +41,23 @@ from ..kernels.scores import (
     least_allocated,
     maxabs_normalize,
     minmax_normalize,
+    selector_spread_compose,
     selector_spread_score,
     simon_share,
+    spread_score_from_raw,
     taint_toleration_score,
     topology_spread_score,
 )
 from ..kernels.storage import device_plan, lvm_plan, open_local_score
-from .state import SchedState, build_state, interpod_term_index
+from .state import (
+    SchedState,
+    add_rows,
+    apply_placement_deltas,
+    build_state,
+    interpod_term_index,
+    take_rows,
+    take_rows_i32,
+)
 
 # Failure-reason codes (host maps to messages mirroring the scheduler's
 # "0/N nodes are available: ..." status strings, scheduler.go:500)
@@ -73,7 +83,7 @@ FAIL_VOLUME_BIND = 11  # PVC missing / not bindable / PV zone mismatch
 # bumps these too — the counts then attribute a trace to whatever phase is
 # active when the background lowering happens to run; the lock keeps
 # concurrent worker-thread traces from losing increments.)
-TRACE_COUNTS = {"scan": 0, "rounds": 0}
+TRACE_COUNTS = {"scan": 0, "rounds": 0, "wave": 0}
 _TRACE_LOCK = threading.Lock()
 
 
@@ -104,6 +114,38 @@ def fetch_outputs(tree):
 def fetch_counts() -> dict:
     """Snapshot of the blocking-fetch counter."""
     return dict(FETCH_COUNTS)
+
+
+# Speculative-wavefront telemetry (docs/speculation.md): bumped host-side
+# from the accept flags each wavefront dispatch returns (they ride the
+# chunk loop's one batched device→host fetch — no extra round-trips).
+# "accepted" counts the longest correct prefix of each wavefront (the pods
+# whose speculative state_0 placement matched the serial answer);
+# "rollback_pods" counts the pods beyond the first divergence, whose
+# speculative placements were discarded and whose results come from the
+# verifier's pod-at-a-time serial replay; a "rollback" is a wavefront with
+# at least one divergence.
+WAVE_COUNTS = {
+    "wavefronts": 0,
+    "pods": 0,
+    "accepted": 0,
+    "rollbacks": 0,
+    "rollback_pods": 0,
+}
+
+
+def wave_counts() -> dict:
+    """Snapshot of the speculation counters."""
+    return dict(WAVE_COUNTS)
+
+
+def wave_enabled() -> bool:
+    """Default for Engine.speculate: SIMTPU_WAVEFRONT=0 disables the
+    speculative wavefront dispatcher (1/unset = on; placements are
+    bit-identical either way — the switch exists for A/B measurement)."""
+    import os
+
+    return os.environ.get("SIMTPU_WAVEFRONT", "1") != "0"
 
 
 REASON_TEXT = {
@@ -414,65 +456,6 @@ def flags_from(tensors: ClusterTensors, batch_ext: dict) -> StepFlags:
         taint_pref=bool(tensors.taint_intolerable.any()),
         static_score=bool(tensors.static_score.any() or tensors.avoid_pen.any()),
     )
-
-
-# plane height up to which the one-hot matmul forms pay: the matmul touches
-# the WHOLE plane (fine for the rounds engine's ROW_BUDGET-bounded carried
-# planes and the [K, N] domain map), while a tall plane (the serial scan's
-# full [T, N] count state) is cheaper through the classic gather/scatter,
-# which touches only the addressed rows
-_MATMUL_ROWS = 512
-
-
-def take_rows(plane: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
-    """`plane[rows]` for a [K, N] plane and a small [Tc] int row vector.
-    Negative row ids yield ZERO rows, subsuming the
-    `where(valid, plane[clip(rows)], 0)` masking idiom at the call sites.
-
-    For short planes this is a one-hot matmul: dynamic row gathers along
-    the major axis lower to latency-bound kernels on TPU (measured ~4 ms
-    for a 1.6 MB gather at 100k nodes — the single hottest op in a bulk
-    round), while the [Tc, K] @ [K, N] product rides the MXU at memory
-    bandwidth. Precision is pinned to HIGHEST: the TPU's default bf16
-    matmul would round counts/domain ids above 256, while the f32-exact
-    passes keep one-hot selection bit-identical to the gather. Tall planes
-    keep the masked gather (the matmul would read the whole plane)."""
-    if plane.shape[0] <= _MATMUL_ROWS:
-        oh = jax.nn.one_hot(rows, plane.shape[0], dtype=jnp.float32)
-        return jnp.matmul(
-            oh, plane.astype(jnp.float32), precision=jax.lax.Precision.HIGHEST
-        )
-    safe = jnp.clip(rows, 0)
-    return jnp.where(
-        (rows >= 0)[:, None], plane[safe].astype(jnp.float32), 0.0
-    )
-
-
-def take_rows_i32(plane: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
-    """Integer-plane row gather via take_rows; exact for values below 2^24
-    (domain ids). Negative row ids yield 0 — callers that need a -1
-    sentinel for invalid rows must mask separately."""
-    if plane.shape[0] <= _MATMUL_ROWS:
-        return take_rows(plane, rows).astype(jnp.int32)
-    safe = jnp.clip(rows, 0)
-    return jnp.where((rows >= 0)[:, None], plane[safe], 0)
-
-
-def add_rows(plane: jnp.ndarray, rows: jnp.ndarray, delta: jnp.ndarray) -> jnp.ndarray:
-    """`plane.at[rows].add(delta)`: duplicate and negative row ids behave
-    like scatter-add with masked rows. Short planes use the full-plane
-    matmul add (row scatters cost milliseconds each on TPU; the
-    [T, Tc] @ [Tc, N] product plus a full-plane add runs at bandwidth —
-    the rounds engine's carried planes are ROW_BUDGET-bounded, ~100 MB).
-    Tall planes (the serial scan's full count state) keep the row scatter,
-    which touches only the addressed rows."""
-    if plane.shape[0] <= _MATMUL_ROWS:
-        oh = jax.nn.one_hot(rows, plane.shape[0], dtype=delta.dtype)
-        return plane + jnp.matmul(
-            oh.T, delta, precision=jax.lax.Precision.HIGHEST
-        )
-    safe = jnp.clip(rows, 0)
-    return plane.at[safe].add(jnp.where((rows >= 0)[:, None], delta, 0.0))
 
 
 class StepEval(NamedTuple):
@@ -1099,11 +1082,16 @@ def plan_scan_chunks(
     flags: StepFlags,
     chunk: int = None,
     row_budget: int = None,
+    wave_ok: np.ndarray = None,
 ):
     """The deterministic chunk plan of a chunked serial scan: yields
-    (c0, c1, gs_p, rows_p) per dispatch, where gs_p is the padded group set
-    the chunk's statics are sliced to (None = full planes) and rows_p the
-    padded term-row list its count planes carry (None = full plane).
+    (c0, c1, gs_p, rows_p, waves) per chunk, where gs_p is the padded group
+    set the chunk's statics are sliced to (None = full planes), rows_p the
+    padded term-row list its count planes carry (None = full plane), and
+    waves the chunk's wavefront sub-plan — absolute (a, b) ranges dispatched
+    through the speculative wavefront executable instead of the general
+    scan (empty without `wave_ok`, the per-pod eligibility mask from
+    `wave_pod_mask`).
 
     Single source of truth for the chunk contexts — `run_scan_chunked`
     executes this plan, and the AOT precompiler (engine/precompile.py)
@@ -1124,6 +1112,9 @@ def plan_scan_chunks(
     g_total = len(tensors.groups)  # statics planes may be [1, N]-collapsed
     group_sliceable = _pow2_up(min(g_total, _SCAN_GROUP_BUDGET)) < g_total
     g_terms_host = _compact_terms(tensors)[0] if row_sliceable else None
+    wave_hard = _wave_group_hard(tensors) if wave_ok is not None else None
+    wave_pref = _wave_group_pref(tensors) if wave_ok is not None else None
+    use_ip = flags.interpod_req or flags.interpod_pref
     for c0 in range(0, n, chunk):
         c1 = min(c0 + chunk, n)
         gs = np.unique(groups[c0:c1])
@@ -1138,7 +1129,15 @@ def plan_scan_chunks(
             rows = rows[rows >= 0]
             if len(rows) <= row_budget:
                 rows_p = pad_row_ids(np.sort(rows), t)
-        yield c0, c1, gs_p, rows_p
+        waves = (
+            _plan_waves(
+                groups, wave_ok, c0, c1, wave_hard, wave_pref,
+                use_topo, use_ip,
+            )
+            if wave_ok is not None
+            else []
+        )
+        yield c0, c1, gs_p, rows_p, waves
 
 
 def run_scan_chunked(
@@ -1152,6 +1151,7 @@ def run_scan_chunked(
     chunk: int = None,
     row_budget: int = None,
     prefetch=None,
+    wave_call=None,
 ):
     """Serial-equivalent scan over `pods`, dispatched in pow2 chunks whose
     count planes are sliced to each chunk's term-row union.
@@ -1160,12 +1160,15 @@ def run_scan_chunked(
     `scan_call(statics, state, seg, flags)` defaults to the compiled
     `_run_scan`; engines pass their sharded variants.  `prefetch` (a
     pytree→pytree callable, typically a non-blocking jax.device_put) is
-    applied to chunk i+1's pod segment right after chunk i dispatches, so
-    the host→device transfer of the next segment rides the queue while the
-    current chunk executes (double-buffered streaming — at most one
-    prepared segment is in flight ahead of the dispatch point).  Returns
-    (final_state, host output tuple) — outputs are numpy, truncated to the
-    real pod count."""
+    applied to the next pod segment right after the current one dispatches,
+    so the host→device transfer rides the queue while the current segment
+    executes (double-buffered streaming — at most one prepared segment is
+    in flight ahead of the dispatch point).  With `wave_call` (the
+    speculative wavefront executable, `_run_wavefront`'s calling
+    convention), eligible same-group runs inside each chunk dispatch
+    through it instead of the general scan — placements stay bit-identical
+    and the accept flags feed WAVE_COUNTS.  Returns (final_state, host
+    output tuple) — outputs are numpy, truncated to the real pod count."""
     call = scan_call or _run_scan
     n = groups.shape[0]
     if n == 0:  # preserve _run_scan's total contract (empty outputs)
@@ -1173,19 +1176,36 @@ def run_scan_chunked(
         return state, tuple(np.asarray(o) for o in fetch_outputs(outs))
     t = int(tensors.n_terms)
     g_total = len(tensors.groups)
-    plan = list(plan_scan_chunks(groups, tensors, flags, chunk, row_budget))
+    wave_ok = (
+        wave_pod_mask(pods, groups, tensors) if wave_call is not None else None
+    )
+    plan = list(
+        plan_scan_chunks(groups, tensors, flags, chunk, row_budget, wave_ok)
+    )
+    # flatten the chunk plan into dispatches: each chunk's wavefront runs
+    # interleave with the general-scan remainders, in pod order
+    dispatches = []  # (plan index, kind, a, b, (hard, pref)), [a, b) absolute
+    for i, (c0, c1, _, _, waves) in enumerate(plan):
+        for seg in flatten_wave_segments(c0, c1, waves):
+            dispatches.append((i,) + seg)
 
-    def prep_seg(i):
+    inv_g_cache = {}
+
+    def prep_seg(di):
         """Host-gather + pad + (optionally) start the device transfer of
-        plan chunk i's pod segment.  Pure function of the plan — safe to
-        run one chunk ahead of the dispatch point."""
-        c0, c1, gs_p, _ = plan[i]
-        seg_arrays = [arr[c0:c1] for arr in pods]
+        dispatch di's pod segment.  Pure function of the plan — safe to
+        run one dispatch ahead of the dispatch point."""
+        i, _, a, b, _ = dispatches[di]
+        gs_p = plan[i][2]
+        seg_arrays = [arr[a:b] for arr in pods]
         if gs_p is not None:
-            inv_g = np.zeros(g_total, np.int32)
-            inv_g[gs_p] = np.arange(len(gs_p), dtype=np.int32)
+            inv_g = inv_g_cache.get(i)
+            if inv_g is None:
+                inv_g = np.zeros(g_total, np.int32)
+                inv_g[gs_p] = np.arange(len(gs_p), dtype=np.int32)
+                inv_g_cache[i] = inv_g
             seg_arrays[0] = inv_g[np.asarray(seg_arrays[0])]
-        seg = pad_pods_pow2(tuple(seg_arrays), _pow2_up(c1 - c0))
+        seg = pad_pods_pow2(tuple(seg_arrays), _pow2_up(b - a))
         return prefetch(seg) if prefetch is not None else seg
 
     # active slice context: the (group set, term-row set) the current
@@ -1210,7 +1230,8 @@ def run_scan_chunked(
     eff_statics = statics
     g_terms_host = _compact_terms(tensors)[0]
     next_seg = prep_seg(0)
-    for i, (c0, c1, gs_p, rows_p) in enumerate(plan):
+    for di, (i, kind, a, b, w_mode) in enumerate(dispatches):
+        _, _, gs_p, rows_p, _ = plan[i]
         key = (
             None if gs_p is None else gs_p.tobytes(),
             None if rows_p is None else rows_p.tobytes(),
@@ -1254,100 +1275,988 @@ def run_scan_chunked(
                 ctx_rows = rows_p
             ctx_key = key
         seg = next_seg
-        state, outs = call(eff_statics, state, seg, flags)
-        # double buffer: chunk i+1's segment starts its transfer while
-        # chunk i executes (the dispatch above is async)
-        if i + 1 < len(plan):
-            next_seg = prep_seg(i + 1)
+        if kind == "wave":
+            state, outs, accepts = wave_call(
+                eff_statics, state, seg, flags,
+                wave_static_spec(tensors, w_mode[0], w_mode[1]),
+            )
+        else:
+            state, outs = call(eff_statics, state, seg, flags)
+            accepts = None
+        # double buffer: the next segment starts its transfer while this
+        # one executes (the dispatch above is async)
+        if di + 1 < len(dispatches):
+            next_seg = prep_seg(di + 1)
         # keep outputs on device: a per-chunk device_get would sync the
         # tunnel once per chunk; all dispatches queue first and one
         # batched transfer materializes everything afterwards
-        outs_dev.append((outs, c1 - c0))
+        outs_dev.append((outs, b - a, accepts))
     state = flush(state)
-    fetched = fetch_outputs([o for o, _ in outs_dev])
-    outs_host = [
-        tuple(np.asarray(o)[:real] for o in chunk_outs)
-        for chunk_outs, (_, real) in zip(fetched, outs_dev)
-    ]
+    fetched = fetch_outputs([(o, acc) for o, _, acc in outs_dev])
+    outs_host = []
+    for (seg_outs, accepts_h), (_, real, _) in zip(fetched, outs_dev):
+        outs_host.append(tuple(np.asarray(o)[:real] for o in seg_outs))
+        if accepts_h is not None:
+            acc = np.asarray(accepts_h)[:real]
+            prefix = int(real) if acc.all() else int(acc.argmin())
+            WAVE_COUNTS["wavefronts"] += 1
+            WAVE_COUNTS["pods"] += int(real)
+            WAVE_COUNTS["accepted"] += prefix
+            if prefix < real:
+                WAVE_COUNTS["rollbacks"] += 1
+                WAVE_COUNTS["rollback_pods"] += int(real) - prefix
     if len(outs_host) == 1:
         return state, outs_host[0]
     merged = tuple(
-        np.concatenate([chunk_outs[i] for chunk_outs in outs_host])
+        np.concatenate([seg_outs[i] for seg_outs in outs_host])
         for i in range(len(outs_host[0]))
     )
     return state, merged
 
 
-def _delta_step(statics: StaticArrays, state: SchedState, entry):
-    """Apply one placement-log entry to the state with weight w (+1 =
-    re-place, -1 = evict): exactly `schedule_step`'s state-update block,
-    without filters or node choice. Drives incremental preemption — a full
-    build_state from a million-entry log per eviction costs more than the
-    whole preemption."""
-    g, node, w, req, vg_alloc, sdev_take, gpu_vec = entry
-    safe = jnp.clip(node, 0)
-    updates = {"free": state.free.at[safe].add(-req * w)}
-    if state.ports_used.shape[1]:
-        updates["ports_used"] = state.ports_used.at[safe].add(
-            statics.ports_req[g] * w
+# -- speculative wavefront scan ---------------------------------------------
+#
+# The serial referee's remaining cost after chunking is the per-pod step
+# itself: every `lax.scan` step re-gathers the pod's group rows from ~20
+# sliced statics planes, streams the chunk's carried count rows through
+# one-hot matmuls, and drags the storage/GPU/ports/volumes machinery along
+# even for pods that use none of it.  But the pod sequence is dominated by
+# RUNS — consecutive pods of one group (1000-replica deployments) whose
+# feasible-node sets and resource deltas interact only through `free` and
+# the group's OWN handful of topology terms.  The wavefront dispatcher
+# exploits that, speculative-decoding style (docs/speculation.md):
+#
+# 1. A host-side planner partitions each chunk's pod sequence into
+#    wavefronts: maximal same-group runs of LEAN pods (unpinned, unforced,
+#    no storage/GPU demand, a group requesting no host ports or volumes —
+#    `wave_pod_mask`).  Everything else stays on the general serial scan.
+# 2. One jitted call per wavefront (`_run_wavefront`) places the whole run:
+#    the speculative step evaluates the run's spec ONCE against the
+#    wavefront-start state (the batched placement every pod would get if
+#    the run's pods could not interact at all), then a compiled VERIFIER
+#    replays the serial tie-break order pod-at-a-time over a reduced carry —
+#    `free` plus the group's own [Tc, N] count-row slices, with every
+#    group-row gather hoisted out of the loop — emitting each pod's exact
+#    serial placement plus an accept flag (speculation == serial).
+# 3. Accept-longest-prefix: pods up to the first divergence kept their
+#    speculative placement (the accept flags prove it); every pod beyond it
+#    is rolled back and takes the verifier's replayed serial answer.  The
+#    committed state is always the verifier's — placements are bit-identical
+#    to the pod-at-a-time scan by construction, and `WAVE_COUNTS` reports
+#    the acceptance rate and rollback volume.
+#
+# Bit-exactness rests on three pinned facts: (a) the verifier computes the
+# same kernel calls in the same order as `filter_and_score`/`score_pod` on
+# inputs that are bitwise equal (take_rows' one-hot matmul reproduces plane
+# rows exactly, and the per-pod lax.cond skips it replaces are themselves
+# exact — a zero-term group's skipped kernels return the same constants the
+# unconditional kernels do); (b) a lean pod's storage/GPU/ports/volumes
+# stages reduce to the same all-true masks and zero plans the general step's
+# skip branches produce; (c) the carried count-row slices hold small
+# integers (counts and integer preference weights), so folding their deltas
+# back into the full planes is float-exact below 2^24.
+
+#: minimum run length worth a wavefront dispatch (shorter runs stay on the
+#: general scan; mirrors RoundsEngine.MIN_RUN's reasoning)
+_WAVE_MIN = 8
+
+
+def wave_group_mask(tensors) -> np.ndarray:
+    """[G] bool — groups whose pods can ride a wavefront: no host-port and
+    no volume requests, so two run members can only interact through free
+    resources and the group's own topology terms (both carried exactly by
+    the verifier).  Memoized on the tensors object."""
+    cached = getattr(tensors, "_wave_group_cache", None)
+    if cached is not None:
+        return cached
+    g_n = len(tensors.groups)
+    ok = np.ones(g_n, bool)
+    if tensors.n_ports:
+        ok &= ~tensors.ports.any(axis=1)
+    if tensors.n_vols:
+        ok &= ~(
+            tensors.vol_rw.any(axis=1)
+            | tensors.vol_ro.any(axis=1)
+            | tensors.vol_att.any(axis=1)
         )
-    if state.vols_any.shape[1]:
-        v_rw = statics.vol_rw_req[g]
-        v_present = v_rw | statics.vol_ro_req[g] | statics.vol_att_req[g]
-        updates["vols_any"] = state.vols_any.at[safe].add(v_present * w)
-        updates["vols_rw"] = state.vols_rw.at[safe].add(v_rw * w)
-    if state.vg_free.shape[1]:
-        updates["vg_free"] = state.vg_free.at[safe].add(-vg_alloc * w)
-    if state.sdev_free.shape[1]:
-        # boolean devices: w>0 consumes (clear), w<0 releases (set)
-        row = state.sdev_free[safe]
-        row = jnp.where(w > 0, row & ~sdev_take, row | sdev_take)
-        updates["sdev_free"] = state.sdev_free.at[safe].set(row)
-    if state.gpu_free.shape[1]:
-        updates["gpu_free"] = state.gpu_free.at[safe].add(-gpu_vec * w)
-    t_cap = statics.g_terms.shape[1]
+    object.__setattr__(tensors, "_wave_group_cache", ok)
+    return ok
+
+
+def wave_pod_mask(pods, groups: np.ndarray, tensors) -> np.ndarray:
+    """[P] bool — pods eligible for wavefront placement: lean (no
+    storage/GPU demand), unpinned, unforced, and of a wavefront-eligible
+    group.  Pure host-side numpy over the pod tuple
+    (`build_pod_arrays` layout)."""
+    ok = (np.asarray(pods[2]) == -1) & ~np.asarray(pods[3])
+    lvm = np.asarray(pods[4])
+    if lvm.size:
+        ok &= lvm.max(axis=1) <= 0
+    dev = np.asarray(pods[6])
+    if dev.size:
+        ok &= dev.max(axis=1) <= 0
+    ok &= np.asarray(pods[8]) <= 0
+    ok &= wave_group_mask(tensors)[groups]
+    return ok
+
+
+def _wave_group_hard(tensors) -> np.ndarray:
+    """[G] bool — the group owns a hard constraint term (DoNotSchedule skew
+    or required (anti-)affinity incidence): its wavefronts take the
+    hard-mode verifier, whose masks are recomputed per step.  Everything
+    else rides the lean verifier.  Memoized on the tensors object."""
+    cached = getattr(tensors, "_wave_hard_cache", None)
+    if cached is not None:
+        return cached
+    g_n = len(tensors.groups)
+    hard = np.zeros(g_n, bool)
+    if tensors.n_terms:
+        hard = (
+            (tensors.spread_hard > 0).any(axis=1)
+            | tensors.a_aff_req.any(axis=1)
+            | tensors.a_anti_req.any(axis=1)
+        )
+    object.__setattr__(tensors, "_wave_hard_cache", hard)
+    return hard
+
+
+def _wave_group_pref(tensors) -> np.ndarray:
+    """[G] bool — the group's own interpod preference weights move its
+    interpod raw while it places (lean verifier's `pref` specialization:
+    without it the interpod term is wavefront-constant between mask
+    flips).  Memoized on the tensors object."""
+    cached = getattr(tensors, "_wave_pref_cache", None)
+    if cached is not None:
+        return cached
+    g_n = len(tensors.groups)
+    pref = np.zeros(g_n, bool)
+    if tensors.n_terms:
+        pref = (
+            (tensors.w_aff_pref != tensors.w_anti_pref) & tensors.s_match
+        ).any(axis=1)
+    object.__setattr__(tensors, "_wave_pref_cache", pref)
+    return pref
+
+
+def _plan_waves(
+    groups: np.ndarray, wave_ok: np.ndarray, c0: int, c1: int,
+    hard_g: np.ndarray, pref_g: np.ndarray, use_topo: bool, use_ip: bool,
+):
+    """Maximal same-group runs of wavefront-eligible pods within [c0, c1),
+    length >= _WAVE_MIN, as absolute (a, b, hard, pref) entries."""
+    g = groups[c0:c1]
+    if g.shape[0] == 0:
+        return []
+    ok = wave_ok[c0:c1]
+    brk = np.flatnonzero((g[1:] != g[:-1]) | (ok[1:] != ok[:-1])) + 1
+    starts = np.concatenate([[0], brk])
+    ends = np.concatenate([brk, [len(g)]])
+    return [
+        (
+            int(c0 + a),
+            int(c0 + b),
+            use_topo and bool(hard_g[g[a]]),
+            use_ip and bool(pref_g[g[a]]),
+        )
+        for a, b in zip(starts, ends)
+        if ok[a] and b - a >= _WAVE_MIN
+    ]
+
+
+def flatten_wave_segments(c0: int, c1: int, waves):
+    """One chunk's dispatch order: ('scan'|'wave', a, b, mode) segments,
+    wavefront runs interleaved with the general-scan remainders in pod
+    order (mode = (hard, pref) for waves, None for scan).  The SINGLE
+    source of the per-chunk dispatch sequence — run_scan_chunked executes
+    it and the AOT enumerator (engine/precompile.py) walks the same list,
+    so the precompiled signatures can never drift from the dispatched
+    ones."""
+    segs = []
+    pos = c0
+    for wa, wb, w_hard, w_pref in waves:
+        if wa > pos:
+            segs.append(("scan", pos, wa, None))
+        segs.append(("wave", wa, wb, (w_hard, w_pref)))
+        pos = wb
+    if pos < c1:
+        segs.append(("scan", pos, c1, None))
+    return segs
+
+
+def wavefront_scan(
+    statics: StaticArrays,
+    state: SchedState,
+    pods,
+    flags: StepFlags = StepFlags(),
+    hard: bool = False,
+    pref: bool = False,
+    key_kinds=None,
+    n_domains: int = 1,
+):
+    """Place one same-group lean wavefront (see the section comment).
+
+    Returns (new_state, (node, reason, lvm_alloc, dev_take, gpu_shares),
+    accepts): the output tuple matches `_run_scan`'s per-pod layout (the
+    extended-resource planes are exact zeros for lean pods, the same values
+    the general step's skip branches emit), and `accepts[i]` is True when
+    pod i's serial placement equals the speculative wavefront-start answer
+    (`node[0]` — every pod of an identical-spec run drafts the same
+    argmax).  Padded rows (inert forced pods, `pad_pods_pow2`) never touch
+    state and report node -1, exactly like the general scan.
+
+    Two statically specialized verifiers (the planner picks per run):
+
+    - `hard=False` (LEAN): the run owns no hard constraint term (no
+      DoNotSchedule skew, no required (anti-)affinity), so the feasibility
+      mask can only change where `free` changes — the node the previous
+      placement touched.  The verify scan carries [N] vectors only: the
+      row-maintained fit mask and free-score, the carried normalized static
+      terms (renormalized via lax.cond on the rare fit-mask flip), and the
+      group's summed count raws (selector-spread host/zone, soft-spread,
+      interpod), updated per step through a [K, N] same-domain indicator
+      per topology KEY (K ≈ 2) instead of [Tc, N] per-term streams.  The
+      full count planes are reconstructed once post-scan from the choice
+      sequence (a per-key domain histogram — exact: counts and preference
+      weights are small integers, so every reordered sum is float-exact).
+    - `hard=True`: the run owns a quota/affinity domain (hard skew or
+      required (anti-)affinity terms), whose masks move domain-wide per
+      placement — the verifier recomputes the full filter cascade per step
+      over the group's [Tc, N] slices, exactly like the general step.
+
+    `n_domains` (static) sizes the post-scan domain histogram."""
+    g_arr, req_arr, pin_arr, forced_arr = pods[0], pods[1], pods[2], pods[3]
+    f = flags
+    n = statics.alloc.shape[0]
+    g = g_arr[0]
+    use_topo = (
+        f.spread_hard or f.spread_soft or f.selector_spread
+        or f.interpod_req or f.interpod_pref
+    )
+    t_cap = statics.g_terms.shape[1] if use_topo else 0
+    carry_ip = bool(t_cap) and (f.interpod_req or f.interpod_pref)
+    w_ = statics.score_w
+    alloc = statics.alloc
+
+    # -- hoisted group rows, state slices, and run invariants (once per
+    # wavefront; the general step recomputes all of these per pod) ---------
+    # every real pod of the run is unpinned (planner guarantee), so pin_m
+    # is all-true and m_static is run-constant; padded rows are forced and
+    # never read the masks
+    m_static = statics.static_mask[g] & statics.node_valid
+    node_pref_g = statics.node_pref[g]
+    taint_g = statics.taint_intol[g]
+    sscore_g = statics.static_score[g]
+    avoid_g = statics.avoid_pen[g]
+    # identical specs share one raw Simon score (static allocatable only)
+    simon_raw = simon_share(alloc, req_arr[0])
+    # wavefront-constant filter stages: the run adds no ports or volumes,
+    # so NodePorts / VolumeRestrictions / NodeVolumeLimits cannot change
+    # while it places; a lean pod's storage and GPU planners reduce to
+    # their skip branches (all-true masks, zero plans).  Boolean AND is
+    # exact, so pre-folding the constant stages is mask-identical.
+    ports_ok = (
+        ports_conflict_free(state.ports_used, statics.ports_req[g])
+        if f.ports
+        else jnp.ones(n, bool)
+    )
+    vol_ok = (
+        volume_conflict_free(
+            state.vols_any, state.vols_rw,
+            statics.vol_rw_req[g], statics.vol_ro_req[g],
+        )
+        if f.vols
+        else jnp.ones(n, bool)
+    )
+    att_ok = (
+        attach_limits_ok(
+            state.vols_any, statics.vol_att_req[g],
+            statics.vol_class_mask, statics.attach_limits,
+        )
+        if f.attach
+        else jnp.ones(n, bool)
+    )
+    m_ports = m_static & ports_ok
+    post_res = vol_ok & att_ok & statics.vol_mask[g]  # m_res -> m_bind fold
+    # identical specs ⇒ NodeResourcesFit and the two free-dependent score
+    # terms change ONLY at the node the previous placement touched: both
+    # are carried whole and row-updated per step (the kernels are row-
+    # independent, so a [1, R]-slice recompute is bit-identical to the
+    # full-width pass the general step pays)
+    req0 = req_arr[0]
+    m_fit0 = resources_fit(state.free, req0)
+    fscore0 = w_[0] * least_allocated(state.free, alloc, req0)
+    fscore0 = fscore0 + w_[1] * balanced_allocation(state.free, alloc, req0)
+
     if t_cap:
         terms_g = statics.g_terms[g]
         tvalid = terms_g >= 0
         tsafe = jnp.clip(terms_g, 0)
-        dom_sub = take_rows_i32(
-            statics.node_dom, jnp.where(tvalid, statics.term_topo[tsafe], -1)
-        )
-        valid_sub = (dom_sub >= 0) & tvalid[:, None]
-        dom_chosen = dom_sub[:, safe]
-        valid_chosen = (dom_chosen >= 0) & tvalid
-        same = valid_sub & (dom_sub == dom_chosen[:, None]) & valid_chosen[:, None]
-        inc = jnp.where(same, w, 0.0)
-
-        updates["cnt_match"] = add_rows(
-            state.cnt_match, terms_g, statics.s_match[g][:, None] * inc
-        )
-        updates["cnt_total"] = state.cnt_total.at[tsafe].add(
-            statics.s_match[g] * jnp.where(valid_chosen, w, 0.0)
-        )
+        term_keys = jnp.where(tvalid, statics.term_topo[tsafe], -1)
         ip_eff = jnp.where(tvalid, statics.ip_of[tsafe], -1)
-
-        def bump_ip(arr, vals):
-            return add_rows(arr, ip_eff, vals[:, None] * inc)
-
-        updates["cnt_own_anti"] = bump_ip(
-            state.cnt_own_anti, statics.a_anti_req[g].astype(jnp.float32)
+        s_match_g = statics.s_match[g]
+        a_aff_g = statics.a_aff_req[g]
+        a_anti_g = statics.a_anti_req[g]
+        w_aff_g = statics.w_aff_pref[g]
+        w_anti_g = statics.w_anti_pref[g]
+        spread_hard_g = statics.spread_hard[g]
+        spread_soft_g = statics.spread_soft[g]
+        ss_host_g = statics.ss_host[g]
+        ss_zone_g = statics.ss_zone[g]
+        dom_sub = take_rows_i32(statics.node_dom, term_keys)
+        valid_sub = (dom_sub >= 0) & tvalid[:, None]
+        cnt_sub0 = take_rows(state.cnt_match, terms_g)
+        ct0 = jnp.where(tvalid, state.cnt_total[tsafe], 0.0)
+    if carry_ip:
+        own0 = tuple(
+            take_rows(plane, ip_eff)
+            for plane in (
+                state.cnt_own_anti, state.cnt_own_aff,
+                state.w_own_aff_pref, state.w_own_anti_pref,
+            )
         )
-        updates["cnt_own_aff"] = bump_ip(
-            state.cnt_own_aff, statics.a_aff_req[g].astype(jnp.float32)
+
+    def fail_from(m_res, m_spread, extra=None):
+        """StepEval.fail_code's reversed cascade with the lean-pod stage
+        identities (m_vol/m_att = m_res & hoisted conds, m_storage =
+        m_gpu = m_bind) substituted."""
+        m_vol = m_res & vol_ok
+        m_att = m_vol & att_ok
+        m_bind = m_att & statics.vol_mask[g]
+        fail = jnp.int32(FAIL_INTERPOD)
+        for mask, code in (
+            (m_spread, FAIL_SPREAD),
+            (m_bind, FAIL_GPU),
+            (m_bind, FAIL_STORAGE),
+            (m_bind, FAIL_VOLUME_BIND),
+            (m_att, FAIL_ATTACH),
+            (m_vol, FAIL_VOLUME),
+            (m_res, FAIL_RESOURCES),
+            (m_ports, FAIL_PORTS),
+            (m_static, FAIL_STATIC),
+        ):
+            fail = jnp.where(jnp.any(mask), fail, code)
+        return fail
+
+    def free_rows_update(free, m_fit, fscore, safe, req, placed):
+        """Row-maintain the carried fit mask and free-score terms: only the
+        touched node's free changed, and the kernels are row-independent,
+        so a [1, R]-slice recompute reproduces the full pass's row bits.
+        Padded (forced) pods never place, so the carry is untouched by
+        their zero req rows.  Returns (m_fit, fscore, prev_fit, fit_row)."""
+        free_row = free[safe][None, :]
+        alloc_row = alloc[safe][None, :]
+        fit_row = resources_fit(free_row, req)[0]
+        frow = w_[0] * least_allocated(free_row, alloc_row, req)
+        frow = frow + w_[1] * balanced_allocation(free_row, alloc_row, req)
+        prev_fit = m_fit[safe]
+        m_fit = m_fit.at[safe].set(jnp.where(placed, fit_row, prev_fit))
+        fscore = fscore.at[safe].set(jnp.where(placed, frow[0], fscore[safe]))
+        return m_fit, fscore, prev_fit, fit_row
+
+    if hard:
+        new_state, nodes, reasons = _wave_verify_hard(
+            statics, state, (req_arr, pin_arr, forced_arr), f,
+            locals(),
         )
-        updates["w_own_aff_pref"] = bump_ip(state.w_own_aff_pref, statics.w_aff_pref[g])
-        updates["w_own_anti_pref"] = bump_ip(
-            state.w_own_anti_pref, statics.w_anti_pref[g]
+    else:
+        new_state, nodes, reasons = _wave_verify_lean(
+            statics, state, (req_arr, pin_arr, forced_arr), f,
+            locals(), pref, key_kinds, n_domains,
         )
-    return state._replace(**updates), ()
+
+    w_pods = nodes.shape[0]
+    outs = (
+        nodes,
+        reasons,
+        jnp.zeros((w_pods, statics.vg_cap.shape[1]), statics.vg_cap.dtype),
+        jnp.zeros((w_pods, state.sdev_free.shape[1]), bool),
+        jnp.zeros((w_pods, state.gpu_free.shape[1]), state.gpu_free.dtype),
+    )
+    # the speculative wavefront placement is the state_0 answer — what one
+    # batched step would assign every pod of the identical-spec run; the
+    # first verify step IS that eval, so nodes[0] is the draft
+    accepts = nodes == nodes[0]
+    return new_state, outs, accepts
 
 
-@partial(jax.jit, donate_argnums=(1,))
-def _apply_log_delta(statics: StaticArrays, state: SchedState, entries):
-    """Scan `_delta_step` over padded entry arrays (w = 0 rows are no-ops)."""
-    state, _ = jax.lax.scan(partial(_delta_step, statics), state, entries)
-    return state
+def _wave_verify_hard(statics, state, xs, f, env):
+    """The hard-mode verifier: full per-step recompute of the group's
+    [Tc, N] filter/score slices (quota/affinity domains move domain-wide
+    per placement).  `env` carries wavefront_scan's hoists."""
+    (m_static, m_ports, post_res, simon_raw, node_pref_g, taint_g, sscore_g,
+     avoid_g, m_fit0, fscore0, w_, alloc, fail_from, free_rows_update) = (
+        env["m_static"], env["m_ports"], env["post_res"], env["simon_raw"],
+        env["node_pref_g"], env["taint_g"], env["sscore_g"], env["avoid_g"],
+        env["m_fit0"], env["fscore0"], env["w_"], env["alloc"],
+        env["fail_from"], env["free_rows_update"],
+    )
+    t_cap = env["t_cap"]
+    carry_ip = env["carry_ip"]
+    if t_cap:
+        (terms_g, tvalid, tsafe, dom_sub, valid_sub, ip_eff, s_match_g,
+         a_aff_g, a_anti_g, w_aff_g, w_anti_g, spread_hard_g, spread_soft_g,
+         ss_host_g, ss_zone_g, cnt_sub0, ct0) = (
+            env["terms_g"], env["tvalid"], env["tsafe"], env["dom_sub"],
+            env["valid_sub"], env["ip_eff"], env["s_match_g"], env["a_aff_g"],
+            env["a_anti_g"], env["w_aff_g"], env["w_anti_g"],
+            env["spread_hard_g"], env["spread_soft_g"], env["ss_host_g"],
+            env["ss_zone_g"], env["cnt_sub0"], env["ct0"],
+        )
+    if carry_ip:
+        own0 = env["own0"]
+
+    def vstep(carry, x):
+        req, pin, forced = x
+        it = iter(carry)
+        free = next(it)
+        m_fit = next(it)
+        fscore = next(it)
+        if t_cap:
+            cnt_sub = next(it)
+            ct = next(it)
+        if carry_ip:
+            own_anti, own_aff, w_own_a, w_own_n = (
+                next(it), next(it), next(it), next(it)
+            )
+        # filter cascade — same stage structure (and flag gating) as
+        # filter_and_score, on the hoisted run-constant masks
+        m_res = m_ports & m_fit
+        m_bind = m_res & post_res
+        m_spread = m_bind
+        if f.spread_hard and t_cap:
+            # unconditional kernel == the general step's lax.cond: with no
+            # active skew terms every node passes (active = max_skew > 0)
+            m_spread = m_bind & topology_spread_filter(
+                cnt_sub, valid_sub, spread_hard_g, m_static
+            )
+        m_all = m_spread
+        if f.interpod_req and t_cap:
+            m_all = m_spread & interpod_filter(
+                cnt_sub, own_anti, valid_sub, ct,
+                s_match_g, a_aff_g, a_anti_g,
+            )
+        feasible = jnp.any(m_all)
+        # score — identical term order and kernels as score_pod; the
+        # per-pod cond skips it replaces return the same constants the
+        # unconditional kernels produce for term-free rows
+        score = fscore
+        score = score + (w_[2] + w_[3]) * minmax_normalize(simon_raw, m_all)
+        if f.node_pref:
+            score += w_[4] * minmax_normalize(node_pref_g, m_all)
+        if f.taint_pref:
+            score += w_[5] * taint_toleration_score(taint_g, m_all)
+        if (f.interpod_pref or f.interpod_req) and t_cap:
+            raw_ipa = interpod_score(
+                cnt_sub, own_aff, w_own_a, w_own_n,
+                s_match_g, w_aff_g, w_anti_g,
+            )
+            score += w_[6] * maxabs_normalize(raw_ipa, m_all)
+        if f.spread_soft and t_cap:
+            score += w_[7] * topology_spread_score(cnt_sub, spread_soft_g, m_all)
+        if f.selector_spread and t_cap:
+            score += w_[8] * selector_spread_score(
+                cnt_sub, ss_host_g, ss_zone_g, m_all
+            )
+        if f.static_score:
+            score += w_[9] * sscore_g + w_[11] * avoid_g
+        score = jnp.where(m_all, score, -jnp.inf)
+
+        chosen = jnp.where(forced, pin, jnp.argmax(score).astype(jnp.int32))
+        placed = jnp.where(
+            forced, (pin >= 0) & statics.node_valid[jnp.clip(pin, 0)], feasible
+        )
+        fail = jax.lax.cond(
+            placed | forced,
+            lambda _: jnp.int32(OK),
+            lambda _: fail_from(m_res, m_spread),
+            None,
+        )
+        reason = jnp.where(
+            placed, OK, jnp.where(forced, FAIL_NO_NODE, fail)
+        ).astype(jnp.int32)
+
+        # state update — schedule_step's update block on the reduced carry
+        safe = jnp.clip(chosen, 0)
+        w = jnp.where(placed, 1.0, 0.0)
+        free = free.at[safe].add(-req * w)
+        m_fit, fscore, _, _ = free_rows_update(
+            free, m_fit, fscore, safe, req, placed
+        )
+        out_carry = [free, m_fit, fscore]
+        if t_cap:
+            dom_chosen = dom_sub[:, safe]
+            valid_chosen = (dom_chosen >= 0) & tvalid & placed
+            same = (
+                valid_sub
+                & (dom_sub == dom_chosen[:, None])
+                & valid_chosen[:, None]
+            )
+            inc = jnp.where(same, 1.0, 0.0)
+            cnt_sub = cnt_sub + s_match_g[:, None] * inc
+            ct = ct + s_match_g * jnp.where(valid_chosen, 1.0, 0.0)
+            out_carry += [cnt_sub, ct]
+        if carry_ip:
+            if f.interpod_req:
+                own_anti = own_anti + a_anti_g[:, None] * inc
+                own_aff = own_aff + a_aff_g[:, None] * inc
+            if f.interpod_pref:
+                w_own_a = w_own_a + w_aff_g[:, None] * inc
+                w_own_n = w_own_n + w_anti_g[:, None] * inc
+            out_carry += [own_anti, own_aff, w_own_a, w_own_n]
+        out_node = jnp.where(placed, chosen, -1)
+        return tuple(out_carry), (out_node, reason)
+
+    carry0 = [state.free, m_fit0, fscore0]
+    if t_cap:
+        carry0 += [cnt_sub0, ct0]
+    if carry_ip:
+        carry0 += list(own0)
+    carry_f, (nodes, reasons) = jax.lax.scan(vstep, tuple(carry0), xs)
+
+    # fold the reduced carry back into the full state.  The count-row
+    # deltas are small integers (counts / integer preference weights), so
+    # plane + (final - initial) is float-exact — bit-identical to having
+    # updated the full planes in place.
+    it = iter(carry_f)
+    updates = {"free": next(it)}
+    next(it)  # m_fit — derived, not part of SchedState
+    next(it)  # fscore — derived, not part of SchedState
+    if t_cap:
+        cnt_f = next(it)
+        ct_f = next(it)
+        updates["cnt_match"] = add_rows(state.cnt_match, terms_g, cnt_f - cnt_sub0)
+        updates["cnt_total"] = state.cnt_total.at[tsafe].add(
+            jnp.where(tvalid, ct_f - ct0, 0.0)
+        )
+    if carry_ip:
+        own_f = (next(it), next(it), next(it), next(it))
+        if f.interpod_req:
+            updates["cnt_own_anti"] = add_rows(
+                state.cnt_own_anti, ip_eff, own_f[0] - own0[0]
+            )
+            updates["cnt_own_aff"] = add_rows(
+                state.cnt_own_aff, ip_eff, own_f[1] - own0[1]
+            )
+        if f.interpod_pref:
+            updates["w_own_aff_pref"] = add_rows(
+                state.w_own_aff_pref, ip_eff, own_f[2] - own0[2]
+            )
+            updates["w_own_anti_pref"] = add_rows(
+                state.w_own_anti_pref, ip_eff, own_f[3] - own0[3]
+            )
+    return state._replace(**updates), nodes, reasons
+
+
+def _wave_verify_lean(statics, state, xs, f, env, pref, key_kinds, n_domains):
+    """The lean-mode verifier: no hard constraint term is owned by the run,
+    so the feasibility mask moves only with the row-maintained fit mask and
+    the count-dependent score terms reduce to carried raws updated through
+    same-domain bookkeeping.  `env` carries wavefront_scan's hoists; `pref`
+    (static) is whether the run carries interpod preference weights that
+    move its own interpod raw; `key_kinds` (static tuple, None = generic)
+    enables the TABULAR carry when every topology key is either
+    unique-per-node (kind 2) or small-domain (kind 1, ≤ DOM_SMALL ids in
+    node_dom_small).
+
+    Carried invariants (each exact, each refreshed only when its inputs
+    can actually have changed):
+    - m_all / feasible: change only when a placement flips the fit mask
+      row of its node (everything else in the cascade is run-constant);
+      between flips the chosen node stays feasible, so the masked
+      selector-spread maxima advance by a scalar `maximum` — max is
+      order-free, hence bit-identical to the full reduction.
+    - normalized static terms (Simon / node-affinity / taint) and, without
+      `pref`, the interpod term: depend on m_all (and a then-constant raw)
+      only — renormalized inside the one flip cond.
+    - count raws: every raw is an integer combination of per-domain
+      placement counts, so TABULAR mode carries only a per-node placement
+      counter (kind-2 keys) and a [K1, DOM_SMALL] domain histogram (kind-1
+      keys), updated O(1) per step, and re-materializes each raw inline —
+      integer sums are float-exact under any regrouping, so the
+      materialized raw is bit-identical to the step-by-step bumps.
+      Generic mode (a kind-0 scatter-fallback key exists) carries the full
+      [N] raws and advances them through a per-key indicator matmul."""
+    (m_static, m_ports, post_res, simon_raw, node_pref_g, taint_g, sscore_g,
+     avoid_g, m_fit0, fscore0, w_, alloc, fail_from, free_rows_update) = (
+        env["m_static"], env["m_ports"], env["post_res"], env["simon_raw"],
+        env["node_pref_g"], env["taint_g"], env["sscore_g"], env["avoid_g"],
+        env["m_fit0"], env["fscore0"], env["w_"], env["alloc"],
+        env["fail_from"], env["free_rows_update"],
+    )
+    t_cap = env["t_cap"]
+    n = statics.alloc.shape[0]
+    node_dom = statics.node_dom  # [K, N]
+    key_n = node_dom.shape[0]
+    key_valid = node_dom >= 0
+    has_ss = bool(t_cap) and f.selector_spread
+    has_soft = bool(t_cap) and f.spread_soft
+    has_ip = bool(t_cap) and (f.interpod_req or f.interpod_pref)
+    hp = jax.lax.Precision.HIGHEST  # integer-count matmuls must stay exact
+
+    if t_cap:
+        (terms_g, tvalid, tsafe, term_keys, ip_eff, s_match_g, w_aff_g,
+         w_anti_g, spread_soft_g, ss_host_g, ss_zone_g, cnt_sub0, ct0,
+         valid_sub) = (
+            env["terms_g"], env["tvalid"], env["tsafe"], env["term_keys"],
+            env["ip_eff"], env["s_match_g"], env["w_aff_g"], env["w_anti_g"],
+            env["spread_soft_g"], env["ss_host_g"], env["ss_zone_g"],
+            env["cnt_sub0"], env["ct0"], env["valid_sub"],
+        )
+        s_match_f = s_match_g.astype(jnp.float32)
+        # per-key coefficient folds: every term of one topology key shares
+        # the same same-domain indicator, so the per-step raw deltas
+        # collapse to [K]-coefficient combinations of per-key counts
+        key_oh = jax.nn.one_hot(term_keys, key_n, dtype=jnp.float32)
+    # the run owns no required (anti-)affinity term, so the interpod
+    # filter's inputs (the own-anti planes and the run-invariant
+    # a_aff/a_anti rows) cannot change while it places — the mask the
+    # general step recomputes per pod is wavefront-constant
+    ip_mask = jnp.ones(n, bool)
+    if bool(t_cap) and f.interpod_req:
+        ip_mask = interpod_filter(
+            cnt_sub0,
+            env["own0"][0] if env["carry_ip"]
+            else take_rows(state.cnt_own_anti, ip_eff),
+            valid_sub, ct0, s_match_g, env["a_aff_g"], env["a_anti_g"],
+        )
+    m_nofit = m_ports & post_res & ip_mask
+    m_all0 = m_nofit & m_fit0
+    feasible0 = jnp.any(m_all0)
+
+    def _norm_terms(m_all):
+        out = [minmax_normalize(simon_raw, m_all)]
+        if f.node_pref:
+            out.append(minmax_normalize(node_pref_g, m_all))
+        if f.taint_pref:
+            out.append(taint_toleration_score(taint_g, m_all))
+        return tuple(out)
+
+    raw0s = []
+    coefs = []
+    if has_ss:
+        any_zone = jnp.any(ss_zone_g)
+        raw0s += [
+            ss_host_g.astype(jnp.float32) @ cnt_sub0,
+            ss_zone_g.astype(jnp.float32) @ cnt_sub0,
+        ]
+        coefs += [
+            jnp.matmul(ss_host_g * s_match_f, key_oh, precision=hp),
+            jnp.matmul(ss_zone_g * s_match_f, key_oh, precision=hp),
+        ]
+    if has_soft:
+        soft_slot = len(raw0s)
+        raw0s.append(spread_soft_g @ cnt_sub0)
+        coefs.append(jnp.matmul(spread_soft_g * s_match_f, key_oh, precision=hp))
+    ipa_raw0 = None
+    if has_ip:
+        own0 = env["own0"]
+        ipa_raw0 = interpod_score(
+            cnt_sub0, own0[1], own0[2], own0[3],
+            s_match_g, w_aff_g, w_anti_g,
+        )
+        if pref:
+            # one placement bumps both the incoming count and the
+            # symmetric owner weight by the same per-key amount — hence 2x
+            raw0s.append(ipa_raw0)
+            coefs.append(2.0 * jnp.matmul(
+                (w_aff_g - w_anti_g) * s_match_f, key_oh, precision=hp
+            ))
+    coef_mat = jnp.stack(coefs) if coefs else None  # [V, K]
+    n_raws = len(raw0s)
+    tab = key_kinds is not None and n_raws > 0
+    if tab:
+        k1_keys = tuple(k for k, kd in enumerate(key_kinds) if kd == 1)
+        k2_keys = tuple(k for k, kd in enumerate(key_kinds) if kd == 2)
+        kv2 = [jnp.where(key_valid[k], 1.0, 0.0) for k in k2_keys]
+        dsmall = [statics.node_dom_small[k] for k in k1_keys]
+        from ..core.tensorize import DOM_SMALL
+
+        def tab_rows(cnttab):
+            """Per-kind-1-key domain histogram gathered onto the node axis
+            (masked where the key is absent)."""
+            return [
+                jnp.where(d >= 0, cnttab[j][jnp.clip(d, 0)], 0.0)
+                for j, d in enumerate(dsmall)
+            ]
+
+        def materialize(v, placecnt, trows):
+            """Raw v at the current step — raw0 plus the integer-exact
+            per-key count combinations."""
+            r = raw0s[v]
+            for j, k in enumerate(k2_keys):
+                r = r + coef_mat[v, k] * (placecnt * kv2[j])
+            for j, k in enumerate(k1_keys):
+                r = r + coef_mat[v, k] * trows[j]
+            return r
+
+        def value_at(v, safe, placecnt, cnttab):
+            """materialize(v)[safe] from the table components (O(1))."""
+            val = raw0s[v][safe]
+            for j, k in enumerate(k2_keys):
+                val = val + coef_mat[v, k] * (placecnt[safe] * kv2[j][safe])
+            for j, k in enumerate(k1_keys):
+                d = dsmall[j][safe]
+                val = val + coef_mat[v, k] * jnp.where(
+                    d >= 0, cnttab[j, jnp.clip(d, 0)], 0.0
+                )
+            return val
+
+    def _flip_terms(m_all):
+        """Everything that must be refreshed when the mask changes: the
+        normalized static terms and the constant-raw interpod term."""
+        out = list(_norm_terms(m_all))
+        if has_ip and not pref:
+            out.append(maxabs_normalize(ipa_raw0, m_all))
+        return tuple(out)
+
+    terms0 = _flip_terms(m_all0)
+    scal0 = (feasible0,)
+    if has_ss:
+        scal0 += (
+            jnp.max(jnp.where(m_all0, raw0s[0], 0.0)),
+            jnp.max(jnp.where(m_all0, raw0s[1], 0.0)),
+        )
+    if tab:
+        count0 = (jnp.zeros(n, jnp.float32),) if k2_keys else ()
+        count0 += (
+            (jnp.zeros((len(k1_keys), DOM_SMALL), jnp.float32),)
+            if k1_keys
+            else ()
+        )
+    else:
+        count0 = tuple(raw0s)
+
+    def lstep(carry, x):
+        req, pin, forced = x
+        it = iter(carry)
+        free = next(it)
+        m_fit = next(it)
+        fscore = next(it)
+        m_all = next(it)
+        terms = tuple(next(it) for _ in terms0)
+        scal = tuple(next(it) for _ in scal0)
+        counts = [next(it) for _ in count0]
+        feasible = scal[0]
+        if tab:
+            ci = iter(counts)
+            placecnt = next(ci) if k2_keys else None
+            cnttab = next(ci) if k1_keys else None
+            trows = tab_rows(cnttab) if k1_keys else []
+            raws = [materialize(v, placecnt, trows) for v in range(n_raws)]
+        else:
+            raws = counts
+        ti = iter(terms)
+        score = fscore
+        score = score + (w_[2] + w_[3]) * next(ti)
+        if f.node_pref:
+            score += w_[4] * next(ti)
+        if f.taint_pref:
+            score += w_[5] * next(ti)
+        if has_ip:
+            if pref:
+                score += w_[6] * maxabs_normalize(raws[-1], m_all)
+            else:
+                score += w_[6] * next(ti)
+        if has_soft:
+            score += w_[7] * spread_score_from_raw(raws[soft_slot], m_all)
+        if has_ss:
+            score += w_[8] * selector_spread_compose(
+                raws[0], raws[1], scal[1], scal[2], any_zone
+            )
+        if f.static_score:
+            score += w_[9] * sscore_g + w_[11] * avoid_g
+        score = jnp.where(m_all, score, -jnp.inf)
+
+        chosen = jnp.where(forced, pin, jnp.argmax(score).astype(jnp.int32))
+        placed = jnp.where(
+            forced, (pin >= 0) & statics.node_valid[jnp.clip(pin, 0)], feasible
+        )
+        # the lean spread stage is m_bind (no skew terms) and must NOT
+        # fold in ip_mask: a pod emptied by existing pods' required
+        # anti-affinity reports FAIL_INTERPOD (the cascade default), not
+        # FAIL_SPREAD — exactly like StepEval.fail_code
+        fail = jax.lax.cond(
+            placed | forced,
+            lambda _: jnp.int32(OK),
+            lambda _: fail_from(
+                m_ports & m_fit, (m_ports & m_fit) & post_res
+            ),
+            None,
+        )
+        reason = jnp.where(
+            placed, OK, jnp.where(forced, FAIL_NO_NODE, fail)
+        ).astype(jnp.int32)
+
+        safe = jnp.clip(chosen, 0)
+        w = jnp.where(placed, 1.0, 0.0)
+        free = free.at[safe].add(-req * w)
+        m_fit, fscore, prev_fit, fit_row = free_rows_update(
+            free, m_fit, fscore, safe, req, placed
+        )
+        if tab:
+            if k2_keys:
+                placecnt = placecnt.at[safe].add(w)
+            if k1_keys:
+                for j in range(len(k1_keys)):
+                    d = dsmall[j][safe]
+                    cnttab = cnttab.at[j, jnp.clip(d, 0)].add(
+                        jnp.where((d >= 0) & placed, 1.0, 0.0)
+                    )
+            new_counts = ((placecnt,) if k2_keys else ()) + (
+                (cnttab,) if k1_keys else ()
+            )
+        elif n_raws:
+            # same-domain indicator per topology key for the chosen node;
+            # every carried raw advances by its per-key coefficient dot
+            dom_ch = node_dom[:, safe]  # [K]
+            keyinc = (
+                key_valid
+                & (node_dom == dom_ch[:, None])
+                & ((dom_ch >= 0) & placed)[:, None]
+            )
+            deltas = jnp.matmul(
+                coef_mat, jnp.where(keyinc, 1.0, 0.0), precision=hp
+            )  # [V, N]
+            new_counts = tuple(r + deltas[v] for v, r in enumerate(raws))
+        else:
+            new_counts = ()
+        m_all = m_all.at[safe].set(
+            jnp.where(placed, m_nofit[safe] & fit_row, m_all[safe])
+        )
+        # between flips the chosen node stays feasible, so the masked
+        # maxima advance through it alone (max is order-free — exact)
+        if has_ss:
+            if tab:
+                ch_safe = value_at(
+                    0, safe, placecnt if k2_keys else None, cnttab
+                )
+                cz_safe = value_at(
+                    1, safe, placecnt if k2_keys else None, cnttab
+                )
+            else:
+                ch_safe = new_counts[0][safe]
+                cz_safe = new_counts[1][safe]
+            scal = (
+                scal[0],
+                jnp.where(placed, jnp.maximum(scal[1], ch_safe), scal[1]),
+                jnp.where(placed, jnp.maximum(scal[2], cz_safe), scal[2]),
+            )
+        # refresh the mask-dependent carries only when the placement
+        # actually flipped its node's fit row
+        flip = placed & (fit_row != prev_fit)
+
+        def _refresh(args):
+            m_all_, counts_ = args[0], args[3]
+            out = (jnp.any(m_all_),)
+            if has_ss:
+                if tab:
+                    ci_ = iter(counts_)
+                    pc_ = next(ci_) if k2_keys else None
+                    ct_ = next(ci_) if k1_keys else None
+                    tr_ = tab_rows(ct_) if k1_keys else []
+                    ch_ = materialize(0, pc_, tr_)
+                    cz_ = materialize(1, pc_, tr_)
+                else:
+                    ch_, cz_ = counts_[0], counts_[1]
+                out += (
+                    jnp.max(jnp.where(m_all_, ch_, 0.0)),
+                    jnp.max(jnp.where(m_all_, cz_, 0.0)),
+                )
+            return _flip_terms(m_all_), out
+
+        terms, scal = jax.lax.cond(
+            flip, _refresh, lambda args: (args[1], args[2]),
+            (m_all, terms, scal, tuple(new_counts)),
+        )
+        out_node = jnp.where(placed, chosen, -1)
+        return (
+            (free, m_fit, fscore, m_all)
+            + tuple(terms) + tuple(scal) + tuple(new_counts),
+            (out_node, reason),
+        )
+
+    carry0 = (
+        (state.free, m_fit0, fscore0, m_all0) + terms0 + scal0 + count0
+    )
+    carry_f, (nodes, reasons) = jax.lax.scan(lstep, carry0, xs)
+    updates = {"free": carry_f[0]}
+
+    # -- post-scan fold of the count planes ------------------------------
+    # Reconstruct each term's domain-count delta from the choice sequence:
+    # a per-key histogram of the chosen nodes' domains, gathered back onto
+    # the node axis.  Counts and preference weights are small integers, so
+    # the reordered sums are bit-identical to the step-by-step bumps the
+    # general scan applies.
+    if t_cap:
+        placed_arr = nodes >= 0
+        safe_arr = jnp.clip(nodes, 0)
+        dom_ch = node_dom[:, safe_arr]  # [K, W]
+        val = jnp.where((dom_ch >= 0) & placed_arr[None, :], 1.0, 0.0)
+        kidx = jnp.arange(key_n)[:, None]
+        dtab = jnp.zeros((key_n, max(n_domains, 1)), jnp.float32)
+        dtab = dtab.at[kidx, jnp.clip(dom_ch, 0)].add(val)
+        keysum = jnp.take_along_axis(dtab, jnp.clip(node_dom, 0), axis=1)
+        keysum = jnp.where(key_valid, keysum, 0.0)  # [K, N]
+        totals = val.sum(axis=1)  # [K] placed pods with a valid domain
+        delta_t = jnp.where(
+            tvalid[:, None], keysum[jnp.clip(term_keys, 0)], 0.0
+        )  # [Tc, N]
+        tot_t = jnp.where(tvalid, totals[jnp.clip(term_keys, 0)], 0.0)
+        updates["cnt_match"] = add_rows(
+            state.cnt_match, terms_g, s_match_f[:, None] * delta_t
+        )
+        updates["cnt_total"] = state.cnt_total.at[tsafe].add(s_match_f * tot_t)
+        # the run owns no required terms (lean), so only the preferred-
+        # weight owner planes can change
+        if f.interpod_pref:
+            updates["w_own_aff_pref"] = add_rows(
+                state.w_own_aff_pref, ip_eff, w_aff_g[:, None] * delta_t
+            )
+            updates["w_own_anti_pref"] = add_rows(
+                state.w_own_anti_pref, ip_eff, w_anti_g[:, None] * delta_t
+            )
+    return state._replace(**updates), nodes, reasons
+
+
+@partial(jax.jit, static_argnums=(3, 4, 5, 6, 7), donate_argnums=(1,))
+def _run_wavefront(
+    statics: StaticArrays,
+    state: SchedState,
+    pods,
+    flags: StepFlags = StepFlags(),
+    hard: bool = False,
+    pref: bool = False,
+    key_kinds=None,
+    n_domains: int = 1,
+):
+    count_trace("wave")
+    return wavefront_scan(
+        statics, state, pods, flags, hard, pref, key_kinds, n_domains
+    )
+
+
+def default_wave_call(statics, state, seg, flags, spec):
+    """run_scan_chunked's engine-less wave_call (the bench and tests use
+    it directly): the plain-jit wavefront dispatch."""
+    return _run_wavefront(statics, state, seg, flags, *spec)
+
+
+def wave_static_spec(tensors, hard: bool, pref: bool) -> tuple:
+    """The static specialization tail of one wavefront dispatch:
+    (hard, pref, key_kinds, n_domains).  key_kinds is the per-topology-key
+    reduction kind tuple when every key supports the tabular carry (kinds
+    1/2), else None (generic carried raws)."""
+    kinds = tensors.key_kind
+    key_kinds = None
+    if kinds is not None and kinds.shape[0] and bool((kinds != 0).all()):
+        key_kinds = tuple(int(x) for x in kinds)
+    return hard, pref, key_kinds, max(int(tensors.n_domains), 1)
+
+
+# Batch apply/undo of placement deltas lives in engine/state.py
+# (`apply_placement_deltas`); the module-level alias keeps the historical
+# monkeypatch point (tests) and the preemption call sites stable.
+_apply_log_delta = apply_placement_deltas
 
 
 class Engine:
@@ -1365,6 +2274,11 @@ class Engine:
         #: route through its registry of background-compiled executables
         #: (engine/precompile.py); None = plain jit dispatch
         self.pipeline = None
+        #: speculative wavefront dispatch of same-group lean runs (the
+        #: verify-and-rollback batcher, docs/speculation.md).  Placements
+        #: are bit-identical on or off; SIMTPU_WAVEFRONT=0 flips the
+        #: default for A/B measurement.
+        self.speculate = wave_enabled()
         self.placed_group: List[int] = []
         self.placed_node: List[int] = []
         self.placed_req: List[np.ndarray] = []
@@ -1404,6 +2318,13 @@ class Engine:
         it with their mesh-compiled callables (tail already closed over)."""
         return "scan", _run_scan, (flags,)
 
+    def _aot_wave(self, flags: StepFlags, spec: tuple):
+        """(pipeline key name, jit callable, static tail) for the
+        speculative wavefront executable (`spec` = wave_static_spec) — the
+        `_aot_scan` analog; the sharded engines override it with their
+        mesh-compiled variants."""
+        return "wave", _run_wavefront, (flags,) + spec
+
     @staticmethod
     def _prefetch_pods(tree):
         """Start the (non-blocking) host→device transfer of a prepared pod
@@ -1430,12 +2351,24 @@ class Engine:
             )
         return fn(*args, *tail)
 
+    def _wave_call(self, statics, state, seg, flags, spec):
+        """Dispatch one compiled wavefront — through the precompile
+        pipeline's registry when one is attached, else the plain jit."""
+        name, fn, tail = self._aot_wave(flags, spec)
+        args = (statics, state, seg)
+        if self.pipeline is not None:
+            return self.pipeline.call(
+                name, tail, args, lambda: fn(*args, *tail)
+            )
+        return fn(*args, *tail)
+
     def _dispatch(
         self, statics: StaticArrays, state: SchedState, pods, flags: StepFlags
     ):
         """Run the scan in pow2 chunks with term-row-sliced count planes
-        (run_scan_chunked).  `ShardedEngine` (simtpu/parallel) overrides
-        `_scan_call` to lay the node axis out across a device mesh; the
+        (run_scan_chunked), speculative wavefronts riding eligible runs.
+        `ShardedEngine` (simtpu/parallel) overrides `_scan_call` /
+        `_aot_wave` to lay the node axis out across a device mesh; the
         chunking composes."""
         return run_scan_chunked(
             statics,
@@ -1446,6 +2379,7 @@ class Engine:
             np.asarray(self._current_batch.group),
             scan_call=self._scan_call,
             prefetch=self._prefetch_pods,
+            wave_call=self._wave_call if self.speculate else None,
         )
 
     def place(self, batch: PodBatch):

@@ -9,12 +9,72 @@ state transition (SURVEY.md §2.3).
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..native import scatter_add_rows
+
+# plane height up to which the one-hot matmul forms pay: the matmul touches
+# the WHOLE plane (fine for the rounds engine's ROW_BUDGET-bounded carried
+# planes and the [K, N] domain map), while a tall plane (the serial scan's
+# full [T, N] count state) is cheaper through the classic gather/scatter,
+# which touches only the addressed rows
+_MATMUL_ROWS = 512
+
+
+def take_rows(plane: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
+    """`plane[rows]` for a [K, N] plane and a small [Tc] int row vector.
+    Negative row ids yield ZERO rows, subsuming the
+    `where(valid, plane[clip(rows)], 0)` masking idiom at the call sites.
+
+    For short planes this is a one-hot matmul: dynamic row gathers along
+    the major axis lower to latency-bound kernels on TPU (measured ~4 ms
+    for a 1.6 MB gather at 100k nodes — the single hottest op in a bulk
+    round), while the [Tc, K] @ [K, N] product rides the MXU at memory
+    bandwidth. Precision is pinned to HIGHEST: the TPU's default bf16
+    matmul would round counts/domain ids above 256, while the f32-exact
+    passes keep one-hot selection bit-identical to the gather. Tall planes
+    keep the masked gather (the matmul would read the whole plane)."""
+    if plane.shape[0] <= _MATMUL_ROWS:
+        oh = jax.nn.one_hot(rows, plane.shape[0], dtype=jnp.float32)
+        return jnp.matmul(
+            oh, plane.astype(jnp.float32), precision=jax.lax.Precision.HIGHEST
+        )
+    safe = jnp.clip(rows, 0)
+    return jnp.where(
+        (rows >= 0)[:, None], plane[safe].astype(jnp.float32), 0.0
+    )
+
+
+def take_rows_i32(plane: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
+    """Integer-plane row gather via take_rows; exact for values below 2^24
+    (domain ids). Negative row ids yield 0 — callers that need a -1
+    sentinel for invalid rows must mask separately."""
+    if plane.shape[0] <= _MATMUL_ROWS:
+        return take_rows(plane, rows).astype(jnp.int32)
+    safe = jnp.clip(rows, 0)
+    return jnp.where((rows >= 0)[:, None], plane[safe], 0)
+
+
+def add_rows(plane: jnp.ndarray, rows: jnp.ndarray, delta: jnp.ndarray) -> jnp.ndarray:
+    """`plane.at[rows].add(delta)`: duplicate and negative row ids behave
+    like scatter-add with masked rows. Short planes use the full-plane
+    matmul add (row scatters cost milliseconds each on TPU; the
+    [T, Tc] @ [Tc, N] product plus a full-plane add runs at bandwidth —
+    the rounds engine's carried planes are ROW_BUDGET-bounded, ~100 MB).
+    Tall planes (the serial scan's full count state) keep the row scatter,
+    which touches only the addressed rows."""
+    if plane.shape[0] <= _MATMUL_ROWS:
+        oh = jax.nn.one_hot(rows, plane.shape[0], dtype=delta.dtype)
+        return plane + jnp.matmul(
+            oh.T, delta, precision=jax.lax.Precision.HIGHEST
+        )
+    safe = jnp.clip(rows, 0)
+    return plane.at[safe].add(jnp.where((rows >= 0)[:, None], delta, 0.0))
 
 
 def interpod_term_index(tensors) -> np.ndarray:
@@ -261,3 +321,89 @@ def build_state(
         vols_any=jnp.asarray(vols_any),
         vols_rw=jnp.asarray(vols_rw),
     )
+
+
+# -- batch apply / undo of placement deltas ----------------------------------
+#
+# The functional analog of the scheduler cache's AddPod/RemovePod pair
+# (`internal/cache/cache.go`): one compiled scan folds a batch of signed
+# placement-log entries into the carried state — sign +1 re-places, -1
+# evicts, 0 is a padding no-op — without rebuilding the state from the full
+# log.  Drives incremental preemption (Engine._apply_saved_delta applies an
+# eviction and its undo as the same call with opposite signs) and any other
+# consumer that needs to roll a batch of placements forward or back.
+
+
+def placement_delta_step(statics, state: SchedState, entry):
+    """Apply one placement-log entry to the state with weight w (+1 =
+    re-place, -1 = evict): exactly `schedule_step`'s state-update block,
+    without filters or node choice. Drives incremental preemption — a full
+    build_state from a million-entry log per eviction costs more than the
+    whole preemption."""
+    g, node, w, req, vg_alloc, sdev_take, gpu_vec = entry
+    safe = jnp.clip(node, 0)
+    updates = {"free": state.free.at[safe].add(-req * w)}
+    if state.ports_used.shape[1]:
+        updates["ports_used"] = state.ports_used.at[safe].add(
+            statics.ports_req[g] * w
+        )
+    if state.vols_any.shape[1]:
+        v_rw = statics.vol_rw_req[g]
+        v_present = v_rw | statics.vol_ro_req[g] | statics.vol_att_req[g]
+        updates["vols_any"] = state.vols_any.at[safe].add(v_present * w)
+        updates["vols_rw"] = state.vols_rw.at[safe].add(v_rw * w)
+    if state.vg_free.shape[1]:
+        updates["vg_free"] = state.vg_free.at[safe].add(-vg_alloc * w)
+    if state.sdev_free.shape[1]:
+        # boolean devices: w>0 consumes (clear), w<0 releases (set)
+        row = state.sdev_free[safe]
+        row = jnp.where(w > 0, row & ~sdev_take, row | sdev_take)
+        updates["sdev_free"] = state.sdev_free.at[safe].set(row)
+    if state.gpu_free.shape[1]:
+        updates["gpu_free"] = state.gpu_free.at[safe].add(-gpu_vec * w)
+    t_cap = statics.g_terms.shape[1]
+    if t_cap:
+        terms_g = statics.g_terms[g]
+        tvalid = terms_g >= 0
+        tsafe = jnp.clip(terms_g, 0)
+        dom_sub = take_rows_i32(
+            statics.node_dom, jnp.where(tvalid, statics.term_topo[tsafe], -1)
+        )
+        valid_sub = (dom_sub >= 0) & tvalid[:, None]
+        dom_chosen = dom_sub[:, safe]
+        valid_chosen = (dom_chosen >= 0) & tvalid
+        same = valid_sub & (dom_sub == dom_chosen[:, None]) & valid_chosen[:, None]
+        inc = jnp.where(same, w, 0.0)
+
+        updates["cnt_match"] = add_rows(
+            state.cnt_match, terms_g, statics.s_match[g][:, None] * inc
+        )
+        updates["cnt_total"] = state.cnt_total.at[tsafe].add(
+            statics.s_match[g] * jnp.where(valid_chosen, w, 0.0)
+        )
+        ip_eff = jnp.where(tvalid, statics.ip_of[tsafe], -1)
+
+        def bump_ip(arr, vals):
+            return add_rows(arr, ip_eff, vals[:, None] * inc)
+
+        updates["cnt_own_anti"] = bump_ip(
+            state.cnt_own_anti, statics.a_anti_req[g].astype(jnp.float32)
+        )
+        updates["cnt_own_aff"] = bump_ip(
+            state.cnt_own_aff, statics.a_aff_req[g].astype(jnp.float32)
+        )
+        updates["w_own_aff_pref"] = bump_ip(state.w_own_aff_pref, statics.w_aff_pref[g])
+        updates["w_own_anti_pref"] = bump_ip(
+            state.w_own_anti_pref, statics.w_anti_pref[g]
+        )
+    return state._replace(**updates), ()
+
+
+@partial(jax.jit, donate_argnums=(1,))
+def apply_placement_deltas(statics, state: SchedState, entries):
+    """Scan `placement_delta_step` over padded entry arrays (w = 0 rows are
+    no-ops).  Entries with w = -1 undo what the same entries with w = +1
+    applied — the batch-apply/undo pair behind preemption's eviction and
+    restore paths."""
+    state, _ = jax.lax.scan(partial(placement_delta_step, statics), state, entries)
+    return state

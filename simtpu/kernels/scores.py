@@ -92,6 +92,19 @@ def taint_toleration_score(intolerable_cnt: jnp.ndarray, mask: jnp.ndarray) -> j
     )
 
 
+def spread_score_from_raw(raw: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """The inverse-min-max of `topology_spread_score` applied to an already
+    summed [N] raw count vector — the single formula source shared by the
+    [T, N] kernel and the wavefront verifier's incrementally carried raw."""
+    big = jnp.float32(3.4e38)
+    lo = jnp.min(jnp.where(mask, raw, big))
+    hi = jnp.max(jnp.where(mask, raw, -big))
+    rng = hi - lo
+    return jnp.where(
+        rng > 0, MAX_NODE_SCORE * (hi - raw) / jnp.maximum(rng, 1e-30), MAX_NODE_SCORE
+    )
+
+
 def topology_spread_score(
     cnt_at: jnp.ndarray,  # [T, N] matching placed pods at each node's domain
     soft_w: jnp.ndarray,  # [T] ScheduleAnyway constraint multiplicity
@@ -101,13 +114,51 @@ def topology_spread_score(
     registry weight 2 applied by the caller): lower matching count in the
     node's domains → higher score, inverse-min-max to [0, 100]; nodes missing
     a topology key count 0 for that constraint."""
-    raw = soft_w @ cnt_at
-    big = jnp.float32(3.4e38)
-    lo = jnp.min(jnp.where(mask, raw, big))
-    hi = jnp.max(jnp.where(mask, raw, -big))
-    rng = hi - lo
+    return spread_score_from_raw(soft_w @ cnt_at, mask)
+
+
+def selector_spread_compose(
+    cnt_host: jnp.ndarray,  # [N] matching placed pods on each node
+    cnt_zone: jnp.ndarray,  # [N] matching placed pods in each node's zone
+    max_host,  # scalar — max of cnt_host over feasible nodes (0-floored)
+    max_zone,  # scalar — max of cnt_zone over feasible nodes (0-floored)
+    any_zone_terms,  # bool scalar — the pod has zone-key counting terms
+) -> jnp.ndarray:
+    """`selector_spread_score`'s normalization with the masked maxima
+    precomputed — the wavefront verifier carries them as incrementally
+    maintained scalars (max is order-free, so the carried value is
+    bit-identical to the reduction)."""
+    node_score = jnp.where(
+        max_host > 0,
+        MAX_NODE_SCORE * (max_host - cnt_host) / jnp.maximum(max_host, 1e-30),
+        MAX_NODE_SCORE,
+    )
+    zone_score = jnp.where(
+        max_zone > 0,
+        MAX_NODE_SCORE * (max_zone - cnt_zone) / jnp.maximum(max_zone, 1e-30),
+        MAX_NODE_SCORE,
+    )
+    have_zones = any_zone_terms & (max_zone > 0)
+    zw = jnp.float32(2.0 / 3.0)
     return jnp.where(
-        rng > 0, MAX_NODE_SCORE * (hi - raw) / jnp.maximum(rng, 1e-30), MAX_NODE_SCORE
+        have_zones, (1.0 - zw) * node_score + zw * zone_score, node_score
+    )
+
+
+def selector_spread_from_counts(
+    cnt_host: jnp.ndarray,  # [N] matching placed pods on each node
+    cnt_zone: jnp.ndarray,  # [N] matching placed pods in each node's zone
+    any_zone_terms,  # bool scalar — the pod has zone-key counting terms
+    mask: jnp.ndarray,  # [N]
+) -> jnp.ndarray:
+    """`selector_spread_score`'s normalization on already summed host/zone
+    count vectors (shared with the wavefront verifier's carried raws)."""
+    return selector_spread_compose(
+        cnt_host,
+        cnt_zone,
+        jnp.max(jnp.where(mask, cnt_host, 0.0)),
+        jnp.max(jnp.where(mask, cnt_zone, 0.0)),
+        any_zone_terms,
     )
 
 
@@ -120,24 +171,11 @@ def selector_spread_score(
     """SelectorSpread score (`plugins/selectorspread/selector_spread.go`):
     spread pods of the same service/controller across nodes, then zones with
     zoneWeighting=2/3 when zones exist."""
-    cnt_host = ss_host.astype(jnp.float32) @ cnt_at
-    cnt_zone = ss_zone.astype(jnp.float32) @ cnt_at
-    max_host = jnp.max(jnp.where(mask, cnt_host, 0.0))
-    max_zone = jnp.max(jnp.where(mask, cnt_zone, 0.0))
-    node_score = jnp.where(
-        max_host > 0,
-        MAX_NODE_SCORE * (max_host - cnt_host) / jnp.maximum(max_host, 1e-30),
-        MAX_NODE_SCORE,
-    )
-    zone_score = jnp.where(
-        max_zone > 0,
-        MAX_NODE_SCORE * (max_zone - cnt_zone) / jnp.maximum(max_zone, 1e-30),
-        MAX_NODE_SCORE,
-    )
-    have_zones = jnp.any(ss_zone) & (max_zone > 0)
-    zw = jnp.float32(2.0 / 3.0)
-    return jnp.where(
-        have_zones, (1.0 - zw) * node_score + zw * zone_score, node_score
+    return selector_spread_from_counts(
+        ss_host.astype(jnp.float32) @ cnt_at,
+        ss_zone.astype(jnp.float32) @ cnt_at,
+        jnp.any(ss_zone),
+        mask,
     )
 
 

@@ -30,13 +30,13 @@ def lvm_plan(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Returns (fits [N], alloc [N, V]) — the pod's LVM allocation per node."""
     n, v = vg_free.shape
-    l = sizes.shape[0]
+    n_claims = sizes.shape[0]
     exists = vg_name_id >= 0
     has_any_vg = jnp.any(exists, axis=1)
     fits = jnp.ones(n, bool)
     alloc = jnp.zeros_like(vg_free)
     free = vg_free
-    for i in range(l):
+    for i in range(n_claims):
         size, vid = sizes[i], vg_ids[i]
         active = size > 0
         named = vid >= 0

@@ -33,6 +33,7 @@ from ..engine.scan import (
     StepFlags,
     count_trace,
     schedule_step,
+    wavefront_scan,
 )
 from .mesh import NODE_AXIS, node_shard_count
 
@@ -215,6 +216,29 @@ def build_sharded_scan(mesh: Mesh, flags: StepFlags = StepFlags()):
     )
 
 
+def build_sharded_wavefront(mesh: Mesh, flags: StepFlags, spec: tuple):
+    """Compile the speculative wavefront call (scan.wavefront_scan — the
+    verify-and-rollback batcher for same-group lean runs) with the node
+    axis laid out over `mesh`.  `spec` is scan.wave_static_spec's
+    (hard, pref, key_kinds, n_domains) specialization tail.  Placements
+    stay bit-identical to the unsharded wavefront (dead-node padding is
+    unselectable and the reduced carries shard with the node axis)."""
+    st_spec = statics_sharding(mesh)
+    state_spec = state_sharding(mesh)
+    rep = NamedSharding(mesh, P())
+
+    def fn(statics, state, pods):
+        count_trace("wave")
+        return wavefront_scan(statics, state, pods, flags, *spec)
+
+    return jax.jit(
+        fn,
+        in_shardings=(st_spec, state_spec, None),
+        out_shardings=(state_spec, (rep, rep, rep, rep, rep), rep),
+        donate_argnums=(1,),
+    )
+
+
 class _MeshMixin:
     """Shared mesh plumbing for the sharded engines: input padding/layout and
     the mesh-wide compiled-scan cache."""
@@ -241,6 +265,13 @@ class _MeshMixin:
         # flags are baked into the mesh-compiled callable; the pipeline key
         # carries them through the name (the mesh itself is engine-fixed)
         return ("sharded_scan", flags), self._sharded_scan_for(flags), ()
+
+    def _aot_wave(self, flags: StepFlags, spec: tuple):
+        fn = _cached_jit(
+            ("wave", self.mesh, flags, spec),
+            lambda: build_sharded_wavefront(self.mesh, flags, spec),
+        )
+        return ("sharded_wave", flags, spec), fn, ()
 
     @staticmethod
     def _prefetch_pods(tree):

@@ -19,7 +19,7 @@ only ever creates DS pods for nodes present in that iteration,
 from __future__ import annotations
 
 from functools import partial
-from typing import List, Optional, Sequence
+from typing import List, Sequence
 
 import jax
 import jax.numpy as jnp

@@ -12,6 +12,18 @@ scratch each time (`apply.go:183`, `MaxNumNewNode=100`). Feasibility is
 monotone in the clone count (clones only add capacity), so the default here is
 a doubling probe + binary search — O(log N) full simulations instead of O(N) —
 with `search="linear"` available for reference-exact behavior.
+
+Non-monotone caveat (pinned by tests/test_plan.py): SCHEDULABILITY is
+monotone, but the MaxCPU/MaxMemory/MaxVG occupancy-cap verdict need not be —
+with DaemonSet overhead, every clone adds `u` usage against `A` capacity, so
+the average rate tends toward u/A and RISES with the clone count whenever it
+starts below that ratio.  A feasible window like {k0..k1} can then be jumped
+over by the doubling probe, where the reference's linear walk would land
+inside it.  The binary search therefore falls back LOUDLY to the
+reference-exact linear scan the moment any probe is rejected by the caps
+alone (everything scheduled, rate over the cap); probes already known
+unschedulable are skipped in the fallback (schedulability stays monotone).
+With the caps at their default 100 the fallback can never trigger.
 """
 
 from __future__ import annotations
@@ -22,7 +34,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from .. import constants as C
 from ..api import simulate
-from ..config import AppInfo, SimonConfig, validate_config
+from ..config import SimonConfig, validate_config
 from ..core.match import node_should_run_pod
 from ..core.objects import (
     AppResource,
@@ -248,18 +260,42 @@ def plan_capacity(
                 )
         return None
 
+    cap_rejected = False  # a probe scheduled everything but missed a cap
+
     def feasible(result: SimulateResult) -> Tuple[bool, str]:
         """Candidate acceptance = everything scheduled AND occupancy caps
-        hold. The reference treats a cap miss like infeasibility: it prints
-        the reason and keeps adding nodes (`apply.go:199-207`) — more
-        capacity lowers the average rate, so this is monotone in the clone
-        count just like schedulability."""
+        hold. The reference treats a cap miss like infeasibility
+        (`apply.go:199-207`); schedulability is monotone in the clone
+        count, but the cap verdict need NOT be (DaemonSet overhead — see
+        the module docstring), so a cap rejection is flagged and aborts
+        the O(log N) search in favor of the reference's linear walk."""
+        nonlocal cap_rejected
         if result.unscheduled_pods:
             return False, ""
         ok, reason = satisfy_resource_setting(result)
         if not ok:
+            cap_rejected = True
             say(reason.rstrip("\n"))
         return ok, reason
+
+    def linear_from(start: int, last_result: SimulateResult) -> PlanResult:
+        """The reference-exact linear walk over [start, max_new_nodes);
+        candidates already probed and found UNSCHEDULABLE are skipped
+        (schedulability is monotone — more clones cannot unschedule
+        them... fewer cannot schedule them), cap-rejected ones re-run."""
+        result = last_result
+        for i in range(start, max_new_nodes):
+            if i in probes and probes[i] > 0:
+                continue  # known unschedulable
+            result = run(i)
+            ok, _ = feasible(result)
+            if ok:
+                return PlanResult(True, i, result, "Success!", probes)
+            if result.unscheduled_pods:
+                msg = diagnose(result)
+                if msg:
+                    return PlanResult(False, i, result, msg, probes)
+        return PlanResult(False, max_new_nodes, result, fail_msg, probes)
 
     fail_msg = f"we have added {max_new_nodes} nodes but it still failed!!"
     result = run(0)
@@ -274,16 +310,24 @@ def plan_capacity(
     # the reference's loop is `for i := 0; i < MaxNumNewNode; i++`
     # (apply.go:183) — the largest candidate ever tried is max_new_nodes-1
     if search == "linear":
-        for i in range(1, max_new_nodes):
-            result = run(i)
-            ok, _ = feasible(result)
-            if ok:
-                return PlanResult(True, i, result, "Success!", probes)
-            if result.unscheduled_pods:
-                msg = diagnose(result)
-                if msg:
-                    return PlanResult(False, i, result, msg, probes)
-        return PlanResult(False, max_new_nodes, result, fail_msg, probes)
+        return linear_from(1, result)
+
+    def cap_fallback() -> PlanResult:
+        """A cap rejection makes feasibility potentially non-monotone —
+        bisection could skip the window the reference's walk would find.
+        Fall back loudly to the linear scan (pinned by
+        tests/test_plan.py's DaemonSet-overhead adversary)."""
+        import sys
+
+        msg = (
+            "simtpu: an occupancy cap rejected a fully-scheduled candidate; "
+            "cap feasibility can be non-monotone in the clone count "
+            "(DaemonSet overhead) — falling back to the reference's linear "
+            "scan"
+        )
+        print(msg, file=sys.stderr)
+        say(msg)
+        return linear_from(1, result)
 
     # doubling probe then binary search (feasibility monotone in clone count)
     hi, hi_result = None, None
@@ -291,6 +335,8 @@ def plan_capacity(
     while probe < max_new_nodes:
         result = run(probe)
         ok, _ = feasible(result)
+        if cap_rejected:
+            return cap_fallback()
         if ok:
             hi, hi_result = probe, result
             break
@@ -305,6 +351,8 @@ def plan_capacity(
             return PlanResult(False, max_new_nodes, result, fail_msg, probes)
         result = run(probe)
         ok, _ = feasible(result)
+        if cap_rejected:
+            return cap_fallback()
         if not ok:
             return PlanResult(False, max_new_nodes, result, fail_msg, probes)
         hi, hi_result = probe, result
@@ -313,6 +361,8 @@ def plan_capacity(
         mid = (lo + hi) // 2
         result = run(mid)
         ok, _ = feasible(result)
+        if cap_rejected:
+            return cap_fallback()
         if ok:
             hi, hi_result = mid, result
         else:
@@ -526,7 +576,10 @@ class Applier:
         ctx = contextlib.nullcontext()
         if trace_dir:
             ctx = jax.profiler.trace(trace_dir)
+        from ..engine.scan import wave_counts, wave_enabled
+
         search, bulk, mesh = _resolve_engines(self.opts, cluster, apps)
+        waves_before = wave_counts()
         # auto-ON for apply on accelerator backends: the one-shot CLI user
         # always pays the cold path, which is exactly what the background
         # AOT pipeline attacks.  CPU backends stay off under auto (the
@@ -580,5 +633,13 @@ class Applier:
             "auto_search": self.opts.search is None,
             "auto_bulk": self.opts.bulk is None,
             "reference_exact": search == "linear" and not bulk,
+            # the speculative wavefront dispatcher's telemetry over this
+            # plan's serial-engine dispatches (docs/speculation.md):
+            # placements are bit-identical with it on or off, so this is
+            # pure observability — acceptance rate and rollback volume
+            "speculate": wave_enabled(),
+            "wavefront": {
+                k: wave_counts()[k] - waves_before[k] for k in waves_before
+            },
         }
         return plan

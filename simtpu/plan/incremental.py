@@ -55,6 +55,14 @@ Two cross-candidate performance levers ride on top (the ISSUE-1 tentpole):
   across processes, which is what lets the persistent compilation cache
   (`simtpu/cache.py`) collapse the cold path on accelerator backends.
   `PlanResult.compiles` records the per-phase jit-trace counts.
+
+Serial-engine dispatches inside the plan (the rounds engines' serial
+fallback segments — tiny runs, matrix leftovers) additionally ride the
+speculative wavefront dispatcher (engine/scan.py, docs/speculation.md):
+eligible same-group lean runs place through the batched
+verify-and-rollback executable instead of the pod-at-a-time scan, with
+bit-identical placements.  `speculate=` (None = the SIMTPU_WAVEFRONT
+default) forces it per plan for A/B measurement.
 """
 
 from __future__ import annotations
@@ -197,6 +205,7 @@ def plan_capacity_incremental(
     mesh=None,
     precompile: bool = False,
     pipeline=None,
+    speculate=None,
 ) -> PlanResult:
     """Minimum clone count of `new_node` deploying everything, via the
     incremental probe strategy described in the module docstring.
@@ -239,7 +248,7 @@ def plan_capacity_incremental(
         return _plan_capacity_incremental(
             cluster, apps, new_node, max_new_nodes, extended_resources,
             progress, sched_config, corrected_ds_overhead, verify,
-            materialize, mesh, pipeline,
+            materialize, mesh, pipeline, speculate,
         )
     finally:
         if own_pipeline is not None:
@@ -259,6 +268,7 @@ def _plan_capacity_incremental(
     materialize: bool,
     mesh,
     pipeline,
+    speculate,
 ) -> PlanResult:
     from ..engine.scan import statics_from, trace_counts
     from ..parallel.sweep import assemble_planning_problem
@@ -320,6 +330,8 @@ def _plan_capacity_incremental(
         eng.sched_config = sched_config
         eng.bulk_shapes = shape_registry
         eng.snap_shapes = True
+        if speculate is not None:
+            eng.speculate = bool(speculate)
         if pipeline is not None and plan_batch is not None:
             from ..engine.precompile import precompile_place
 

@@ -9,7 +9,7 @@ for tablewriter.
 from __future__ import annotations
 
 import json
-from typing import List, Optional, Sequence
+from typing import List, Sequence
 
 from . import constants as C
 from .core.objects import annotations_of, labels_of, name_of, namespace_of, pod_requests

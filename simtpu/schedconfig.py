@@ -15,7 +15,6 @@ appends to them).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict
 
 import numpy as np
 import yaml

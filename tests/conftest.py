@@ -8,6 +8,14 @@ Must run before jax is imported anywhere.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell presets axon/tpu
+# Speculative wavefront dispatch is OFF for the general suite: placements
+# are bit-identical either way (that IS the pinned contract), but every
+# tiny test problem would otherwise compile its own wavefront executables
+# on top of the scan/round bodies — a suite-wide compile tax that pushed
+# the fast tier against its wall-clock budget.  tests/test_wavefront.py
+# (and anything else that wants the dispatcher) sets Engine.speculate
+# explicitly, which overrides this default.
+os.environ.setdefault("SIMTPU_WAVEFRONT", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -10,7 +10,7 @@ from simtpu.core.match import (
     pod_tolerates_node_taints,
     toleration_tolerates_taint,
 )
-from simtpu.core.objects import ResourceTypes, pod_requests
+from simtpu.core.objects import pod_requests
 from simtpu.core.quantity import format_quantity, parse_quantity
 from simtpu.io.cluster import create_cluster_resource_from_cluster_config
 from simtpu.io.yaml_loader import load_resources
@@ -148,7 +148,7 @@ class TestMatch:
     def test_affinity_exists_and_doesnotexist(self):
         master = _node("m", {"node-role.kubernetes.io/master": ""})
         worker = _node("w", {"node-role.kubernetes.io/worker": ""})
-        req = lambda op: {
+        def req(op): return {
             "requiredDuringSchedulingIgnoredDuringExecution": {
                 "nodeSelectorTerms": [
                     {

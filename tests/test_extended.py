@@ -7,7 +7,6 @@ kernel-level unit checks of the vendored algorithms' semantics."""
 import json
 import os
 
-import numpy as np
 import pytest
 
 import simtpu.constants as C

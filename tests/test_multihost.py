@@ -95,8 +95,8 @@ def _run_two_process(tmp_path, engine: str):
         stdout, _ = p.communicate(timeout=600)
         logs.append(stdout)
     if any(p.returncode != 0 for p in procs) and any(
-        "Multiprocess computations aren't implemented on the CPU backend" in l
-        for l in logs
+        "Multiprocess computations aren't implemented on the CPU backend" in log
+        for log in logs
     ):
         # environment capability, not a product bug: this jax build's CPU
         # backend cannot run cross-process collectives at all (the

@@ -107,6 +107,49 @@ class TestShardedRoundsEngine:
 
 
 class TestShardedMatrixRounds:
+    def test_matrix_mix_identical_under_gspmd_small(self):
+        """Fast-tier sibling of the slow matrix test: the same round
+        variants (multi-GPU, multi-claim LVM, preset gpu-index, required
+        colocate-with-self) under GSPMD at a tiny shape, so CI exercises
+        every variant on every run (ISSUE 3 satellite)."""
+        from simtpu.parallel import ShardedRoundsEngine
+        from simtpu.synth import make_deployment
+
+        cluster = synth_cluster(
+            10, seed=51, zones=2, taint_frac=0.1, gpu_frac=0.6, storage_frac=0.5
+        )
+        apps = synth_apps(
+            30,
+            seed=52,
+            zones=2,
+            pods_per_deployment=10,
+            selector_frac=0.2,
+            anti_affinity_frac=0.2,
+            gpu_frac=0.4,
+            gpu_multi_frac=0.6,
+            storage_frac=0.4,
+            lvm_multi_frac=0.6,
+            affinity_frac=0.3,
+        )
+        preset = ResourceTypes()
+        preset.deployments = [
+            make_deployment("preset", 4, 250, 256, gpu_mem_mib=4096, gpu_index="0-1")
+        ]
+        apps = list(apps) + [AppResource(name="preset", resource=preset)]
+        ext = ("open-local", "gpu")
+        seed_name_hashes(0)
+        base = simulate(cluster, apps, bulk=True, extended_resources=ext)
+        mesh = make_mesh(sweep=1)
+        seed_name_hashes(0)
+        sharded = simulate(
+            cluster,
+            apps,
+            extended_resources=ext,
+            engine_factory=lambda t: ShardedRoundsEngine(t, mesh),
+        )
+        assert _placements(base) == _placements(sharded)
+        assert len(base.unscheduled_pods) == len(sharded.unscheduled_pods)
+
     @pytest.mark.slow
     def test_matrix_mix_identical_under_gspmd(self):
         """Round-4 MATRIX / self-affinity round variants under GSPMD
@@ -302,6 +345,42 @@ class TestGraftEntry:
 
 
 class TestShardedChunkedRounds:
+    def test_chunked_rows_identical_under_gspmd_small(self):
+        """Fast-tier sibling of the slow chunked-rows test: the
+        ROW_BUDGET row-carry path under GSPMD at a tiny shape (ISSUE 3
+        satellite)."""
+        from simtpu.engine.rounds import RoundsEngine
+        from simtpu.parallel import ShardedRoundsEngine
+
+        cluster = synth_cluster(12, seed=41, zones=2, taint_frac=0.1)
+        apps = synth_apps(
+            36,
+            seed=42,
+            zones=2,
+            pods_per_deployment=9,
+            selector_frac=0.2,
+            anti_affinity_frac=0.3,
+            spread_frac=0.4,
+        )
+
+        class ChunkedBase(RoundsEngine):
+            ROW_BUDGET = 4
+
+        seed_name_hashes(3)
+        base = simulate(cluster, apps, engine_factory=ChunkedBase)
+
+        mesh = make_mesh(sweep=1)
+
+        class Chunked(ShardedRoundsEngine):
+            ROW_BUDGET = 4
+
+        seed_name_hashes(3)
+        sharded = simulate(
+            cluster, apps, engine_factory=lambda t: Chunked(t, mesh)
+        )
+        assert _placements(base) == _placements(sharded)
+        assert len(base.unscheduled_pods) == len(sharded.unscheduled_pods)
+
     @pytest.mark.slow
     def test_chunked_rows_identical_under_gspmd(self):
         """The chunked row-carry path (ROW_BUDGET) must also be placement-

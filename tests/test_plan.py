@@ -10,7 +10,6 @@ from simtpu.plan.capacity import (
     meet_resource_requests,
     new_fake_nodes,
     plan_capacity,
-    satisfy_resource_setting,
 )
 from simtpu.workloads.expand import seed_name_hashes
 
@@ -203,7 +202,6 @@ class TestIncrementalPlanner:
 
     @pytest.mark.parametrize("seed", [5, 21, 34])
     def test_matches_serial_planner(self, seed):
-        import numpy as np
 
         from simtpu.plan.incremental import plan_capacity_incremental
         from simtpu.synth import make_node, synth_apps
@@ -472,3 +470,93 @@ class TestPlannerPreemptionDivergence:
         # the documented band: incremental >= serial, by exactly the
         # capacity the victims would have freed
         assert inc.nodes_added >= serial.nodes_added
+
+
+class TestBinarySearchCapNonMonotone:
+    """ISSUE 3 satellite: with DaemonSet overhead, the occupancy-cap
+    verdict is NOT monotone in the clone count — every clone adds DS usage
+    `u` against capacity `A`, so the average rate climbs toward u/A and a
+    narrow feasible window can sit between "too few clones to schedule"
+    and "too many clones for the cap".  The doubling probe jumps straight
+    over such a window; the pinned behavior is a LOUD fallback to the
+    reference's linear scan the moment a cap rejection is seen (module
+    docstring of plan/capacity.py documents the choice)."""
+
+    def _scenario(self):
+        from .fixtures import (
+            make_fake_daemon_set,
+            with_template_node_selector,
+        )
+
+        cluster = ResourceTypes()
+        # ample base capacity with zero usage keeps the initial rate low,
+        # so the per-clone DS share (6/10) RAISES the average as clones
+        # are added — the non-monotone direction
+        cluster.nodes = [
+            make_fake_node(f"base-{i}", "10", "100Gi") for i in range(10)
+        ]
+        # the DaemonSet and the workload both target the template pool
+        # only (the base nodes exist purely as cap denominator)
+        cluster.daemon_sets = [
+            make_fake_daemon_set(
+                "heavy-agent", "kube-system", "6", "1Gi",
+                with_template_node_selector({"pool": "fresh"}),
+            )
+        ]
+        res = ResourceTypes()
+        res.deployments = [
+            make_fake_deployment(
+                "web", "default", 6, "2", "1Gi",
+                with_template_node_selector({"pool": "fresh"}),
+            )
+        ]
+        apps = [AppResource(name="web", resource=res)]
+        template = make_fake_node(
+            "tmpl", "10", "100Gi", with_node_labels({"pool": "fresh"})
+        )
+        # clones: 10 cores, 6 to the DS -> 2 workload pods each; k=3
+        # schedules all 6.  cpu rate(k) = (6k + 12) / (100 + 10k):
+        # k=3 -> 23% (inside the cap), k=4 -> 25%, k>=4 rejected by
+        # MaxCPU=24 -- the feasible window is exactly {3}, and the
+        # doubling probe (1, 2, 4, ...) never lands on it
+        return cluster, apps, template
+
+    def test_binary_falls_back_to_linear_answer(self, monkeypatch, capsys):
+        cluster, apps, template = self._scenario()
+        monkeypatch.setenv(C.ENV_MAX_CPU, "24")
+
+        seed_name_hashes(11)
+        linear = plan_capacity(
+            cluster, apps, template, max_new_nodes=10, search="linear"
+        )
+        assert linear.success and linear.nodes_added == 3, linear.message
+
+        seed_name_hashes(11)
+        binary = plan_capacity(
+            cluster, apps, template, max_new_nodes=10, search="binary"
+        )
+        err = capsys.readouterr().err
+        assert binary.success, binary.message
+        assert binary.nodes_added == linear.nodes_added == 3
+        assert "falling back" in err  # the loud part of the contract
+        # the window's upper neighbor really was cap-rejected (scheduled
+        # but infeasible) — the trigger for the fallback
+        assert binary.probes.get(4) == 0
+
+    def test_caps_off_stays_on_bisection(self, monkeypatch, capsys):
+        """Without caps the window degenerates to the monotone case: the
+        bisection must find the same count as linear with no fallback."""
+        cluster, apps, template = self._scenario()
+        monkeypatch.delenv(C.ENV_MAX_CPU, raising=False)
+
+        seed_name_hashes(11)
+        linear = plan_capacity(
+            cluster, apps, template, max_new_nodes=10, search="linear"
+        )
+        seed_name_hashes(11)
+        binary = plan_capacity(
+            cluster, apps, template, max_new_nodes=10, search="binary"
+        )
+        assert "falling back" not in capsys.readouterr().err
+        assert binary.success and linear.success
+        assert binary.nodes_added == linear.nodes_added == 3

@@ -13,9 +13,9 @@ from simtpu.api import simulate
 # wall-clock envelopes only fire on dedicated perf runs (advisor low, round
 # 4): explicit opt-in, anything else keeps them off
 _PERF_ASSERT = os.environ.get("SIMTPU_PERF_ASSERT", "").lower() in ("1", "true", "yes", "on")
-from simtpu.core.objects import ResourceTypes
+from simtpu.core.objects import ResourceTypes  # noqa: E402
 
-from .fixtures import (
+from .fixtures import (  # noqa: E402
     make_fake_node,
     make_fake_pod,
     with_node_labels,

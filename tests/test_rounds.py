@@ -10,7 +10,7 @@ import numpy as np
 
 import simtpu.constants as C
 from simtpu.api import simulate
-from simtpu.core.objects import AppResource, ResourceTypes, set_label
+from simtpu.core.objects import AppResource, ResourceTypes
 from simtpu.engine.rounds import RoundsEngine
 from simtpu.synth import synth_apps, synth_cluster
 
@@ -272,7 +272,6 @@ class TestBatchedLeftoverProbes:
     def test_mid_batch_placement_reverts_and_reprobes(self, monkeypatch):
         import numpy as np
 
-        import simtpu.engine.rounds as rounds_mod
         from simtpu.engine import scan as scan_mod
 
         eng = self._engine()

@@ -5,7 +5,6 @@ into the engine's score-term weight vector and change placement.
 
 from __future__ import annotations
 
-import numpy as np
 import pytest
 
 from simtpu.api import simulate

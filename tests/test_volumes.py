@@ -14,7 +14,6 @@ through the full `simulate()` path.
 
 from __future__ import annotations
 
-import numpy as np
 
 from simtpu.api import simulate
 from simtpu.core.objects import ResourceTypes
