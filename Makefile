@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all install lint test test-all test-perf bench bench-cold clean
+.PHONY: all install lint test test-all test-perf bench bench-cold bench-faults clean
 
 all: test
 
@@ -51,6 +51,15 @@ bench-cold:
 	SIMTPU_BENCH_PODS=20000 SIMTPU_BENCH_SMALL=0 SIMTPU_BENCH_HARD=0 \
 	SIMTPU_BENCH_MATRIX=0 SIMTPU_BENCH_PLAN=0 SIMTPU_BENCH_BIG=0 \
 	$(PY) bench.py
+
+# fault-injection smoke at a small shape (mirrors bench-cold): exhaustive
+# single-node scenario sweep through the batched engine vs the serial
+# drain/requeue replay floor, plus a small N+k plan_resilience search —
+# fault_scenarios_per_s / fault_sweep_speedup land in the JSON line
+bench-faults:
+	SIMTPU_BENCH_FAULTS=1 SIMTPU_BENCH_NODES=2000 SIMTPU_BENCH_PODS=20000 \
+	SIMTPU_BENCH_SMALL=0 SIMTPU_BENCH_HARD=0 SIMTPU_BENCH_MATRIX=0 \
+	SIMTPU_BENCH_PLAN=0 SIMTPU_BENCH_BIG=0 $(PY) bench.py
 
 clean:
 	rm -rf build dist *.egg-info simtpu/native/_build

@@ -47,11 +47,18 @@ dispatcher, engine/scan.py — bit-identical placements), with
 (`wavefront_accept_rate`) and rollback volume
 (`wavefront_rollbacks`/`wavefront_rollback_pods`) alongside.
 
+The fault-injection point (ISSUE 4, simtpu/faults) reports
+`fault_scenarios_per_s` (batched sweep), the serial drain/requeue replay
+floor, their ratio `fault_sweep_speedup`, and `plan_resilience` counters
+from a small N+k survivability search.
+
 Env knobs: SIMTPU_BENCH_NODES (default 100000), SIMTPU_BENCH_PODS (default
 1000000), SIMTPU_BENCH_SCAN_PODS (scan-rate slice, default 2000),
 SIMTPU_BENCH_BASELINE_PODS (default 300), SIMTPU_BENCH_SMALL=0 /
 SIMTPU_BENCH_HARD=0 / SIMTPU_BENCH_MATRIX=0 / SIMTPU_BENCH_PLAN=0 /
-SIMTPU_BENCH_BIG=0 to skip the extra points, SIMTPU_BENCH_PRECOMPILE=0/1
+SIMTPU_BENCH_BIG=0 to skip the extra points, SIMTPU_BENCH_FAULTS=1/0 to
+force/skip the fault-injection point (default: north-star runs only;
+`make bench-faults` = the small-shape smoke), SIMTPU_BENCH_PRECOMPILE=0/1
 to force the background AOT precompile pipeline off/on (unset = auto: on
 for accelerator backends; `make bench-cold` runs a small-shape cold-start
 smoke with the persistent cache off).
@@ -333,6 +340,98 @@ def big_point() -> dict:
     }
 
 
+def fault_point() -> dict:
+    """Fault-injection sweep point (ISSUE 4 acceptance): an exhaustive
+    single-node failure sweep at >= 1k nodes through the batched scenario
+    engine (simtpu/faults/sweep.py) against the serial drain/requeue/
+    restore replay floor, plus a small N+k `plan_resilience` search.  The
+    batched rate is the steady state (second sweep, first compiles); the
+    serial floor is timed after a one-scenario warmup for the same reason.
+    Env: SIMTPU_BENCH_FAULT_NODES (default 2000), SIMTPU_BENCH_FAULT_PODS
+    (default 20000), SIMTPU_BENCH_FAULT_SERIAL (replayed scenarios for the
+    floor, default 8)."""
+    from simtpu.faults import (
+        place_cluster,
+        serial_replay,
+        single_node_scenarios,
+        sweep_scenarios,
+    )
+    from simtpu.plan.resilience import plan_resilience
+    from simtpu.synth import make_node, synth_apps, synth_cluster
+
+    n_nodes = int(os.environ.get("SIMTPU_BENCH_FAULT_NODES", 2000))
+    n_pods = int(os.environ.get("SIMTPU_BENCH_FAULT_PODS", 20000))
+    serial_n = int(os.environ.get("SIMTPU_BENCH_FAULT_SERIAL", 8))
+    note(f"fault point: {n_nodes} nodes x {n_pods} pods, exhaustive k=1 sweep")
+    cluster = synth_cluster(n_nodes, seed=11, zones=16, taint_frac=0.1)
+    apps = synth_apps(
+        n_pods, seed=12, zones=16, pods_per_deployment=200,
+        selector_frac=0.1, toleration_frac=0.1, anti_affinity_frac=0.2,
+        spread_frac=0.2,
+    )
+    pc = place_cluster(cluster, apps)
+    placed = int((pc.nodes >= 0).sum())
+    scen = single_node_scenarios(pc.n_nodes, nodes=cluster.nodes)
+    sweep_scenarios(pc, scen)  # compile + warm
+    sw = sweep_scenarios(pc, scen)
+    batched_rate = sw.timings["scenarios_per_s"]
+    # serial floor: drain + requeue + restore per scenario; a first pass
+    # over the same scenarios warms the probe-shape executables, then the
+    # timed pass replays all 1 + serial_n of them warm
+    serial_replay(pc, scen, limit=1 + serial_n)
+    t0 = time.perf_counter()
+    serial_counts, _ = serial_replay(pc, scen, limit=1 + serial_n)
+    serial_rate = (1 + serial_n) / max(time.perf_counter() - t0, 1e-9)
+    if not np.array_equal(serial_counts, sw.unplaced[: len(serial_counts)]):
+        note("WARNING: fault sweep diverged from the serial replay")
+    speedup = batched_rate / max(serial_rate, 1e-9)
+    note(
+        f"fault sweep: {len(scen)} scenarios, batched "
+        f"{batched_rate:.0f}/s vs serial {serial_rate:.1f}/s "
+        f"({speedup:.1f}x); survival {sw.survival_rate:.3f}"
+    )
+    out = {
+        "fault_nodes": n_nodes,
+        "fault_scenarios": len(scen),
+        "fault_scenarios_per_s": round(batched_rate, 1),
+        "fault_serial_scenarios_per_s": round(serial_rate, 2),
+        "fault_sweep_speedup": round(speedup, 1),
+        "fault_survival_rate": round(sw.survival_rate, 4),
+    }
+    # a small N+k search riding the same machinery: how many template
+    # clones until every single-node failure is survivable
+    plan_nodes = int(os.environ.get("SIMTPU_BENCH_RESILIENCE_NODES", 400))
+    plan_pods = int(os.environ.get("SIMTPU_BENCH_RESILIENCE_PODS", 6000))
+    p_cluster = synth_cluster(plan_nodes, seed=13, zones=8, taint_frac=0.0)
+    p_apps = synth_apps(
+        plan_pods, seed=14, zones=8, pods_per_deployment=100,
+        selector_frac=0.0, toleration_frac=0.0, anti_affinity_frac=0.1,
+    )
+    template = make_node(
+        "tmpl", 64000, 256,
+        {"kubernetes.io/hostname": "tmpl",
+         "topology.kubernetes.io/zone": "zone-plan"},
+    )
+    t0 = time.perf_counter()
+    plan = plan_resilience(
+        p_cluster, p_apps, template, k=1, max_new_nodes=32, seed=15
+    )
+    plan_s = time.perf_counter() - t0
+    note(
+        f"plan_resilience: nodes_added={plan.nodes_added} "
+        f"success={plan.success} wall={plan_s:.1f}s probes={plan.probes}"
+    )
+    out["plan_resilience_s"] = round(plan_s, 2)
+    out["resilience_nodes_added"] = plan.nodes_added
+    out["resilience_success"] = plan.success
+    if plan.sweep is not None:
+        out["resilience_scenarios_per_s"] = round(
+            plan.sweep.timings.get("scenarios_per_s", 0.0), 1
+        )
+    out["fault_placed"] = placed
+    return out
+
+
 def time_plan():
     """The min-node-add plan at north-star scale: a 100k-node cluster whose
     Open-Local capacity strands ~28k LVM pods of a 1M-pod selector-free mix,
@@ -607,10 +706,22 @@ def main() -> int:
             except Exception as exc:  # noqa: BLE001 - report, keep the line
                 note(f"big point failed: {type(exc).__name__}: {exc}")
                 record["big_point_error"] = f"{type(exc).__name__}: {exc}"
+    # fault-injection point (ISSUE 4): on by default at north-star runs,
+    # SIMTPU_BENCH_FAULTS=1 forces it at any configuration (`make
+    # bench-faults` = the small-shape smoke), =0 skips
+    faults_env = os.environ.get("SIMTPU_BENCH_FAULTS", "")
+    if faults_env != "0" and (north_star or faults_env == "1"):
+        try:
+            record.update(fault_point())
+        except Exception as exc:  # noqa: BLE001 - report, keep the line
+            note(f"fault point failed: {type(exc).__name__}: {exc}")
+            record["fault_error"] = f"{type(exc).__name__}: {exc}"
     print(json.dumps(record))
-    # a failed plan or big-point phase keeps the placement record but
-    # signals the failure through the exit status (drivers record both)
-    return 1 if ("plan_error" in record or "big_point_error" in record) else 0
+    # a failed plan/big/fault phase keeps the placement record but signals
+    # the failure through the exit status (drivers record both)
+    return 1 if any(
+        key in record for key in ("plan_error", "big_point_error", "fault_error")
+    ) else 0
 
 
 if __name__ == "__main__":

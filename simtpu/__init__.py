@@ -27,17 +27,22 @@ __all__ = [
     "Simulator",
     "UnscheduledPod",
     "plan_capacity",
+    "plan_resilience",
     "simulate",
     "__version__",
 ]
 
 
 def __getattr__(name):
-    # lazy: the planner pulls in the full engine/parallel stack
+    # lazy: the planners pull in the full engine/parallel/faults stack
     if name == "plan_capacity":
         from .plan.capacity import plan_capacity
 
         return plan_capacity
+    if name == "plan_resilience":
+        from .plan.resilience import plan_resilience
+
+        return plan_resilience
     if name == "SchedulerConfig":
         from .schedconfig import SchedulerConfig
 

@@ -168,6 +168,11 @@ class Simulator:
         self._nodes: List[dict] = []
         self._scheduled: List[dict] = []  # placed pods, nodeName set; parallel
         self._placed_prio: List[float] = []  # ... to the engine placement log
+        # ... and whether each entry was BOUND via spec.nodeName before
+        # scheduling (statically bound pods die with their node under fault
+        # drains, faults/drain.py — the placed copies are indistinguishable
+        # after record_placed_pod sets nodeName on everything)
+        self._placed_forced: List[bool] = []
         self._preempted: List[PreemptedPod] = []
         self._unscheduled: List[UnscheduledPod] = []
         self._storage_classes: List[dict] = []
@@ -226,13 +231,16 @@ class Simulator:
 
     # -- internals ---------------------------------------------------------
 
-    def _record_placed(self, pod: dict, node_idx: int, gpu_shares) -> None:
+    def _record_placed(
+        self, pod: dict, node_idx: int, gpu_shares, forced: bool = False
+    ) -> None:
         self._scheduled.append(
             record_placed_pod(
                 pod, self._nodes[node_idx]["metadata"]["name"], gpu_shares
             )
         )
         self._placed_prio.append(pod_priority(pod))
+        self._placed_forced.append(forced)
 
     def _record_failed(self, pod: dict, reason: int, note: str = "") -> None:
         msg = REASON_TEXT.get(int(reason), "unschedulable")
@@ -284,7 +292,10 @@ class Simulator:
         failed = []
         for i, (pod, node_idx, reason) in enumerate(zip(batch.pods, nodes, reasons)):
             if node_idx >= 0:
-                self._record_placed(pod, node_idx, extras["gpu_shares"][i])
+                self._record_placed(
+                    pod, node_idx, extras["gpu_shares"][i],
+                    forced=bool(batch.forced[i]),
+                )
             else:
                 failed.append((pod, int(reason)))
         self._preempt_failed_batch(failed)
@@ -465,11 +476,17 @@ class Simulator:
                 saved = self._engine.remove_placements(all_v)
                 for i, entry in zip(saved["indices"], saved["entries"]):
                     saved_per_pod[owner[i]].append(
-                        (entry, self._scheduled[i], self._placed_prio[i])
+                        (
+                            entry,
+                            self._scheduled[i],
+                            self._placed_prio[i],
+                            self._placed_forced[i],
+                        )
                     )
                 for i in reversed(saved["indices"]):
                     del self._scheduled[i]
                     del self._placed_prio[i]
+                    del self._placed_forced[i]
             probe = self._tensorizer.add_pods([p for p, _, _, _, _ in wave])
             log_base = len(self._engine.placed_node)
             nodes, _, extras = self._engine.place(probe)
@@ -509,7 +526,7 @@ class Simulator:
                 prov_victims: list = []
 
                 def _absorb(records):
-                    for entry, vpod, _ in records:
+                    for entry, vpod, _prio, _forced in records:
                         prov_nodes.add(entry[1])
                         prov_victims.append(
                             (_labels(entry[1]), _anti_topo_keys(vpod))
@@ -545,7 +562,7 @@ class Simulator:
                     continue
                 pod = wave[w][0]
                 who = f"{namespace_of(pod)}/{name_of(pod)}"
-                for _, vpod, _ in saved_per_pod[w]:
+                for _, vpod, _prio, _forced in saved_per_pod[w]:
                     self._preempted.append(
                         PreemptedPod(
                             pod=vpod,
@@ -620,12 +637,13 @@ class Simulator:
         base = len(self._engine.placed_node)
         saved = {
             "indices": list(range(base, base + len(records))),
-            "entries": [entry for entry, _, _ in records],
+            "entries": [entry for entry, _, _, _ in records],
         }
         self._engine.restore_placements(saved)
-        for _, vpod, vprio in records:
+        for _, vpod, vprio, vforced in records:
             self._scheduled.append(vpod)
             self._placed_prio.append(vprio)
+            self._placed_forced.append(vforced)
 
     def _propose_victims(self, pod: dict, reason: int, model: dict):
         """Host-side victim proposal for one failed pod against the wave
@@ -795,6 +813,11 @@ class Simulator:
             relevant = np.ones(len(placed_groups_a), bool)
 
         node_ok = np.asarray(static, bool).copy()
+        if getattr(self._engine, "node_valid", None) is not None:
+            # fault-masked nodes (simtpu/faults/drain.py) are not landing
+            # sites: the engine's filter pipeline is guaranteed to reject
+            # them at verify, so proposing one only burns a wave
+            node_ok &= np.asarray(self._engine.node_valid, bool)
         if pin_name is not None:
             # the pin restricts WITHIN the static mask (the serial loop
             # checked static first): a pinned node the pod can never place

@@ -50,11 +50,12 @@ def _interactive_select(names: List[str]) -> List[str]:
     return picked
 
 
-def _plan_json(plan) -> str:
+def _plan_json(plan, resilience: dict = None) -> str:
     """Machine-readable plan summary for scripted/CI consumers: includes
     the engine record (search/bulk/shards + auto flags), so the
     non-reference-exact fast path is detectable from the OUTPUT, not just
-    a stderr notice that pipelines routinely drop."""
+    a stderr notice that pipelines routinely drop.  `resilience` attaches
+    the post-plan fault-sweep counters (`--faults`)."""
     import json
 
     doc = {
@@ -69,7 +70,65 @@ def _plan_json(plan) -> str:
             len(plan.result.unscheduled_pods) if plan.result is not None else None
         ),
     }
+    if resilience is not None:
+        doc["resilience"] = resilience
     return json.dumps(doc)
+
+
+def _apply_faults_sweep(applier, plan, spec: str, samples: int, seed: int, progress):
+    """Post-plan survivability assessment for `simtpu apply --faults`: one
+    batched fault sweep over the WINNING cluster (base + the clones the
+    plan added).  Placement for the sweep runs engine-level without
+    preemption (the capacity-sweep contract, plan/resilience.py)."""
+    from .core.objects import ResourceTypes
+    from .faults import generate_scenarios, place_cluster, sweep_scenarios
+    from .plan.capacity import new_fake_nodes
+
+    cluster = applier.load_cluster()
+    apps = applier.load_apps()
+    if plan.nodes_added:
+        trial = ResourceTypes(**{k: list(v) for k, v in vars(cluster).items()})
+        trial.nodes = list(cluster.nodes) + new_fake_nodes(
+            applier.load_new_node(), plan.nodes_added
+        )
+        cluster = trial
+    progress(
+        f"fault sweep over the winning cluster ({len(cluster.nodes)} nodes, "
+        f"faults={spec})"
+    )
+    pc = place_cluster(
+        cluster,
+        apps,
+        extended_resources=applier.opts.extended_resources,
+        sched_config=applier._sched_config(),
+    )
+    # the sweep's own base placement can differ from the plan's (engine-
+    # level, simulate() pod order, no preemption) — pods it strands never
+    # enter a requeue, so the count MUST ride the output or the counters
+    # silently assess a smaller pod set
+    base_unplaced = int((pc.nodes < 0).sum())
+    if base_unplaced:
+        progress(
+            f"{base_unplaced} pod(s) do not place in the sweep's base "
+            "placement — survivability is assessed over the placed set only"
+        )
+    scen = generate_scenarios(cluster.nodes, spec, samples=samples, seed=seed)
+    return sweep_scenarios(pc, scen), base_unplaced
+
+
+def _sweep_json_doc(sweep, spec: str, samples: int, seed: int) -> dict:
+    doc = dict(sweep.counters())
+    doc.update(
+        {
+            "spec": spec,
+            "samples": samples,
+            "seed": seed,
+            "worst": [[lbl, n] for lbl, n in sweep.worst()],
+            "critical_nodes": [[node, n] for node, n in sweep.critical_nodes()],
+            "timings": {k: round(v, 3) for k, v in sweep.timings.items()},
+        }
+    )
+    return doc
 
 
 def cmd_apply(args: argparse.Namespace) -> int:
@@ -101,6 +160,21 @@ def cmd_apply(args: argparse.Namespace) -> int:
         return fail_early(
             ValueError("--json and --interactive are mutually exclusive")
         )
+    if args.faults and opts.interactive:
+        # the post-plan fault sweep re-loads the app list from the config;
+        # an interactive selection would silently not apply to it
+        return fail_early(
+            ValueError("--faults and --interactive are mutually exclusive")
+        )
+    if args.faults:
+        # reject a malformed spec BEFORE the (potentially minutes-long)
+        # plan runs, not after it succeeded
+        from .faults import parse_fault_spec
+
+        try:
+            parse_fault_spec(args.faults)
+        except ValueError as exc:
+            return fail_early(exc)
     try:
         applier = Applier(opts)
     except (ValueError, FileNotFoundError) as exc:
@@ -118,14 +192,44 @@ def cmd_apply(args: argparse.Namespace) -> int:
         plan = applier.run(select_apps=select, progress=progress)
     except (ValueError, FileNotFoundError) as exc:
         return fail_early(exc)
+    fault_sweep, fault_base_unplaced, fault_error = None, 0, None
+    if args.faults and plan.success:
+        try:
+            fault_sweep, fault_base_unplaced = _apply_faults_sweep(
+                applier, plan, args.faults, args.fault_samples,
+                args.fault_seed, progress,
+            )
+        except ValueError as exc:
+            # a failed post-plan sweep must not discard the successful
+            # plan: record the error alongside it instead
+            fault_error = str(exc)
+            print(f"fault sweep failed: {exc}", file=sys.stderr)
     if args.json:
-        print(_plan_json(plan))
+        resilience = None
+        if fault_sweep is not None:
+            resilience = _sweep_json_doc(
+                fault_sweep, args.faults, args.fault_samples, args.fault_seed
+            )
+            resilience["base_unplaced"] = fault_base_unplaced
+        elif fault_error is not None:
+            resilience = {"error": fault_error}
+        print(_plan_json(plan, resilience=resilience))
         return 0 if plan.success else 1
     if plan.success:
         print(f"{C.COLOR_GREEN}Success!{C.COLOR_RESET}")
         print(C.COLOR_GREEN, end="")
         print(report(plan.result.node_status, opts.extended_resources))
         print(C.COLOR_RESET, end="")
+        if fault_sweep is not None:
+            from .report import resilience_report
+
+            print(resilience_report(fault_sweep))
+            if fault_base_unplaced:
+                print(
+                    f"{C.COLOR_RED}warning: {fault_base_unplaced} pod(s) "
+                    "unplaced before any failure; survivability covers the "
+                    f"placed set only{C.COLOR_RESET}"
+                )
         if plan.timings:
             phases = "  ".join(f"{k}={v:.2f}s" for k, v in plan.timings.items())
             print(f"phase timings: {phases}")
@@ -139,6 +243,124 @@ def cmd_apply(args: argparse.Namespace) -> int:
         print(report(plan.result.node_status, opts.extended_resources))
         print(C.COLOR_RESET, end="")
     return 1
+
+
+def cmd_resilience(args: argparse.Namespace) -> int:
+    """Survivability assessment / N+k planning over the configured cluster
+    (simtpu/faults, plan/resilience.py).  Default mode drains + requeues
+    every generated failure scenario against the as-is cluster; `--plan`
+    searches the minimum newNode clone count whose cluster survives them
+    (requires `newNode` in the Config CR)."""
+    import json
+
+    opts = ApplierOptions(
+        simon_config=args.simon_config,
+        default_scheduler_config=args.default_scheduler_config or "",
+        extended_resources=args.extended_resources or [],
+    )
+
+    def fail_early(exc: Exception) -> int:
+        if args.json:
+            print(json.dumps({"success": False, "message": str(exc)}))
+        print(exc, file=sys.stderr)
+        return 1
+
+    try:
+        applier = Applier(opts)
+    except (ValueError, FileNotFoundError) as exc:
+        return fail_early(exc)
+    progress_stream = sys.stderr if args.json else sys.stdout
+
+    def progress(msg: str) -> None:
+        print(f"{C.COLOR_YELLOW}{msg}{C.COLOR_RESET}", file=progress_stream)
+
+    try:
+        cluster = applier.load_cluster()
+        apps = applier.load_apps()
+        sched_config = applier._sched_config()
+        if args.plan:
+            from .plan.resilience import plan_resilience
+
+            new_node = applier.load_new_node()
+            plan = plan_resilience(
+                cluster,
+                apps,
+                new_node,
+                spec=args.faults,
+                quantile=args.quantile,
+                samples=args.samples,
+                seed=args.seed,
+                max_new_nodes=args.max_new_nodes,
+                extended_resources=opts.extended_resources,
+                progress=progress,
+                sched_config=sched_config,
+            )
+            if args.json:
+                doc = plan.counters()
+                doc["message"] = plan.message
+                doc["probes"] = {
+                    str(i): rec for i, rec in sorted(plan.probes.items())
+                }
+                if plan.sweep is not None:
+                    doc["worst"] = [[lbl, n] for lbl, n in plan.sweep.worst()]
+                print(json.dumps(doc))
+                return 0 if plan.success else 1
+            color = C.COLOR_GREEN if plan.success else C.COLOR_RED
+            print(f"{color}{plan.message}{C.COLOR_RESET}")
+            if plan.success:
+                print(
+                    f"minimum nodes added for survivability: {plan.nodes_added}"
+                )
+            if plan.sweep is not None:
+                from .report import resilience_report
+
+                print(resilience_report(plan.sweep))
+            return 0 if plan.success else 1
+
+        from .faults import generate_scenarios, place_cluster, sweep_scenarios
+
+        progress(
+            f"placing workloads ({len(cluster.nodes)} nodes), then sweeping "
+            f"faults={args.faults}"
+        )
+        pc = place_cluster(
+            cluster,
+            apps,
+            extended_resources=opts.extended_resources,
+            bulk=not args.no_bulk,
+            sched_config=sched_config,
+        )
+        base_unplaced = int((pc.nodes < 0).sum())
+        if base_unplaced:
+            progress(
+                f"{base_unplaced} pod(s) do not place before any failure — "
+                "the sweep assesses only the placed set"
+            )
+        scen = generate_scenarios(
+            cluster.nodes, args.faults, samples=args.samples, seed=args.seed
+        )
+        sweep = sweep_scenarios(pc, scen)
+    except (ValueError, FileNotFoundError) as exc:
+        return fail_early(exc)
+    survived_all = bool(sweep.survival_rate >= 1.0) and base_unplaced == 0
+    if args.json:
+        doc = _sweep_json_doc(sweep, args.faults, args.samples, args.seed)
+        doc["success"] = survived_all
+        doc["base_unplaced"] = base_unplaced
+        print(json.dumps(doc))
+        return 0 if survived_all else 1
+    from .report import resilience_report
+
+    color = C.COLOR_GREEN if survived_all else C.COLOR_RED
+    print(color, end="")
+    print(resilience_report(sweep))
+    print(C.COLOR_RESET, end="")
+    rate = sweep.timings.get("scenarios_per_s", 0.0)
+    print(
+        f"{len(scen)} scenario(s), {int(sweep.survived.sum())} survived "
+        f"({rate:.0f} scenarios/s)"
+    )
+    return 0 if survived_all else 1
 
 
 def cmd_version(_args: argparse.Namespace) -> int:
@@ -257,7 +479,108 @@ def build_parser() -> argparse.ArgumentParser:
         "can-ever-fit diagnostic (the reference pins its probe pod to a node "
         "named 'simon', so the overhead silently contributes nothing)",
     )
+    apply_p.add_argument(
+        "--faults",
+        metavar="SPEC",
+        help="after a successful plan, sweep failure scenarios over the "
+        "winning cluster and report survivability (e.g. 'k=1' = every "
+        "single-node outage, 'k=2:500,zone' = 500 two-node samples plus "
+        "zone outages); counters ride --json under 'resilience'",
+    )
+    apply_p.add_argument(
+        "--fault-samples",
+        type=int,
+        default=256,
+        metavar="N",
+        help="sample budget per k>=2 fault term (default 256)",
+    )
+    apply_p.add_argument(
+        "--fault-seed",
+        type=int,
+        default=0,
+        metavar="SEED",
+        help="deterministic seed for sampled fault scenarios (default 0)",
+    )
     apply_p.set_defaults(func=cmd_apply)
+
+    res_p = sub.add_parser(
+        "resilience",
+        help="fault-injection survivability: drain + requeue batched "
+        "failure scenarios (and optionally plan N+k capacity)",
+    )
+    res_p.add_argument(
+        "-f", "--simon-config", required=True, help="path of simon config (required)"
+    )
+    res_p.add_argument(
+        "-d",
+        "--default-scheduler-config",
+        help="path of scheduler-config overrides",
+    )
+    res_p.add_argument(
+        "-e",
+        "--extended-resources",
+        nargs="*",
+        choices=["open-local", "gpu"],
+        help="extended resources to model (open-local, gpu)",
+    )
+    res_p.add_argument(
+        "--faults",
+        metavar="SPEC",
+        default="k=1",
+        help="failure model: comma-separated k=<int>[:<samples>] terms and "
+        "domain outages (zone, rack, host, label:<key>); default k=1 = "
+        "every single-node outage",
+    )
+    res_p.add_argument(
+        "--samples",
+        type=int,
+        default=256,
+        metavar="N",
+        help="sample budget per k>=2 fault term (default 256; exhaustive "
+        "when the combination count fits)",
+    )
+    res_p.add_argument(
+        "--seed",
+        type=int,
+        default=0,
+        metavar="SEED",
+        help="deterministic seed for sampled scenarios (default 0)",
+    )
+    res_p.add_argument(
+        "--quantile",
+        type=float,
+        default=1.0,
+        metavar="Q",
+        help="with --plan: accept a candidate when at least this fraction "
+        "of scenarios fully re-places (default 1.0 = every scenario)",
+    )
+    res_p.add_argument(
+        "--plan",
+        action="store_true",
+        help="search the minimum newNode clone count whose cluster "
+        "survives the failure model (requires newNode in the Config CR)",
+    )
+    res_p.add_argument(
+        "--max-new-nodes",
+        type=int,
+        default=C.MAX_NUM_NEW_NODE,
+        metavar="N",
+        help=f"--plan search ceiling (default {C.MAX_NUM_NEW_NODE})",
+    )
+    res_p.add_argument(
+        "--no-bulk",
+        action="store_true",
+        help="place the base workloads with the serial scan engine instead "
+        "of the bulk rounds engine",
+    )
+    res_p.add_argument(
+        "--json",
+        action="store_true",
+        help="print machine-readable survivability counters (scenarios, "
+        "survived, fault_scenarios_per_s, worst scenarios, critical nodes) "
+        "instead of the report tables",
+    )
+    res_p.set_defaults(func=cmd_resilience)
 
     ver_p = sub.add_parser("version", help="print version")
     ver_p.set_defaults(func=cmd_version)

@@ -23,6 +23,9 @@ LABEL_APP_NAME = "simon/app-name"
 LABEL_HOSTNAME = "kubernetes.io/hostname"
 LABEL_ZONE = "topology.kubernetes.io/zone"
 LABEL_ZONE_BETA = "failure-domain.beta.kubernetes.io/zone"
+# failure-domain label the fault subsystem (simtpu/faults) keys rack-outage
+# scenarios off; kubernetes standardizes no rack key, so simtpu owns one
+LABEL_RACK = "simtpu.io/rack"
 
 ENV_MAX_CPU = "MaxCPU"
 ENV_MAX_MEMORY = "MaxMemory"

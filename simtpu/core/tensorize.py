@@ -534,6 +534,23 @@ class PodBatch:
     ext: dict = None  # stacked extended demand arrays (built by add_pods)
 
 
+def slice_batch(batch: PodBatch, idx) -> PodBatch:
+    """An index-selected view of a batch.  Engines consume only the arrays;
+    `pods` follows (as references) when the source batch carries it, so
+    drain/requeue consumers can still name the pods they report.  Shared by
+    the incremental planner's completion probes and the fault subsystem's
+    requeue batches."""
+    idx = np.asarray(idx, np.int64)
+    return PodBatch(
+        pods=[batch.pods[int(i)] for i in idx] if batch.pods else [],
+        group=batch.group[idx],
+        req=batch.req[idx],
+        pin=batch.pin[idx],
+        forced=batch.forced[idx],
+        ext={k: np.asarray(v)[idx] for k, v in batch.ext.items()},
+    )
+
+
 class Tensorizer:
     """Incremental tensorization: one instance per simulation.
 

@@ -95,6 +95,12 @@ def _sds(shape, dtype):
     )
 
 
+# public names for out-of-package enumerators (the fault sweep registers
+# its scenario-batched executable under these, simtpu/faults/sweep.py)
+as_sds = _as_sds
+sds = _sds
+
+
 def state_sds(tensors):
     """The SchedState signature a fresh engine carries for `tensors`,
     derived from build_state ITSELF via jax.eval_shape (tracing its

@@ -55,6 +55,7 @@ from .state import (
     apply_placement_deltas,
     build_state,
     interpod_term_index,
+    pack_delta_entries,
     take_rows,
     take_rows_i32,
 )
@@ -2279,6 +2280,14 @@ class Engine:
         #: are bit-identical on or off; SIMTPU_WAVEFRONT=0 flips the
         #: default for A/B measurement.
         self.speculate = wave_enabled()
+        #: optional [N] host bool mask — False rows are out of this
+        #: engine's cluster (failed nodes under fault injection,
+        #: simtpu/faults/drain.py).  ANDed into statics.node_valid at every
+        #: place(), composing with subclass masks (MaskedRoundsEngine's
+        #: candidate mask, the sharded engines' dead-row padding); the
+        #: preemption proposer (api.py) reads the same attribute so masked
+        #: nodes are never proposed as landing sites.
+        self.node_valid = None
         self.placed_group: List[int] = []
         self.placed_node: List[int] = []
         self.placed_req: List[np.ndarray] = []
@@ -2293,6 +2302,17 @@ class Engine:
         self.last_state: SchedState = None
         self._last_vocab = None  # vocabulary sizes behind last_state
         self._state_dirty = False  # log surgery (preemption) invalidates reuse
+
+    def log_req_matrix(self, r: int) -> np.ndarray:
+        """The placement log's request rows padded to the r-column resource
+        vocabulary — the ONE assembly shared by the state rebuild here and
+        the fault sweep's delta sources (simtpu/faults/sweep.py), so a new
+        log column cannot silently diverge them."""
+        if not self.placed_req:
+            return np.zeros((0, r), np.float32)
+        return np.stack(
+            [np.pad(q, (0, r - q.shape[0])) for q in self.placed_req]
+        ).astype(np.float32)
 
     @staticmethod
     def state_vocab(tensors) -> tuple:
@@ -2414,16 +2434,17 @@ class Engine:
                 tensors,
                 np.asarray(self.placed_group, np.int32),
                 np.asarray(self.placed_node, np.int32),
-                (
-                    np.stack(
-                        [np.pad(q, (0, r - q.shape[0])) for q in self.placed_req]
-                    )
-                    if self.placed_req
-                    else np.zeros((0, r), np.float32)
-                ),
+                self.log_req_matrix(r),
                 self.ext_log,
             )
         statics = statics_from(tensors, self.sched_config)
+        if self.node_valid is not None:
+            # fault/what-if masking: dead rows no pod can select — the same
+            # lever the capacity sweep vmaps over (parallel/sweep.py)
+            statics = statics._replace(
+                node_valid=statics.node_valid
+                & jnp.asarray(np.asarray(self.node_valid, bool))
+            )
         ext = batch.ext
         flags = flags_from(tensors, batch.ext)
         # a donating dispatch can invalidate `state`'s buffers before raising
@@ -2481,25 +2502,16 @@ class Engine:
         if self._last_vocab != self.state_vocab(tensors):
             self._state_dirty = True
             return
-        v = len(entries)
-        v_pad = 1 << max(v - 1, 0).bit_length()  # pow2-bounded compile set
-        g_a = np.zeros(v_pad, np.int32)
-        n_a = np.zeros(v_pad, np.int32)
-        w_a = np.zeros(v_pad, np.float32)
-        req_a = np.zeros((v_pad, r), np.float32)
-        vg_a = np.zeros((v_pad, tensors.ext.vg_cap.shape[1]), np.float32)
-        sd_a = np.zeros((v_pad, tensors.ext.sdev_cap.shape[1]), bool)
-        gp_a = np.zeros((v_pad, tensors.ext.gpu_dev_total.shape[1]), np.float32)
-        for i, (g, node, req, _enode, vg, sdev, gpu_sh, gpu_mem) in enumerate(entries):
-            g_a[i], n_a[i], w_a[i] = g, node, sign
-            req_a[i, : req.shape[0]] = req
-            vg_a[i] = vg
-            sd_a[i] = sdev
-            gp_a[i] = np.asarray(gpu_sh) * gpu_mem
-        statics = statics_from(tensors, self.sched_config)
-        self.last_state = _apply_log_delta(
-            statics, self.last_state, (g_a, n_a, w_a, req_a, vg_a, sd_a, gp_a)
+        packed = pack_delta_entries(
+            entries,
+            r,
+            tensors.ext.vg_cap.shape[1],
+            tensors.ext.sdev_cap.shape[1],
+            tensors.ext.gpu_dev_total.shape[1],
+            sign,
         )
+        statics = statics_from(tensors, self.sched_config)
+        self.last_state = _apply_log_delta(statics, self.last_state, packed)
 
     def remove_placements(self, indices: List[int]) -> dict:
         """Delete log entries at `indices`; returns an undo token."""

@@ -404,6 +404,37 @@ def apply_placement_deltas(statics, state: SchedState, entries):
     """Scan `placement_delta_step` over padded entry arrays (w = 0 rows are
     no-ops).  Entries with w = -1 undo what the same entries with w = +1
     applied — the batch-apply/undo pair behind preemption's eviction and
-    restore paths."""
+    restore paths and the fault sweep's scenario drains
+    (simtpu/faults/)."""
     state, _ = jax.lax.scan(partial(placement_delta_step, statics), state, entries)
     return state
+
+
+def pack_delta_entries(entries, n_resources: int, vg_w: int, sd_w: int, gd_w: int,
+                       sign: float, pad_to: int = None):
+    """Padded entry arrays for `apply_placement_deltas` from saved
+    placement-log records in `Engine.remove_placements`' layout
+    ((g, node, req, ext_node, vg_alloc, sdev_take, gpu_shares, gpu_mem) per
+    entry).  Rows beyond len(entries) carry w = 0 and are exact no-ops
+    through `placement_delta_step`; `pad_to` overrides the default
+    pow2-bounded padding (the fault sweep pads every scenario of a batch
+    to one shared length so all scenarios compile one executable).  The
+    single packing used by the engine's eviction/undo path and the
+    scenario sweep — shared code is what keeps their delta arithmetic
+    bit-identical."""
+    v = len(entries)
+    v_pad = pad_to if pad_to is not None else 1 << max(v - 1, 0).bit_length()
+    g_a = np.zeros(v_pad, np.int32)
+    n_a = np.zeros(v_pad, np.int32)
+    w_a = np.zeros(v_pad, np.float32)
+    req_a = np.zeros((v_pad, n_resources), np.float32)
+    vg_a = np.zeros((v_pad, vg_w), np.float32)
+    sd_a = np.zeros((v_pad, sd_w), bool)
+    gp_a = np.zeros((v_pad, gd_w), np.float32)
+    for i, (g, node, req, _enode, vg, sdev, gpu_sh, gpu_mem) in enumerate(entries):
+        g_a[i], n_a[i], w_a[i] = g, node, sign
+        req_a[i, : req.shape[0]] = req
+        vg_a[i] = vg
+        sd_a[i] = sdev
+        gp_a[i] = np.asarray(gpu_sh) * gpu_mem
+    return (g_a, n_a, w_a, req_a, vg_a, sd_a, gp_a)

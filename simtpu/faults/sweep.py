@@ -1,0 +1,423 @@
+"""Batched failure-scenario sweep: thousands of what-if outages at once.
+
+The serial oracle (faults/drain.py) answers one scenario with three engine
+round-trips — drain deltas, a requeue placement, the undo.  At N scenarios
+that is O(N) compiled dispatches, the same shape as the reference's serial
+candidate loop before the capacity sweep (parallel/sweep.py) batched it.
+Here the scenario axis becomes a tensor dimension:
+
+1. per scenario, the DRAIN is a fixed-length batch of signed placement-log
+   deltas (`engine/state.py placement_delta_step`, w = -1 real rows, 0
+   padding) applied to the shared base state — the same arithmetic the
+   serial path's `remove_placements` undo machinery runs, so drained
+   states are bit-identical;
+2. the REQUEUE is a fixed-length `schedule_step` scan of the scenario's
+   evicted pods (original placement order) against the scenario-masked
+   statics — the same kernels as the serial engine's dispatch;
+3. `vmap` batches both over a `[S, N]` scenario-mask tensor, chunked so
+   the vmapped carry stays within memory, and one compiled executable
+   (`_fault_sweep`) serves every chunk.  With `mesh=`, the scenario axis
+   shards over "sweep" and the node axis over "nodes", exactly like the
+   capacity sweep.
+
+Padding is trailing and inert: a padded delta row carries w = 0 (an exact
+no-op through `placement_delta_step` — its sdev mask is zeroed so the
+boolean-release branch is the identity), and a padded requeue row is an
+unforced zero-request phantom whose state effects occur AFTER every real
+pod of its scenario; outputs are masked back to the real counts host-side.
+
+`sweep_scenarios` enumerates its executable into the AOT registry
+(engine/precompile.py) under the scenario-batched signature when handed a
+pipeline, so the compile overlaps the host-side scenario assembly.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.scan import (
+    StepFlags,
+    build_pod_arrays,
+    count_trace,
+    flags_from,
+    schedule_step,
+    statics_from,
+)
+from ..engine.state import build_state, placement_delta_step
+from .drain import PlacedCluster, drain_requeue
+from .scenarios import ScenarioSet
+
+
+@partial(jax.jit, static_argnums=(5,))
+def _fault_sweep(statics, valid_s, state, entries_s, pods_s, flags=StepFlags()):
+    """One chunk of scenarios: vmapped drain (delta scan) + requeue
+    (schedule scan).  `state` is the shared base carry (broadcast, never
+    donated); `valid_s [S, N]` is the SURVIVING-node mask per scenario."""
+    count_trace("fault_sweep")
+
+    def one(valid, entries, pods):
+        drained, _ = jax.lax.scan(
+            partial(placement_delta_step, statics), state, entries
+        )
+        st = statics._replace(node_valid=statics.node_valid & valid)
+        _, outs = jax.lax.scan(
+            partial(schedule_step, st, flags=flags), drained, pods
+        )
+        return outs[0], outs[1]  # landing nodes, failure reasons
+
+    return jax.vmap(one)(valid_s, entries_s, pods_s)
+
+
+def _pow2(x: int) -> int:
+    return 1 << max(int(x) - 1, 0).bit_length()
+
+
+def _state_bytes(state) -> int:
+    leaves = jax.tree_util.tree_leaves(state)
+    return sum(int(np.prod(x.shape)) * x.dtype.itemsize for x in leaves)
+
+
+def _base_state(pc: PlacedCluster):
+    """The base carry every scenario drains from.  `place_cluster` leaves
+    the engine's carried state valid; a dirtied engine (log surgery without
+    a following place) rebuilds from the log the way Engine.place would."""
+    eng = pc.engine
+    tensors = pc.tensors
+    if (
+        eng.last_state is not None
+        and not eng._state_dirty
+        and eng._last_vocab == eng.state_vocab(tensors)
+    ):
+        return eng.last_state
+    r = tensors.alloc.shape[1]
+    return build_state(
+        tensors,
+        np.asarray(eng.placed_group, np.int32),
+        np.asarray(eng.placed_node, np.int32),
+        eng.log_req_matrix(r),
+        eng.ext_log,
+    )
+
+
+@dataclass
+class SweepResult:
+    """Per-scenario outcomes of one batched sweep."""
+
+    scenarios: ScenarioSet
+    evicted: np.ndarray  # [S] pods drained off failed nodes
+    lost: np.ndarray  # [S] forced pods that die with their node
+    requeued: np.ndarray  # [S] requeue attempts (evicted - lost)
+    unplaced: np.ndarray  # [S] requeued pods that found no surviving node
+    requeue_rows: np.ndarray  # [S, Rq] batch rows (-1 padding)
+    requeue_nodes: np.ndarray  # [S, Rq] landing nodes (-1 = unplaced)
+    requeue_reasons: np.ndarray  # [S, Rq] failure codes
+    timings: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def survived(self) -> np.ndarray:
+        return self.unplaced == 0
+
+    @property
+    def survival_rate(self) -> float:
+        s = len(self.scenarios)
+        return float(self.survived.sum()) / s if s else 1.0
+
+    def worst(self, top: int = 5) -> List[Tuple[str, int]]:
+        """The `top` scenarios by unplaced-pod count (ties by index)."""
+        order = np.argsort(-self.unplaced, kind="stable")[:top]
+        return [
+            (self.scenarios.labels[int(s)], int(self.unplaced[s]))
+            for s in order
+            if self.unplaced[s] > 0
+        ]
+
+    def critical_nodes(self, top: int = 10) -> List[Tuple[str, int]]:
+        """For single-node scenarios: the nodes whose loss strands the most
+        pods — the cluster's criticality ranking."""
+        singles = [
+            (self.scenarios.labels[s], int(self.unplaced[s]))
+            for s in range(len(self.scenarios))
+            if int(self.scenarios.masks[s].sum()) == 1
+        ]
+        singles.sort(key=lambda kv: -kv[1])
+        return [(lbl.split(":", 1)[-1], n) for lbl, n in singles[:top] if n > 0]
+
+    def counters(self) -> Dict[str, object]:
+        """Machine-readable summary (CLI --json, bench)."""
+        return {
+            "scenarios": len(self.scenarios),
+            "survived": int(self.survived.sum()),
+            "survival_rate": round(self.survival_rate, 4),
+            "evicted_total": int(self.evicted.sum()),
+            "unplaced_max": int(self.unplaced.max()) if len(self.unplaced) else 0,
+            "fault_scenarios_per_s": round(
+                self.timings.get("scenarios_per_s", 0.0), 1
+            ),
+        }
+
+
+def _chunk_default(state, n_scenarios: int) -> int:
+    """Scenario rows per dispatch: bound the vmapped carry to ~256 MB of
+    replicated state, clamped to [8, 128] and pow2 for shape stability."""
+    per = max(_state_bytes(state), 1)
+    budget = 256 << 20
+    return int(min(128, max(8, _pow2(min(budget // per, n_scenarios) or 1))))
+
+
+def sweep_scenarios(
+    pc: PlacedCluster,
+    scenarios: ScenarioSet,
+    s_chunk: Optional[int] = None,
+    mesh=None,
+    pipeline=None,
+) -> SweepResult:
+    """Evaluate every scenario's drain + requeue in vmapped chunks.
+
+    Produces, for each scenario, the identical unplaced-pod set as the
+    serial replay (`drain_requeue(pc, mask, restore=True)`) — pinned by
+    tests/test_faults.py.  The engine itself is never touched: the base
+    state is read once and broadcast, so the sweep composes with any
+    engine (bulk, masked, a resilience candidate's
+    `MaskedRoundsEngine`)."""
+    t0 = time.perf_counter()
+    eng = pc.engine
+    tensors = pc.tensors
+    n = pc.n_nodes
+    r = tensors.alloc.shape[1]
+    if scenarios.n_nodes != n:
+        raise ValueError(
+            f"scenarios span {scenarios.n_nodes} nodes, cluster has {n}"
+        )
+    s_total = len(scenarios)
+    flags = flags_from(tensors, pc.batch.ext)
+    statics = statics_from(tensors, eng.sched_config)
+    state = _base_state(pc)
+    base_valid = (
+        np.ones(n, bool)
+        if eng.node_valid is None
+        else np.asarray(eng.node_valid, bool)
+    )
+
+    # -- host-side scenario assembly --------------------------------------
+    masks = np.asarray(scenarios.masks, bool)
+    log_nodes = np.asarray(eng.placed_node, np.int32)
+    log_rows = pc.log_row  # log index -> batch row
+    dies = pc.dies_with_node  # DS pods / nodeName pins die with their node
+    ev_lists = [np.flatnonzero(masks[s][log_nodes]) for s in range(s_total)]
+    rq_lists = []
+    lost = np.zeros(s_total, np.int64)
+    for s, ev in enumerate(ev_lists):
+        rows = log_rows[ev]
+        f = dies[rows]
+        lost[s] = int(f.sum())
+        rq_lists.append(rows[~f])
+    e_pad = _pow2(max((len(v) for v in ev_lists), default=0) or 1)
+    r_pad = _pow2(max((len(v) for v in rq_lists), default=0) or 1)
+    if s_chunk is None:
+        s_chunk = _chunk_default(state, s_total)
+    if mesh is not None:
+        from ..parallel.mesh import SWEEP_AXIS
+
+        s_chunk = max(s_chunk, mesh.shape[SWEEP_AXIS])
+        s_chunk -= s_chunk % mesh.shape[SWEEP_AXIS]
+
+    shardings = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import NODE_AXIS, SWEEP_AXIS
+        from ..parallel.sharded import (
+            pad_state,
+            pad_statics,
+            state_sharding,
+            statics_sharding,
+        )
+
+        statics, pad = pad_statics(statics, mesh.shape[NODE_AXIS])
+        state = pad_state(state, pad)
+        statics = jax.device_put(statics, statics_sharding(mesh))
+        state = jax.device_put(state, state_sharding(mesh))
+        shardings = (
+            NamedSharding(mesh, P(SWEEP_AXIS, NODE_AXIS)),
+            NamedSharding(mesh, P(SWEEP_AXIS)),
+            pad,
+        )
+
+    if pipeline is not None:
+        # enumerate the scenario-batched signature into the AOT registry
+        # BEFORE the (host-bound) per-chunk assembly below, so the compile
+        # overlaps it (engine/precompile.py)
+        _submit_sweep(
+            pipeline, statics, state, flags, s_chunk, e_pad, r_pad, pc
+        )
+
+    # whole-log delta source columns, gathered per scenario
+    m = len(log_nodes)
+    log_group = np.asarray(eng.placed_group, np.int32)
+    log_req = eng.log_req_matrix(r)
+    ext = eng.ext_log
+    log_vg = (
+        np.asarray(ext["vg_alloc"], np.float32)
+        if m
+        else np.zeros((0, tensors.ext.vg_cap.shape[1]), np.float32)
+    )
+    log_sd = (
+        np.asarray(ext["sdev_take"], bool)
+        if m
+        else np.zeros((0, tensors.ext.sdev_cap.shape[1]), bool)
+    )
+    log_gpu = (
+        np.asarray(ext["gpu_shares"], np.float32)
+        * np.asarray(ext["gpu_mem"], np.float32)[:, None]
+        if m
+        else np.zeros((0, tensors.ext.gpu_dev_total.shape[1]), np.float32)
+    )
+    _, pods_full = build_pod_arrays(pc.batch, r)
+
+    def gather_block(s0: int, s1: int):
+        """Assemble one chunk's (valid, entries, pods, rq_idx) arrays,
+        padding the scenario axis with empty (failure-free) rows."""
+        sb = s_chunk
+        ev_idx = np.full((sb, e_pad), -1, np.int64)
+        rq_idx = np.full((sb, r_pad), -1, np.int64)
+        valid = np.ones((sb, n), bool) & base_valid[None, :]
+        for j, s in enumerate(range(s0, s1)):
+            ev = ev_lists[s]
+            rq = rq_lists[s]
+            ev_idx[j, : len(ev)] = ev
+            rq_idx[j, : len(rq)] = rq
+            valid[j] &= ~masks[s]
+        ev_ok = ev_idx >= 0
+        ev_safe = np.maximum(ev_idx, 0)
+        entries = (
+            np.where(ev_ok, log_group[ev_safe], 0).astype(np.int32),
+            np.where(ev_ok, log_nodes[ev_safe], 0).astype(np.int32),
+            np.where(ev_ok, -1.0, 0.0).astype(np.float32),
+            log_req[ev_safe],
+            log_vg[ev_safe],
+            # padded rows MUST carry an all-False device mask: the w<0
+            # release branch of placement_delta_step ORs it into the row
+            log_sd[ev_safe] & ev_ok[..., None],
+            log_gpu[ev_safe],
+        )
+        rq_ok = rq_idx >= 0
+        rq_safe = np.maximum(rq_idx, 0)
+
+        def pod_col(arr, fill=0):
+            got = arr[rq_safe]
+            mask = rq_ok.reshape(rq_ok.shape + (1,) * (got.ndim - 2))
+            return np.where(mask, got, fill).astype(arr.dtype)
+
+        pods = (
+            pod_col(pods_full[0]),  # group
+            pod_col(pods_full[1]),  # req
+            pod_col(pods_full[2], fill=-1),  # pin: padding is unpinned
+            pod_col(pods_full[3]),  # forced (False for padding)
+        ) + tuple(pod_col(a) for a in pods_full[4:])
+        if shardings is not None and shardings[2]:
+            valid = np.pad(valid, ((0, 0), (0, shardings[2])))
+        return valid, entries, pods, rq_idx
+
+    timings = {"assemble_s": 0.0, "sweep_s": 0.0}
+    rq_rows = np.full((s_total, r_pad), -1, np.int64)
+    rq_nodes = np.full((s_total, r_pad), -1, np.int64)
+    rq_reasons = np.zeros((s_total, r_pad), np.int32)
+    t_sweep = 0.0
+    for s0 in range(0, s_total, s_chunk):
+        s1 = min(s0 + s_chunk, s_total)
+        ta = time.perf_counter()
+        valid, entries, pods, rq_idx = gather_block(s0, s1)
+        if shardings is not None:
+            valid = jax.device_put(jnp.asarray(valid), shardings[0])
+            entries = jax.device_put(entries, shardings[1])
+            pods = jax.device_put(pods, shardings[1])
+        timings["assemble_s"] += time.perf_counter() - ta
+        td = time.perf_counter()
+        args = (statics, valid, state, entries, pods)
+        if pipeline is not None:
+            nodes_b, reasons_b = pipeline.call(
+                "fault_sweep", (flags,), args, lambda: _fault_sweep(*args, flags)
+            )
+        else:
+            nodes_b, reasons_b = _fault_sweep(*args, flags)
+        nodes_b = np.asarray(nodes_b)[: s1 - s0]
+        reasons_b = np.asarray(reasons_b)[: s1 - s0]
+        t_sweep += time.perf_counter() - td
+        rq_rows[s0:s1] = rq_idx[: s1 - s0]
+        rq_nodes[s0:s1] = np.where(rq_idx[: s1 - s0] >= 0, nodes_b, -1)
+        rq_reasons[s0:s1] = np.where(rq_idx[: s1 - s0] >= 0, reasons_b, 0)
+    timings["sweep_s"] = t_sweep
+    timings["total_s"] = time.perf_counter() - t0
+    timings["scenarios_per_s"] = s_total / t_sweep if t_sweep > 0 else 0.0
+
+    evicted = np.asarray([len(v) for v in ev_lists], np.int64)
+    requeued = np.asarray([len(v) for v in rq_lists], np.int64)
+    unplaced = ((rq_nodes < 0) & (rq_rows >= 0)).sum(axis=1)
+    return SweepResult(
+        scenarios=scenarios,
+        evicted=evicted,
+        lost=lost,
+        requeued=requeued,
+        unplaced=unplaced.astype(np.int64),
+        requeue_rows=rq_rows,
+        requeue_nodes=rq_nodes,
+        requeue_reasons=rq_reasons,
+        timings=timings,
+    )
+
+
+def _submit_sweep(pipeline, statics, state, flags, s_chunk, e_pad, r_pad, pc):
+    """Queue the scenario-batched executable's AOT compile (one signature
+    per (chunk, pad) shape — every chunk of a sweep shares it)."""
+    from ..engine.precompile import as_sds as _as_sds, sds as _sds
+
+    n = int(np.asarray(statics.node_valid).shape[0])
+    r = pc.tensors.alloc.shape[1]
+    ext = pc.tensors.ext
+    entries_sds = (
+        _sds((s_chunk, e_pad), np.int32),
+        _sds((s_chunk, e_pad), np.int32),
+        _sds((s_chunk, e_pad), np.float32),
+        _sds((s_chunk, e_pad, r), np.float32),
+        _sds((s_chunk, e_pad, ext.vg_cap.shape[1]), np.float32),
+        _sds((s_chunk, e_pad, ext.sdev_cap.shape[1]), bool),
+        _sds((s_chunk, e_pad, ext.gpu_dev_total.shape[1]), np.float32),
+    )
+    _, pods_full = build_pod_arrays(pc.batch, r)
+    pods_sds = tuple(
+        _sds((s_chunk, r_pad) + a.shape[1:], a.dtype) for a in pods_full
+    )
+    args_sds = (
+        _as_sds(statics),
+        _sds((s_chunk, n), bool),
+        _as_sds(state),
+        entries_sds,
+        pods_sds,
+    )
+    pipeline.submit("fault_sweep", (flags,), _fault_sweep, args_sds)
+
+
+def serial_replay(
+    pc: PlacedCluster,
+    scenarios: ScenarioSet,
+    limit: Optional[int] = None,
+):
+    """The serial oracle: drain + requeue + restore per scenario through
+    the engine path (`faults/drain.py`).  Returns (unplaced counts,
+    per-scenario unplaced batch-row sets) for the first `limit` scenarios —
+    the floor the batched sweep is benchmarked (and pinned) against."""
+    s_n = len(scenarios) if limit is None else min(limit, len(scenarios))
+    counts = np.zeros(s_n, np.int64)
+    row_sets = []
+    for s in range(s_n):
+        res = drain_requeue(pc, scenarios.masks[s], restore=True)
+        counts[s] = res.unplaced
+        row_sets.append(frozenset(int(x) for x in res.unplaced_rows))
+    return counts, row_sets

@@ -1,6 +1,7 @@
 """Capacity planning: serial reference-faithful search (`capacity`),
-incremental single-tensorization search (`incremental`), and the batched
-candidate sweep (`simtpu.parallel.sweep`)."""
+incremental single-tensorization search (`incremental`), the batched
+candidate sweep (`simtpu.parallel.sweep`), and N+k survivability planning
+(`resilience`, riding the fault subsystem `simtpu.faults`)."""
 
 from .capacity import (  # noqa: F401
     Applier,
@@ -9,3 +10,4 @@ from .capacity import (  # noqa: F401
     plan_capacity,
 )
 from .incremental import plan_capacity_incremental  # noqa: F401
+from .resilience import ResiliencePlan, plan_resilience  # noqa: F401

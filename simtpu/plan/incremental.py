@@ -84,7 +84,7 @@ from ..core.objects import (
     name_of,
     namespace_of,
 )
-from ..core.tensorize import PodBatch
+from ..core.tensorize import slice_batch
 from ..engine.rounds import RoundsEngine
 from ..engine.scan import REASON_TEXT
 from .capacity import PlanResult, _env_cap, meet_resource_requests
@@ -108,19 +108,6 @@ class MaskedRoundsEngine(RoundsEngine):
             node_valid=statics.node_valid & jnp.asarray(self.node_valid)
         )
         return super()._dispatch(statics, state, pods, flags)
-
-
-def _slice_batch(batch: PodBatch, idx: np.ndarray) -> PodBatch:
-    """An index-selected view of a batch (engines consume only the arrays;
-    the pods list stays host-side with the planner)."""
-    return PodBatch(
-        pods=[],
-        group=batch.group[idx],
-        req=batch.req[idx],
-        pin=batch.pin[idx],
-        forced=batch.forced[idx],
-        ext={k: np.asarray(v)[idx] for k, v in batch.ext.items()},
-    )
 
 
 _state_copier = None
@@ -438,7 +425,7 @@ def _plan_capacity_incremental(
         say(f"add {i} node(s)")
         c0 = trace_counts()
         idx = np.flatnonzero(base_failed | ((clone_of >= 0) & (clone_of < i)))
-        probe_batch = _slice_batch(batch, idx)
+        probe_batch = slice_batch(batch, idx)
         eng = make_engine(valid_mask(i), plan_batch=probe_batch)
         eng.last_state = _copy_state(snapshot)
         eng._last_vocab = vocab

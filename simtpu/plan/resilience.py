@@ -1,0 +1,321 @@
+"""N+k survivability planning: the smallest cluster that still fits after
+k failures.
+
+`plan_capacity` answers "min template clones so everything fits"
+(`pkg/apply/apply.go:183-233` semantics); production capacity reviews ask
+the harder question — "min clones so everything STILL fits after any
+(or a p-quantile of) k-node outages".  This module wraps the fault
+subsystem (simtpu/faults) in the same search scaffolding as
+`plan/capacity.py`:
+
+- ONE tensorization of base + max clones
+  (`parallel.sweep.assemble_planning_problem`), candidate membership via
+  `node_valid` masks, shared bulk-shape registry across candidates — the
+  incremental planner's levers;
+- per candidate i: one bulk base placement (`MaskedRoundsEngine`), then
+  one batched fault sweep (`faults.sweep.sweep_scenarios`) over scenarios
+  generated on candidate i's live nodes (failures may hit clones too —
+  an added node is as mortal as a base node);
+- a candidate is FEASIBLE when the base placement strands nothing and at
+  least `quantile` of its scenarios fully re-place after drain + requeue;
+- doubling probe + bisection over the candidate count (`search="binary"`,
+  the default), with `search="linear"` for the reference-shaped upward
+  walk.
+
+Monotonicity caveat (the same assumption `plan_capacity` documents for
+schedulability): survivability is capacity-monotone, but with SAMPLED
+scenario sets (k >= 2 on large clusters) each candidate is judged on its
+own deterministic sample (seeded per candidate), so bisection can in
+principle disagree with the linear walk near the boundary by sampling
+noise.  Scenario seeds derive as `seed + candidate`, making every run
+reproducible; raise `samples` or use `search="linear"` when the boundary
+matters to the pod.
+
+Preemption does not run inside the sweep (the drain asks whether
+everything fits, the capacity-planning contract); `faults.drain_simulator`
+is the eviction-semantics path.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from .. import constants as C
+from ..core.objects import AppResource, ResourceTypes
+from ..faults.drain import PlacedCluster
+from ..faults.scenarios import generate_scenarios
+from ..faults.sweep import SweepResult, sweep_scenarios
+from .incremental import MaskedRoundsEngine
+
+
+@dataclass
+class ResiliencePlan:
+    """Outcome of one `plan_resilience` search."""
+
+    success: bool
+    nodes_added: int
+    k: int
+    quantile: float
+    message: str = ""
+    #: per-candidate {"scenarios": S, "survived": n, "base_unplaced": m}
+    probes: Dict[int, Dict[str, int]] = field(default_factory=dict)
+    #: the winning candidate's sweep (None when the search failed)
+    sweep: Optional[SweepResult] = None
+    timings: Dict[str, float] = field(default_factory=dict)
+
+    def counters(self) -> Dict[str, object]:
+        """Machine-readable summary (CLI --json, bench)."""
+        out = {
+            "success": self.success,
+            "nodes_added": self.nodes_added,
+            "k": self.k,
+            "quantile": self.quantile,
+            "candidates_probed": len(self.probes),
+            "plan_resilience_s": round(self.timings.get("total_s", 0.0), 2),
+        }
+        if self.sweep is not None:
+            out.update(self.sweep.counters())
+        return out
+
+
+def _diagnose_doomed(
+    sweep: SweepResult, batch, new_node: dict, all_ds, corrected: bool
+):
+    """Scenarios no clone count can rescue: a stranded pod that cannot EVER
+    run on the template (`apply.go:213-231` semantics — affinity/taints or
+    template capacity net of DaemonSet overhead).  Returns (doomed scenario
+    count, message for the first doomed pod)."""
+    from ..core.match import node_should_run_pod
+    from ..core.objects import name_of, namespace_of
+    from .capacity import meet_resource_requests
+
+    doomed, msg = 0, None
+    for s in np.flatnonzero(~sweep.survived):
+        rows = sweep.requeue_rows[s]
+        stranded = rows[(rows >= 0) & (sweep.requeue_nodes[s] < 0)]
+        for j in stranded[:16]:  # a handful decides the scenario
+            pod = batch.pods[int(j)]
+            why = None
+            if not node_should_run_pod(new_node, pod):
+                why = (
+                    "the pod cannot be scheduled successfully by adding "
+                    "node: pod does not fit new node affinity or taints"
+                )
+            elif not meet_resource_requests(
+                new_node, pod, all_ds, corrected=corrected
+            ):
+                why = (
+                    "new node cannot meet resource requests of pod: the "
+                    "total requested resource of daemonset pods in new "
+                    "node is too large"
+                )
+            if why is not None:
+                doomed += 1
+                if msg is None:
+                    msg = (
+                        f"scenario {sweep.scenarios.labels[int(s)]!r} cannot "
+                        f"be survived by adding nodes: pod "
+                        f"{namespace_of(pod)}/{name_of(pod)}: {why}"
+                    )
+                break
+    return doomed, msg
+
+
+def plan_resilience(
+    cluster: ResourceTypes,
+    apps: Sequence[AppResource] = (),
+    new_node: Optional[dict] = None,
+    k: int = 1,
+    quantile: float = 1.0,
+    spec: Optional[str] = None,
+    samples: int = 256,
+    seed: int = 0,
+    max_new_nodes: int = C.MAX_NUM_NEW_NODE,
+    extended_resources: Sequence[str] = (),
+    search: str = "binary",
+    progress=None,
+    sched_config=None,
+    mesh=None,
+    pipeline=None,
+    s_chunk: Optional[int] = None,
+    corrected_ds_overhead: bool = False,
+) -> ResiliencePlan:
+    """Minimum clone count of `new_node` whose cluster still fully places
+    every workload under the failure model.
+
+    The failure model is `spec` (a `faults.parse_fault_spec` string) when
+    given, else ``k=<k>`` — sampled/exhaustive k-node outages.  A candidate
+    passes when its base placement strands nothing AND the surviving
+    fraction of its scenario sweep is >= `quantile` (1.0 = every scenario).
+    `new_node=None` assesses only the as-is cluster (candidate 0) and
+    reports success/failure without searching."""
+    from ..engine.scan import statics_from
+    from ..parallel.sweep import assemble_planning_problem
+
+    say = progress or (lambda s: None)
+    t_start = time.perf_counter()
+    timings: Dict[str, float] = {}
+    fault_spec = spec if spec is not None else f"k={k}"
+    from ..faults.scenarios import parse_fault_spec
+
+    # the reported k is the largest failure size the spec names (domain
+    # outages fail whole label domains; their size is scenario-dependent)
+    k = max(
+        [t["k"] for t in parse_fault_spec(fault_spec) if t["kind"] == "k"],
+        default=k,
+    )
+    max_new = max(max_new_nodes - 1, 0) if new_node is not None else 0
+    template = new_node if new_node is not None else cluster.nodes[0]
+    t0 = time.perf_counter()
+    tz, all_nodes, n_base, ordered = assemble_planning_problem(
+        cluster, apps, template, max_new, extended_resources
+    )
+    batch = tz.add_pods(ordered)
+    tensors = tz.freeze()
+    statics_from(tensors, sched_config)  # transfer device statics once
+    pin = np.asarray(batch.pin)
+    clone_of = pin - n_base  # >= 0 for clone-pinned (DaemonSet) pods
+    timings["tensorize"] = time.perf_counter() - t0
+
+    # one bulk-shape registry across every candidate's engine, the
+    # incremental planner's warm-executable lever
+    shape_registry: Dict = {}
+    probes: Dict[int, Dict[str, int]] = {}
+    sweeps: Dict[int, SweepResult] = {}
+    all_ds = list(cluster.daemon_sets)
+    for app in apps:
+        all_ds += app.resource.daemon_sets
+
+    class _Doomed(Exception):
+        """A failure scenario no clone count can rescue forces the
+        quantile unreachable — abort the search with the diagnosis."""
+
+    def valid_mask(i: int) -> np.ndarray:
+        m = np.ones(len(all_nodes), bool)
+        m[n_base + i :] = False
+        return m
+
+    def probe(i: int) -> bool:
+        """Base placement + fault sweep for candidate i; True = survives."""
+        say(f"resilience probe: {i} node(s) added, faults={fault_spec}")
+        valid = valid_mask(i)
+        if mesh is not None:
+            from ..parallel.sharded import MaskedShardedRoundsEngine
+
+            eng = MaskedShardedRoundsEngine(tz, mesh, valid)
+        else:
+            eng = MaskedRoundsEngine(tz, valid)
+        eng.sched_config = sched_config
+        eng.bulk_shapes = shape_registry
+        eng.snap_shapes = True
+        nodes, reasons, _extras = eng.place(batch)
+        nodes = np.asarray(nodes)
+        phantom = clone_of >= i
+        base_unplaced = int(((nodes < 0) & ~phantom).sum())
+        rec = {"scenarios": 0, "survived": 0, "base_unplaced": base_unplaced}
+        probes[i] = rec
+        if base_unplaced:
+            return False
+        pc = PlacedCluster(
+            tz=tz, tensors=tensors, batch=batch, engine=eng,
+            nodes=nodes, reasons=np.asarray(reasons),
+        )
+        scen = generate_scenarios(
+            all_nodes, fault_spec, samples=samples, seed=seed + i, valid=valid
+        )
+        sweep = sweep_scenarios(
+            pc, scen, s_chunk=s_chunk, mesh=mesh, pipeline=pipeline
+        )
+        sweeps[i] = sweep
+        rec["scenarios"] = len(scen)
+        rec["survived"] = int(sweep.survived.sum())
+        ok = sweep.survival_rate >= quantile - 1e-12
+        if not ok and new_node is not None:
+            doomed, msg = _diagnose_doomed(
+                sweep, batch, new_node, all_ds, corrected_ds_overhead
+            )
+            if doomed and (len(scen) - doomed) / len(scen) < quantile - 1e-12:
+                raise _Doomed(msg)
+        return ok
+
+    def finish(i: int) -> ResiliencePlan:
+        timings["total_s"] = time.perf_counter() - t_start
+        return ResiliencePlan(
+            True, i, k, quantile, "Success!",
+            probes=probes, sweep=sweeps.get(i), timings=timings,
+        )
+
+    def fail(msg: str) -> ResiliencePlan:
+        timings["total_s"] = time.perf_counter() - t_start
+        return ResiliencePlan(
+            False, max_new_nodes, k, quantile, msg, probes=probes,
+            sweep=None, timings=timings,
+        )
+
+    fail_msg = (
+        f"we have added {max_new_nodes} nodes but the workloads still do "
+        f"not survive {fault_spec} failures!!"
+    )
+    t0 = time.perf_counter()
+    try:
+        if probe(0):
+            timings["search"] = time.perf_counter() - t0
+            return finish(0)
+        if new_node is None:
+            timings["search"] = time.perf_counter() - t0
+            rec = probes[0]
+            return fail(
+                "cluster does not survive the failure model "
+                f"({rec['survived']}/{rec['scenarios']} scenarios place fully, "
+                f"{rec['base_unplaced']} pods unplaced before any failure)"
+            )
+
+        if search == "linear":
+            for i in range(1, max_new + 1):
+                if probe(i):
+                    timings["search"] = time.perf_counter() - t0
+                    return finish(i)
+            timings["search"] = time.perf_counter() - t0
+            return fail(fail_msg)
+
+        # doubling probe then bisection (survivability capacity-monotone,
+        # the plan_capacity scaffolding; see the module docstring's
+        # sampling caveat)
+        hi = None
+        cand = 1
+        while cand <= max_new:
+            if probe(cand):
+                hi = cand
+                break
+            cand *= 2
+        if hi is None:
+            if max_new >= 1 and max_new not in probes and probe(max_new):
+                hi = max_new
+            else:
+                timings["search"] = time.perf_counter() - t0
+                return fail(fail_msg)
+        lo = max(
+            [i for i in probes if i < hi and not _passed(probes[i], quantile)],
+            default=0,
+        )
+        while hi - lo > 1:
+            mid = (lo + hi) // 2
+            if probe(mid):
+                hi = mid
+            else:
+                lo = mid
+    except _Doomed as exc:
+        timings["search"] = time.perf_counter() - t0
+        return fail(str(exc))
+    timings["search"] = time.perf_counter() - t0
+    return finish(hi)
+
+
+def _passed(rec: Dict[str, int], quantile: float) -> bool:
+    if rec["base_unplaced"] or not rec["scenarios"]:
+        return False
+    return rec["survived"] / rec["scenarios"] >= quantile - 1e-12

@@ -186,8 +186,11 @@ def synth_cluster(
     taint_frac: float = 0.1,
     gpu_frac: float = 0.0,
     storage_frac: float = 0.0,
+    racks_per_zone: int = 4,
 ) -> ResourceTypes:
-    """A cluster of `n_nodes` heterogeneous nodes across `zones` zones."""
+    """A cluster of `n_nodes` heterogeneous nodes across `zones` zones,
+    each node also labeled with a rack failure domain nested in its zone
+    (`simtpu.io/rack`, the key `simtpu/faults` domain scenarios target)."""
     rng = np.random.default_rng(seed)
     nodes = []
     for i in range(n_nodes):
@@ -221,6 +224,15 @@ def synth_cluster(
         nodes.append(
             make_node(f"node-{i:06d}", cpu, mem, labels, taints, gpu, storage, devices)
         )
+    if racks_per_zone > 0:
+        # rack failure-domain labels, drawn AFTER the whole per-node stream
+        # so every pre-existing seed's draws (and the placements/fuzz
+        # scenarios pinned to them) are unchanged — append-only RNG use
+        rack_of = rng.integers(racks_per_zone, size=n_nodes)
+        for i, node in enumerate(nodes):
+            node["metadata"]["labels"]["simtpu.io/rack"] = (
+                f"zone-{i % zones}-rack-{int(rack_of[i])}"
+            )
     res = ResourceTypes()
     res.nodes = nodes
     if storage_frac > 0:

@@ -1,0 +1,326 @@
+"""Fault-injection subsystem tests (simtpu/faults, plan/resilience).
+
+The load-bearing pin (ISSUE 4 acceptance): an exhaustive single-node
+failure sweep through the batched scenario engine produces, for EVERY
+scenario, the identical unplaced-pod set as the serial replay (drain the
+node via the batch-delta API, rerun placement, undo).  Plus the satellite
+properties: failure-free drains are strict no-ops, drained pods never
+land on masked nodes (fuzzed over synth seeds), scenario generation is
+deterministic, rack labels ride synth_cluster without disturbing
+pre-existing RNG streams, and the sweep shards over the test mesh with
+identical results.
+"""
+
+import numpy as np
+import pytest
+
+from simtpu import constants as C
+from simtpu.faults import (
+    domain_scenarios,
+    drain_requeue,
+    drain_simulator,
+    generate_scenarios,
+    k_node_scenarios,
+    parse_fault_spec,
+    place_cluster,
+    serial_replay,
+    single_node_scenarios,
+    stack_scenarios,
+    sweep_scenarios,
+)
+from simtpu.synth import make_node, synth_apps, synth_cluster
+
+
+def _mixed_problem(node_seed=21, app_seed=22, n_nodes=10, n_pods=60):
+    cluster = synth_cluster(
+        n_nodes, seed=node_seed, zones=3, taint_frac=0.1,
+        gpu_frac=0.3, storage_frac=0.3,
+    )
+    apps = synth_apps(
+        n_pods, seed=app_seed, zones=3, pods_per_deployment=10,
+        selector_frac=0.2, toleration_frac=0.1, anti_affinity_frac=0.3,
+        gpu_frac=0.2, storage_frac=0.2,
+    )
+    return cluster, apps
+
+
+@pytest.fixture(scope="module")
+def placed():
+    cluster, apps = _mixed_problem()
+    return cluster, place_cluster(cluster, apps)
+
+
+def _sweep_unplaced_sets(sw):
+    out = []
+    for s in range(len(sw.scenarios)):
+        mask = (sw.requeue_rows[s] >= 0) & (sw.requeue_nodes[s] < 0)
+        out.append(frozenset(int(x) for x in sw.requeue_rows[s][mask]))
+    return out
+
+
+class TestSweepSerialEquivalence:
+    def test_exhaustive_single_node_matches_serial_replay(self, placed):
+        """ISSUE 4 acceptance pin: batched sweep == serial replay on every
+        single-node scenario — same unplaced-pod SETS, not just counts."""
+        cluster, pc = placed
+        scen = single_node_scenarios(pc.n_nodes, nodes=cluster.nodes)
+        sw = sweep_scenarios(pc, scen)
+        counts, sets = serial_replay(pc, scen)
+        assert np.array_equal(sw.unplaced, counts)
+        assert _sweep_unplaced_sets(sw) == sets
+        # the sweep must have actually drained something somewhere
+        assert sw.evicted.sum() > 0
+
+    def test_domain_and_k2_scenarios_match_serial_replay(self, placed):
+        cluster, pc = placed
+        scen = stack_scenarios(
+            [
+                domain_scenarios(cluster.nodes, C.LABEL_ZONE),
+                domain_scenarios(cluster.nodes, C.LABEL_RACK),
+                k_node_scenarios(pc.n_nodes, 2, samples=12, seed=5),
+            ]
+        )
+        sw = sweep_scenarios(pc, scen, s_chunk=8)
+        counts, sets = serial_replay(pc, scen)
+        assert np.array_equal(sw.unplaced, counts)
+        assert _sweep_unplaced_sets(sw) == sets
+
+    def test_sharded_sweep_identical(self, placed):
+        """The mesh-sharded sweep (scenario axis over 'sweep', node axis
+        over 'nodes') must not change one outcome."""
+        from simtpu.parallel import make_mesh
+
+        cluster, pc = placed
+        scen = single_node_scenarios(pc.n_nodes, nodes=cluster.nodes)
+        base = sweep_scenarios(pc, scen)
+        mesh = make_mesh(sweep=2)  # 2-way scenario x 4-way node sharding
+        sharded = sweep_scenarios(pc, scen, mesh=mesh, s_chunk=4)
+        assert np.array_equal(base.unplaced, sharded.unplaced)
+        assert np.array_equal(base.requeue_nodes, sharded.requeue_nodes)
+
+
+class TestDrainProperties:
+    @pytest.mark.parametrize("seed", [0, 23])
+    def test_failure_free_drain_is_noop_and_masks_hold(self, seed):
+        """Fuzz (ISSUE 4 satellite): an empty node mask drains nothing and
+        leaves the engine log bit-identical; non-empty masks never see a
+        drained pod reappear on a failed node, and restore=True returns
+        the log to the base placement."""
+        cluster, apps = _mixed_problem(
+            node_seed=100 + seed, app_seed=200 + seed, n_nodes=8, n_pods=40
+        )
+        pc = place_cluster(cluster, apps)
+        log_before = (
+            list(pc.engine.placed_node),
+            list(pc.engine.placed_group),
+        )
+        # failure-free scenario: strict no-op
+        res = drain_requeue(pc, np.zeros(pc.n_nodes, bool), restore=True)
+        assert len(res.evicted_rows) == 0 and res.unplaced == 0
+        assert list(pc.engine.placed_node) == log_before[0]
+        assert list(pc.engine.placed_group) == log_before[1]
+        assert pc.engine.node_valid is None
+        # and through the batched sweep: an all-False row survives trivially
+        from simtpu.faults.scenarios import ScenarioSet
+
+        empty = ScenarioSet(
+            masks=np.zeros((1, pc.n_nodes), bool), labels=("none",)
+        )
+        sw = sweep_scenarios(pc, empty)
+        assert sw.evicted[0] == 0 and sw.unplaced[0] == 0
+        # non-empty masks: requeued placements avoid every failed node
+        rng = np.random.default_rng(seed)
+        for _ in range(3):
+            mask = np.zeros(pc.n_nodes, bool)
+            mask[rng.choice(pc.n_nodes, size=2, replace=False)] = True
+            out = drain_requeue(pc, mask, restore=True)
+            landed = out.requeue_nodes[out.requeue_nodes >= 0]
+            assert not mask[landed].any(), "drained pod reappeared on a failed node"
+            assert list(pc.engine.placed_node) == log_before[0]
+
+    def test_restore_leaves_sweep_reproducible(self, placed):
+        """After serial replays (drain+undo cycles) the batched sweep still
+        reproduces its own results — the undo path restores the carried
+        state the sweep reads."""
+        cluster, pc = placed
+        scen = single_node_scenarios(pc.n_nodes, nodes=cluster.nodes)
+        first = sweep_scenarios(pc, scen)
+        serial_replay(pc, scen, limit=3)
+        second = sweep_scenarios(pc, scen)
+        assert np.array_equal(first.unplaced, second.unplaced)
+        assert np.array_equal(first.requeue_nodes, second.requeue_nodes)
+
+
+class TestDrainSimulator:
+    def test_preemption_honors_fault_mask(self):
+        """Facade-level drain requeues through the full api.py flow; no pod
+        of the final result sits on a failed node (including preemption
+        landings), and DaemonSet pods die with the node."""
+        from simtpu.api import Simulator
+        from simtpu.core.objects import ResourceTypes, name_of
+        from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
+
+        from tests.fixtures import make_fake_pod, with_pod_node_name
+
+        cluster, apps = _mixed_problem(n_nodes=6, n_pods=30)
+        sim = Simulator()
+        work = ResourceTypes(**{k: list(v) for k, v in vars(cluster).items()})
+        work.pods = get_valid_pods_exclude_daemonset(work)
+        # statically bound pods die with their node like DaemonSet pods
+        bound = [
+            make_fake_pod(
+                f"bound-{i}", "default", "100m", "64Mi",
+                with_pod_node_name(f"node-{i:06d}"),
+            )
+            for i in range(6)
+        ]
+        work.pods += bound
+        sim.run_cluster(work)
+        for app in apps:
+            sim.schedule_app(app)
+        # fail the node hosting the most pods, so the drain is non-trivial
+        counts = np.bincount(
+            np.asarray(sim._engine.placed_node), minlength=len(cluster.nodes)
+        )
+        target = int(np.argmax(counts))
+        mask = np.zeros(len(cluster.nodes), bool)
+        mask[target] = True
+        unsched_before = len(sim._unscheduled)
+        res = drain_simulator(sim, mask)
+        assert len(res.evicted_rows) > 0
+        final = sim._result()
+        failed_name = name_of(cluster.nodes[target])
+        for status in final.node_status:
+            if name_of(status.node) == failed_name:
+                assert status.pods == [], "pods still on the failed node"
+        # the bound pod of the failed node died with it: not re-placed
+        # anywhere, not reported unschedulable
+        bound_names = {f"bound-{target}"}
+        placed_names = {
+            name_of(p) for s in final.node_status for p in s.pods
+        }
+        assert not (bound_names & placed_names)
+        assert all(
+            name_of(u.pod) not in bound_names
+            for u in sim._unscheduled[unsched_before:]
+        )
+        # the engine keeps the mask: later batches also avoid the node
+        assert sim._engine.node_valid is not None
+        assert not sim._engine.node_valid[target]
+
+
+class TestScenarioModel:
+    def test_k_scenarios_deterministic_and_distinct(self):
+        a = k_node_scenarios(40, 2, samples=16, seed=3)
+        b = k_node_scenarios(40, 2, samples=16, seed=3)
+        assert np.array_equal(a.masks, b.masks)
+        assert len(a) == 16
+        assert len({m.tobytes() for m in a.masks}) == 16
+        assert (a.masks.sum(axis=1) == 2).all()
+        c = k_node_scenarios(40, 2, samples=16, seed=4)
+        assert not np.array_equal(a.masks, c.masks)
+
+    def test_k_exhaustive_when_budget_allows(self):
+        s = k_node_scenarios(6, 2, samples=100, seed=0)
+        assert len(s) == 15  # C(6, 2)
+
+    def test_parse_spec(self):
+        terms = parse_fault_spec("k=1,k=3:50,zone,label:foo/bar")
+        assert terms[0] == {"kind": "k", "k": 1, "samples": None}
+        assert terms[1] == {"kind": "k", "k": 3, "samples": 50}
+        assert terms[2] == {"kind": "domain", "key": C.LABEL_ZONE}
+        assert terms[3] == {"kind": "domain", "key": "foo/bar"}
+        with pytest.raises(ValueError):
+            parse_fault_spec("bogus")
+
+    def test_domain_scenarios_cover_all_labeled_nodes(self):
+        cluster = synth_cluster(12, seed=9, zones=3)
+        zones = domain_scenarios(cluster.nodes, C.LABEL_ZONE)
+        assert len(zones) == 3
+        assert zones.masks.any(axis=0).all()  # every node is in some zone
+        racks = domain_scenarios(cluster.nodes, C.LABEL_RACK)
+        assert len(racks) >= 3
+        # racks nest within zones: each rack mask stays inside one zone mask
+        for rm in racks.masks:
+            assert any((rm & ~zm).sum() == 0 for zm in zones.masks)
+
+    def test_generate_valid_restriction(self):
+        cluster = synth_cluster(8, seed=9, zones=2)
+        valid = np.zeros(8, bool)
+        valid[:5] = True
+        scen = generate_scenarios(cluster.nodes, "k=1", valid=valid)
+        assert len(scen) == 5
+        assert not scen.masks[:, 5:].any()
+
+
+class TestSynthRackSatellite:
+    def test_rack_labels_present_and_stream_preserving(self):
+        """Rack labels are stamped on every node, and their RNG draws are
+        APPEND-ONLY: every other node field is identical with racks on or
+        off (pre-existing seeds' streams — and the tests pinned to them —
+        unchanged)."""
+        with_racks = synth_cluster(20, seed=5, zones=4, taint_frac=0.3,
+                                   gpu_frac=0.3, storage_frac=0.3)
+        without = synth_cluster(20, seed=5, zones=4, taint_frac=0.3,
+                                gpu_frac=0.3, storage_frac=0.3,
+                                racks_per_zone=0)
+        for a, b in zip(with_racks.nodes, without.nodes):
+            labels_a = dict(a["metadata"]["labels"])
+            rack = labels_a.pop(C.LABEL_RACK)
+            assert rack.startswith(labels_a[C.LABEL_ZONE])
+            assert labels_a == b["metadata"]["labels"]
+            assert a["spec"] == b["spec"]
+            assert a["status"] == b["status"]
+            assert a["metadata"]["annotations"] == b["metadata"]["annotations"]
+
+
+class TestPlanResilience:
+    def test_plans_enough_nodes_to_survive_any_single_failure(self):
+        """A cluster sized to just fit its pods needs extra nodes to
+        survive k=1; the plan finds a count whose sweep fully survives."""
+        from simtpu.plan.resilience import plan_resilience
+
+        nodes = [
+            make_node(
+                f"n{i}", 8000, 32,
+                {"kubernetes.io/hostname": f"n{i}",
+                 "topology.kubernetes.io/zone": "zone-a"},
+            )
+            for i in range(4)
+        ]
+        from simtpu.core.objects import AppResource, ResourceTypes
+        from simtpu.synth import make_deployment
+
+        cluster = ResourceTypes()
+        cluster.nodes = nodes
+        res = ResourceTypes()
+        res.deployments.append(make_deployment("web", 28, 1000, 256))
+        apps = [AppResource(name="web", resource=res)]
+        template = make_node(
+            "tmpl", 8000, 32,
+            {"kubernetes.io/hostname": "tmpl",
+             "topology.kubernetes.io/zone": "zone-a"},
+        )
+        plan = plan_resilience(
+            cluster, apps, template, k=1, max_new_nodes=8, seed=1
+        )
+        assert plan.success
+        assert plan.nodes_added >= 1
+        assert plan.sweep is not None and bool(plan.sweep.survived.all())
+        # candidate 0 was probed and failed (28 pods fill 3 nodes' worth)
+        assert plan.probes[0]["survived"] < plan.probes[0]["scenarios"]
+
+    def test_assess_only_mode(self, placed):
+        from simtpu.plan.resilience import plan_resilience
+
+        cluster, _pc = placed
+        apps = synth_apps(
+            60, seed=22, zones=3, pods_per_deployment=10,
+            selector_frac=0.2, toleration_frac=0.1, anti_affinity_frac=0.3,
+            gpu_frac=0.2, storage_frac=0.2,
+        )
+        plan = plan_resilience(cluster, apps, None, k=1)
+        assert plan.nodes_added in (0, C.MAX_NUM_NEW_NODE)
+        assert 0 in plan.probes
+        counters = plan.counters()
+        assert "plan_resilience_s" in counters
