@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all install lint test test-all test-perf bench bench-cold bench-faults clean
+.PHONY: all install lint test test-all test-perf bench bench-cold bench-faults bench-layout clean
 
 all: test
 
@@ -60,6 +60,20 @@ bench-faults:
 	SIMTPU_BENCH_FAULTS=1 SIMTPU_BENCH_NODES=2000 SIMTPU_BENCH_PODS=20000 \
 	SIMTPU_BENCH_SMALL=0 SIMTPU_BENCH_HARD=0 SIMTPU_BENCH_MATRIX=0 \
 	SIMTPU_BENCH_PLAN=0 SIMTPU_BENCH_BIG=0 $(PY) bench.py
+
+# carried-state layout smoke at a small shape (mirrors bench-cold): the
+# compact-vs-dense A/B point alone, ASSERTING bit-identical placements and
+# a >= 2x carried-byte reduction on the multi-domain synthetic cluster —
+# state_bytes / state_bytes_dense / state_compact_ratio land in the JSON
+# line (CI runs this alongside lint + the fast tier)
+bench-layout:
+	SIMTPU_BENCH_LAYOUT=1 SIMTPU_BENCH_LAYOUT_ASSERT=1 \
+	SIMTPU_BENCH_LAYOUT_NODES=2000 SIMTPU_BENCH_LAYOUT_PODS=20000 \
+	SIMTPU_BENCH_NODES=500 SIMTPU_BENCH_PODS=2000 \
+	SIMTPU_BENCH_SCAN_PODS=200 SIMTPU_BENCH_BASELINE_PODS=50 \
+	SIMTPU_BENCH_SMALL=0 SIMTPU_BENCH_HARD=0 SIMTPU_BENCH_MATRIX=0 \
+	SIMTPU_BENCH_PLAN=0 SIMTPU_BENCH_BIG=0 SIMTPU_BENCH_FAULTS=0 \
+	$(PY) bench.py
 
 clean:
 	rm -rf build dist *.egg-info simtpu/native/_build

@@ -61,7 +61,15 @@ force/skip the fault-injection point (default: north-star runs only;
 `make bench-faults` = the small-shape smoke), SIMTPU_BENCH_PRECOMPILE=0/1
 to force the background AOT precompile pipeline off/on (unset = auto: on
 for accelerator backends; `make bench-cold` runs a small-shape cold-start
-smoke with the persistent cache off).
+smoke with the persistent cache off), SIMTPU_BENCH_LAYOUT=1/0 to force/skip
+the carried-state layout A/B point (`state_bytes` vs `state_bytes_dense`,
+SIMTPU_COMPACT A/B, `make bench-layout` = the small-shape asserting smoke).
+
+Byte telemetry rides every run: `fetch_bytes` (device→host payload of one
+warm placement, next to the `fetches` round-trip count),
+`engine_state_bytes` (the carried scheduling state under the active
+layout, per-plane gauge via engine/state.py `state_gauge`), and
+`device_peak_bytes` (accelerator memory_stats high-water; None on CPU).
 """
 
 from __future__ import annotations
@@ -276,10 +284,12 @@ def time_bulk(tensors, batch, precompile: bool = False):
             # path's own cache, so a pipeline-less rerun would recompile
             eng.pipeline = pipe
         t_dispatch = time.perf_counter()
-        f0 = fetch_counts()["get"]
+        f0 = fetch_counts()
         nodes, reasons, _ = eng.place(batch)
         run_s = time.perf_counter() - t0
-        extra["fetches"] = fetch_counts()["get"] - f0
+        f1 = fetch_counts()
+        extra["fetches"] = f1["get"] - f0["get"]
+        extra["fetch_bytes"] = f1["bytes"] - f0["bytes"]
         note(f"bulk run {i}: {run_s:.1f}s")
         if cold is None:
             cold = run_s
@@ -337,6 +347,108 @@ def big_point() -> dict:
         "big_point_s": round(wall, 2),
         "big_point_nodes": 400_000,
         "big_point_placed": placed,
+    }
+
+
+def device_peak_bytes():
+    """Accelerator peak-memory high-water (jax memory_stats), None on
+    backends that publish none (CPU) — the on-device half of the byte
+    telemetry next to `state_bytes` and `fetch_bytes`."""
+    import jax
+
+    try:
+        stats = jax.devices()[0].memory_stats()
+    except (RuntimeError, AttributeError):
+        return None
+    if not stats:
+        return None
+    return stats.get("peak_bytes_in_use")
+
+
+def layout_point() -> dict:
+    """Carried-state layout A/B (ISSUE 5): the same multi-domain synthetic
+    problem placed twice through the rounds engine — once carrying the
+    domain-tabular CompactState between dispatches, once carrying dense
+    SchedState — pinning bit-identical placements and reporting the carried
+    byte reduction (`state_bytes` vs `state_bytes_dense`) plus the warm
+    placement walls for the throughput-no-worse check.  Zones x racks plus
+    zone spread/anti-affinity make most topology keys small-domain (the
+    representative 'multi-domain' shape); hostname selector-spread rows
+    stay dense by design.  Env: SIMTPU_BENCH_LAYOUT_NODES (default 20000) /
+    SIMTPU_BENCH_LAYOUT_PODS (default 100000);
+    SIMTPU_BENCH_LAYOUT_ASSERT=1 (the `make bench-layout` smoke) fails the
+    run unless the carry shrank >= 2x."""
+    from simtpu.core.tensorize import Tensorizer
+    from simtpu.engine.rounds import RoundsEngine
+    from simtpu.engine.state import state_gauge
+    from simtpu.synth import synth_apps, synth_cluster
+    from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
+
+    n_nodes = int(os.environ.get("SIMTPU_BENCH_LAYOUT_NODES", 20_000))
+    n_pods = int(os.environ.get("SIMTPU_BENCH_LAYOUT_PODS", 100_000))
+    note(f"layout point: {n_nodes} nodes x {n_pods} pods, compact-carry A/B")
+    cluster = synth_cluster(
+        n_nodes, seed=21, zones=16, racks_per_zone=8, taint_frac=0.1
+    )
+    # domain-keyed constraint mix: zone spread + zone affinity on most
+    # groups, NO hostname anti-affinity — hostname rows (SelectorSpread's
+    # per-host term) are unique-per-node and stay dense by design, so this
+    # measures the tabular win on the rows that can compress
+    apps = synth_apps(
+        n_pods, seed=22, zones=16, pods_per_deployment=500,
+        selector_frac=0.2, toleration_frac=0.1, anti_affinity_frac=0.0,
+        spread_frac=0.8, affinity_frac=0.5,
+    )
+    pods = []
+    for app in apps:
+        pods.extend(get_valid_pods_exclude_daemonset(app.resource))
+
+    def run(compact: bool):
+        """(warm wall, nodes, gauge) — best of two fresh-engine runs, the
+        same steady-state protocol as time_bulk."""
+        best, nodes, gauge = float("inf"), None, None
+        for _ in range(2):
+            tz = Tensorizer(
+                cluster.nodes, storage_classes=cluster.storage_classes
+            )
+            eng = RoundsEngine(tz)
+            eng.compact = compact
+            batch = tz.add_pods(pods)
+            t0 = time.perf_counter()
+            nodes, _, _ = eng.place(batch)
+            best = min(best, time.perf_counter() - t0)
+            gauge = state_gauge()
+        return best, nodes, gauge
+
+    compact_s, compact_nodes, g = run(True)
+    dense_s, dense_nodes, _ = run(False)
+    if not np.array_equal(compact_nodes, dense_nodes):
+        note("WARNING: compact-carry placements diverged from dense")
+    ratio = g["dense_bytes"] / max(g["carried_bytes"], 1)
+    note(
+        f"layout: carried {g['carried_bytes']} B compact vs "
+        f"{g['dense_bytes']} B dense ({ratio:.2f}x); warm wall "
+        f"{compact_s:.2f}s compact vs {dense_s:.2f}s dense"
+    )
+    top = sorted(g["planes"].items(), key=lambda kv: -kv[1])[:4]
+    note("layout: largest carried planes: " + ", ".join(
+        f"{name}={b}" for name, b in top
+    ))
+    if os.environ.get("SIMTPU_BENCH_LAYOUT_ASSERT", "0") == "1":
+        assert np.array_equal(compact_nodes, dense_nodes), (
+            "compact-carry placements must be bit-identical to dense"
+        )
+        assert ratio >= 2.0, (
+            f"carried-state bytes shrank only {ratio:.2f}x (< 2x) on the "
+            "multi-domain synthetic cluster"
+        )
+    return {
+        "layout_nodes": n_nodes,
+        "state_bytes": g["carried_bytes"],
+        "state_bytes_dense": g["dense_bytes"],
+        "state_compact_ratio": round(ratio, 2),
+        "layout_compact_s": round(compact_s, 2),
+        "layout_dense_s": round(dense_s, 2),
     }
 
 
@@ -583,6 +695,7 @@ def main() -> int:
     ) = build_problem(n_nodes, n_pods)
 
     from simtpu.engine.scan import flags_from, wave_counts
+    from simtpu.engine.state import state_gauge as _state_gauge
 
     precompile = _bench_precompile()
     note("problem built; timing scan slice (pod-at-a-time floor)")
@@ -670,6 +783,14 @@ def main() -> int:
         "compile_serial_s": cold_extra.get("compile_serial_s"),
         "precompile": precompile,
         "fetches": cold_extra.get("fetches"),
+        # byte-level transfer + residency telemetry (ISSUE 5): device→host
+        # payload of one warm placement, the carried-state layout in effect
+        # and its per-plane gauge, and the accelerator's peak residency
+        # (None on CPU backends, which publish no memory_stats)
+        "fetch_bytes": cold_extra.get("fetch_bytes"),
+        "compact": _state_gauge()["compact"],
+        "engine_state_bytes": _state_gauge()["carried_bytes"],
+        "device_peak_bytes": device_peak_bytes(),
         "compilation_cache": bool(cache_dir),
         # exact-scan throughput: the pod-at-a-time floor vs the speculative
         # wavefront dispatcher on the same slice (bit-identical placements;
@@ -716,11 +837,24 @@ def main() -> int:
         except Exception as exc:  # noqa: BLE001 - report, keep the line
             note(f"fault point failed: {type(exc).__name__}: {exc}")
             record["fault_error"] = f"{type(exc).__name__}: {exc}"
+    # carried-state layout A/B (ISSUE 5): on by default at north-star runs,
+    # SIMTPU_BENCH_LAYOUT=1 forces it at any configuration (`make
+    # bench-layout` = the small-shape asserting smoke), =0 skips
+    layout_env = os.environ.get("SIMTPU_BENCH_LAYOUT", "")
+    if layout_env != "0" and (north_star or layout_env == "1"):
+        try:
+            record.update(layout_point())
+        except Exception as exc:  # noqa: BLE001 - report, keep the line
+            note(f"layout point failed: {type(exc).__name__}: {exc}")
+            record["layout_error"] = f"{type(exc).__name__}: {exc}"
     print(json.dumps(record))
-    # a failed plan/big/fault phase keeps the placement record but signals
-    # the failure through the exit status (drivers record both)
+    # a failed plan/big/fault/layout phase keeps the placement record but
+    # signals the failure through the exit status (drivers record both)
     return 1 if any(
-        key in record for key in ("plan_error", "big_point_error", "fault_error")
+        key in record
+        for key in (
+            "plan_error", "big_point_error", "fault_error", "layout_error"
+        )
     ) else 0
 
 

@@ -92,6 +92,29 @@ _UNSCHEDULABLE_TAINT = {"key": "node.kubernetes.io/unschedulable", "effect": "No
 # route (hostname) or the scatter fallback. Shared with engine/rounds.py.
 DOM_SMALL = 64
 
+# -- carried-state dtype policy (THE conversion boundary) -------------------
+#
+# Every count-like plane of the carried scheduling state (topology counts,
+# interpod owner counts/weights, port and volume user counts) holds small
+# integers by construction: placements bump them by ±1 or by integer k8s
+# preference weights, so the values are exact in int32 AND in float32 (below
+# 2^24).  The layout policy (docs/memory.md, "state layout" table) is:
+#
+#   carried/boundary form (engine/state.py CompactState): COUNT_DTYPE —
+#     integer, the honest dtype; crossing a dispatch boundary or the wire in
+#     this form costs no precision and keeps regrouped sums bit-stable.
+#   in-kernel form (SchedState inside a dispatch): float32 — the one-hot
+#     matmul row gathers (state.take_rows) and the scoring kernels are
+#     float pipelines; int-valued f32 arithmetic on counts is exact, so the
+#     f32 <-> COUNT_DTYPE casts at expand/compress are bit-clean round trips.
+#   boolean planes (sdev_free, node_valid, every feasibility mask):
+#     MASK_DTYPE end to end — never widened to float.
+#
+# This block is the single place the policy lives; engine/state.py imports
+# these names rather than restating dtypes at each conversion site.
+COUNT_DTYPE = np.int32
+MASK_DTYPE = np.bool_
+
 
 # ---------------------------------------------------------------------------
 # Node-side vectorized label algebra

@@ -50,14 +50,22 @@ from ..kernels.scores import (
 )
 from ..kernels.storage import device_plan, lvm_plan, open_local_score
 from .state import (
+    CompactState,
     SchedState,
     add_rows,
     apply_placement_deltas,
     build_state,
+    compact_enabled,
+    compact_spec,
+    compress_state,
+    expand_state,
     interpod_term_index,
+    node_dom_small_for,
     pack_delta_entries,
+    state_nbytes,
     take_rows,
     take_rows_i32,
+    update_state_gauge,
 )
 
 # Failure-reason codes (host maps to messages mirroring the scheduler's
@@ -98,22 +106,32 @@ def trace_counts() -> dict:
     return dict(TRACE_COUNTS)
 
 
-# Blocking device→host fetch counter: every engine-path jax.device_get goes
+# Blocking device→host fetch counters: every engine-path jax.device_get goes
 # through fetch_outputs, so the bench can report how many tunnel round-trips
 # a placement paid (each costs fixed wire latency regardless of payload —
-# the matrix point's measured floor, docs/status.md).
-FETCH_COUNTS = {"get": 0}
+# the matrix point's measured floor, docs/status.md) AND how many bytes they
+# moved ("bytes" — the payload-side of the transfer audit; with it, a
+# regression that grows the fetched tree shows up even when the round-trip
+# count stays flat).
+FETCH_COUNTS = {"get": 0, "bytes": 0}
 
 
 def fetch_outputs(tree):
-    """jax.device_get with round-trip accounting (one bump per blocking
-    fetch, however much data it moves)."""
+    """jax.device_get with round-trip + byte accounting (one "get" bump per
+    blocking fetch; "bytes" sums the materialized host payload)."""
     FETCH_COUNTS["get"] += 1
-    return jax.device_get(tree)
+    out = jax.device_get(tree)
+    FETCH_COUNTS["bytes"] += sum(
+        leaf.nbytes
+        for leaf in jax.tree_util.tree_leaves(out)
+        if hasattr(leaf, "nbytes")
+    )
+    return out
 
 
 def fetch_counts() -> dict:
-    """Snapshot of the blocking-fetch counter."""
+    """Snapshot of the blocking-fetch counters ("get" round-trips, "bytes"
+    of fetched payload — both monotone over a process)."""
     return dict(FETCH_COUNTS)
 
 
@@ -2280,6 +2298,12 @@ class Engine:
         #: are bit-identical on or off; SIMTPU_WAVEFRONT=0 flips the
         #: default for A/B measurement.
         self.speculate = wave_enabled()
+        #: carry the between-dispatch state in the domain-tabular compact
+        #: layout (engine/state.py CompactState): kind-1 topology keys'
+        #: count rows as [Rt, D] histograms, integer dtypes.  Placements
+        #: are bit-identical on or off (expansion is one exact gather);
+        #: SIMTPU_COMPACT=0 flips the default for A/B measurement.
+        self.compact = compact_enabled()
         #: optional [N] host bool mask — False rows are out of this
         #: engine's cluster (failed nodes under fault injection,
         #: simtpu/faults/drain.py).  ANDed into statics.node_valid at every
@@ -2360,6 +2384,87 @@ class Engine:
         node axis to the shard multiple (parallel/sharded.py)."""
         return statics_sds, state_sds
 
+    # -- compact carried-state plumbing ----------------------------------
+    # The carry that crosses place() boundaries (and preemption's delta
+    # path) travels domain-tabular (engine/state.py section comment);
+    # dispatch loops always see the dense SchedState, expanded by one
+    # jitted gather.  The sharded engines override _compress_call /
+    # _expand_call with mesh-sharded variants so the carried compact
+    # planes keep their node-axis layout between batches.
+
+    def _active_compact_spec(self, tensors):
+        """The compaction plan when this engine should carry compact state
+        (None = carry dense: the A/B switch is off, or no topology key is
+        tabular so there is nothing to compact)."""
+        if not self.compact:
+            return None
+        spec = compact_spec(tensors)
+        return spec if spec.enabled else None
+
+    def _compress_call(self, spec_dev, state):
+        return compress_state(spec_dev, state)
+
+    def _expand_call(self, spec_dev, cstate, nds):
+        return expand_state(spec_dev, cstate, nds)
+
+    def _expand_carry(self, tensors, cstate: CompactState) -> SchedState:
+        """Dense view of a compact carry (padded node_dom_small follows the
+        carry's own node axis — sharded carries stay shard-padded)."""
+        spec = compact_spec(tensors)
+        return self._expand_call(
+            spec.dev, cstate, node_dom_small_for(tensors, cstate.free.shape[0])
+        )
+
+    def _store_state(self, tensors, final_state: SchedState):
+        """Compress (when active) and gauge the carry place() stores."""
+        dense_bytes = sum(state_nbytes(final_state).values())
+        spec = self._active_compact_spec(tensors)
+        stored = (
+            final_state
+            if spec is None
+            else self._compress_call(spec.dev, final_state)
+        )
+        update_state_gauge(stored, dense_bytes)
+        return stored
+
+    def carried_state(self) -> SchedState:
+        """The engine's carried state in dense SchedState form (read-only
+        peek: expansion never donates the carry).  Consumers that thread
+        the carry into their own dispatches — the fault sweep's base
+        state, direct delta tests — go through this instead of touching
+        last_state, whose representation is a layout choice."""
+        state = self.last_state
+        if state is not None and self._state_dirty:
+            # a dispatch failed mid-flight: a dense carry may already be
+            # donated (dead buffers — reading them is an opaque
+            # deleted-array error deep in the consumer), and even an
+            # intact compact carry no longer reflects the log; fail at
+            # the API with the actual precondition instead
+            raise ValueError(
+                "carried_state(): a dispatch failed after the carry was "
+                "handed to it, so the carry is invalidated (dense layouts "
+                "donate it outright); place() again (which rebuilds from "
+                "the placement log) before reading it"
+            )
+        if state is None:
+            return state
+        tensors = self.tensorizer.freeze()
+        if self._last_vocab != self.state_vocab(tensors):
+            # a compact carry's domain partition is keyed to the vocabulary
+            # it was compressed under — expanding against re-frozen tensors
+            # with new terms would gather with mismatched index shapes; a
+            # dense carry would merely read stale, but raising only under
+            # one layout would let the SIMTPU_COMPACT A/B change API
+            # behavior for the same caller mistake, so both refuse
+            raise ValueError(
+                "carried_state(): the carry predates a vocabulary change "
+                "(add_pods interned new terms/groups); place() the pending "
+                "batch first, or rebuild from the placement log"
+            )
+        if isinstance(state, CompactState):
+            state = self._expand_carry(tensors, state)
+        return state
+
     def _scan_call(self, statics, state, seg, flags):
         """Dispatch one compiled scan segment — through the precompile
         pipeline's registry when one is attached, else the plain jit."""
@@ -2429,6 +2534,11 @@ class Engine:
             and self._last_vocab == vocab
         ):
             state = self.last_state
+            if isinstance(state, CompactState):
+                # one-gather expansion back to the dense in-kernel form;
+                # the compact carry itself is NOT donated, so a failed
+                # dispatch below leaves it intact for the log fallback
+                state = self._expand_carry(tensors, state)
         else:
             state = build_state(
                 tensors,
@@ -2454,7 +2564,12 @@ class Engine:
         final_state, (nodes, reasons, lvm_alloc, dev_take, gpu_shares) = self._dispatch(
             statics, state, pods, flags
         )
-        self.last_state = final_state
+        # the dense final state simply goes unreferenced after this call —
+        # compression deliberately does NOT donate it (int32 outputs cannot
+        # alias f32 inputs; see the audit note on compress_state); what is
+        # stored — and what every later expansion reproduces bit-identically
+        # — is the domain-tabular carry
+        self.last_state = self._store_state(tensors, final_state)
         # cache bookkeeping only after a successful dispatch: a failed run
         # must not leave the reuse branch validating a stale/donated state
         self._last_vocab = vocab
@@ -2511,7 +2626,18 @@ class Engine:
             sign,
         )
         statics = statics_from(tensors, self.sched_config)
-        self.last_state = _apply_log_delta(statics, self.last_state, packed)
+        state = self.last_state
+        if isinstance(state, CompactState):
+            state = self._expand_carry(tensors, state)
+        # a DENSE carry is donated to the delta dispatch below (the compact
+        # branch only donates its fresh expansion); mirror place()'s guard
+        # so a failure mid-delta forces the from-log rebuild instead of a
+        # later dispatch on a deleted buffer
+        self._state_dirty = True
+        self.last_state = self._store_state(
+            tensors, _apply_log_delta(statics, state, packed)
+        )
+        self._state_dirty = False
 
     def remove_placements(self, indices: List[int]) -> dict:
         """Delete log entries at `indices`; returns an undo token."""

@@ -9,13 +9,15 @@ state transition (SURVEY.md §2.3).
 
 from __future__ import annotations
 
+import os
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.tensorize import COUNT_DTYPE, MASK_DTYPE
 from ..native import scatter_add_rows
 
 # plane height up to which the one-hot matmul forms pay: the matmul touches
@@ -438,3 +440,320 @@ def pack_delta_entries(entries, n_resources: int, vg_w: int, sd_w: int, gd_w: in
         sd_a[i] = sdev
         gp_a[i] = np.asarray(gpu_sh) * gpu_mem
     return (g_a, n_a, w_a, req_a, vg_a, sd_a, gp_a)
+
+
+# -- compact carried state ---------------------------------------------------
+#
+# The carried count planes are [T, N] / [Ti, N] dense float32, but for every
+# topology key with a small domain cardinality (key_kind == 1: zone / rack /
+# region-sized keys, ≤ DOM_SMALL compact ids in node_dom_small) the per-node
+# value is CONSTANT within a domain — cnt[t, n] is "matching pods in node n's
+# domain", the same number for every node of the domain and 0 where the key
+# is absent.  Those rows carry D_key ≤ DOM_SMALL numbers of information in N
+# floats.  Between dispatches the state therefore travels in a domain-TABULAR
+# form (CompactState): kind-1 term rows as [Rt, D] histograms indexed by
+# node_dom_small, dense [N] rows only for unique-per-node keys (kind 2,
+# where the row IS the information) and the scatter fallback (kind 0), with
+# integer planes narrowed to COUNT_DTYPE (the conversion boundary documented
+# in core/tensorize.py).  Expansion back to per-node form is ONE gather
+# inside a jitted kernel (expand_state), so every filter/score/tie-break
+# consumer sees bit-identical float32 planes; compression is a gather of one
+# representative node per (key, domain) — no reduction, hence exact by
+# construction (pinned by tests/test_compact.py round trips).
+#
+# SIMTPU_COMPACT=0 flips the engines back to carrying dense SchedState
+# between dispatches — placements are bit-identical either way; the switch
+# exists for A/B measurement (bench.py `state_bytes` / `make bench-layout`).
+
+
+def compact_enabled() -> bool:
+    """Default for Engine.compact: SIMTPU_COMPACT=0 disables the compact
+    carried-state layout (1/unset = on)."""
+    return os.environ.get("SIMTPU_COMPACT", "1") != "0"
+
+
+class CompactSpecDev(NamedTuple):
+    """Device-resident index arrays driving compress/expand (constant per
+    tensors; memoized alongside the host spec)."""
+
+    t_tab: jnp.ndarray  # [Rt] cnt_match rows with a kind-1 (tabular) key
+    t_dense: jnp.ndarray  # [Rd] the rest (kind 0/2) — Rt + Rd == T
+    t_keys: jnp.ndarray  # [Rt] topology key per tabular row
+    t_rep: jnp.ndarray  # [Rt, D] representative node per domain (-1 none)
+    ip_tab: jnp.ndarray  # [Rti] interpod-plane rows with a kind-1 key
+    ip_dense: jnp.ndarray  # [Rdi] — Rti + Rdi == Ti
+    ip_keys: jnp.ndarray  # [Rti]
+    ip_rep: jnp.ndarray  # [Rti, D]
+
+
+class CompactSpec(NamedTuple):
+    """Host-side compaction plan for one frozen tensors object."""
+
+    enabled: bool  # any tabular row exists (else carry dense SchedState)
+    d: int  # histogram width (max small-domain count over kind-1 keys)
+    dev: Optional[CompactSpecDev]
+
+
+def compact_spec(tensors) -> CompactSpec:
+    """The (memoized) compaction plan: partition the cnt_match and interpod
+    plane rows by their topology key's reduction kind, and precompute one
+    representative node per (kind-1 key, domain) for the exact
+    representative-gather compression."""
+    cached = getattr(tensors, "_compact_spec_cache", None)
+    if cached is not None:
+        return cached
+    t = int(tensors.n_terms)
+    kinds = (
+        tensors.key_kind
+        if tensors.key_kind is not None
+        else np.zeros(0, np.int32)
+    )
+    nds = tensors.node_dom_small
+    if not t or not kinds.shape[0]:
+        spec = CompactSpec(False, 1, None)
+        object.__setattr__(tensors, "_compact_spec_cache", spec)
+        return spec
+    term_keys = np.asarray(tensors.term_topo_key[:t], np.int32)
+    tab_mask = kinds[term_keys] == 1
+    t_tab = np.flatnonzero(tab_mask).astype(np.int32)
+    t_dense = np.flatnonzero(~tab_mask).astype(np.int32)
+    if not len(t_tab):
+        spec = CompactSpec(False, 1, None)
+        object.__setattr__(tensors, "_compact_spec_cache", spec)
+        return spec
+    d = 1
+    for k in np.unique(term_keys[tab_mask]):
+        d = max(d, int(nds[k].max(initial=-1)) + 1)
+    # representative node per (key, small domain): the FIRST node carrying
+    # the domain id — compression gathers the plane at it, which is exact
+    # because kind-1 rows are domain-constant (the class invariant every
+    # state update preserves; see the module comment)
+    rep = np.full((kinds.shape[0], d), -1, np.int32)
+    for k in range(kinds.shape[0]):
+        if kinds[k] != 1:
+            continue
+        ids = nds[k]
+        valid = np.flatnonzero(ids >= 0)
+        rep[k, ids[valid][::-1]] = valid[::-1].astype(np.int32)
+    ip_of = interpod_term_index(tensors)
+    ip_terms = np.flatnonzero(ip_of >= 0)  # ascending = plane row order
+    ip_tabm = tab_mask[ip_terms]
+    ip_tab = np.flatnonzero(ip_tabm).astype(np.int32)
+    ip_dense = np.flatnonzero(~ip_tabm).astype(np.int32)
+    t_keys = term_keys[t_tab]
+    ip_keys = term_keys[ip_terms[ip_tab]]
+    dev = CompactSpecDev(
+        t_tab=jnp.asarray(t_tab),
+        t_dense=jnp.asarray(t_dense),
+        t_keys=jnp.asarray(t_keys),
+        t_rep=jnp.asarray(rep[t_keys]),
+        ip_tab=jnp.asarray(ip_tab),
+        ip_dense=jnp.asarray(ip_dense),
+        ip_keys=jnp.asarray(ip_keys),
+        ip_rep=jnp.asarray(rep[ip_keys]),
+    )
+    spec = CompactSpec(True, d, dev)
+    object.__setattr__(tensors, "_compact_spec_cache", spec)
+    return spec
+
+
+def node_dom_small_for(tensors, n: int) -> jnp.ndarray:
+    """tensors.node_dom_small as a device array whose node axis is padded to
+    `n` with -1 (absent) — the sharded engines carry a shard-padded state,
+    and padded (dead) nodes must expand to 0 exactly like key-less nodes.
+    Memoized per width on the tensors object."""
+    cache = getattr(tensors, "_nds_pad_cache", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(tensors, "_nds_pad_cache", cache)
+    got = cache.get(n)
+    if got is None:
+        nds = np.asarray(tensors.node_dom_small, np.int32)
+        pad = n - nds.shape[1]
+        if pad:
+            nds = np.pad(nds, ((0, 0), (0, pad)), constant_values=-1)
+        got = cache[n] = jnp.asarray(nds)
+    return got
+
+
+class CompactState(NamedTuple):
+    """SchedState's between-dispatch form: domain-tabular count planes,
+    COUNT_DTYPE integers, bool masks (see the section comment).  Field
+    pairs (`*_tab`, `*_dense`) partition the corresponding SchedState
+    plane's rows; continuous planes (free / vg_free / gpu_free) ride along
+    unchanged."""
+
+    free: jnp.ndarray  # [N, R] f32
+    cm_tab: jnp.ndarray  # [Rt, D] cnt_match tabular rows
+    cm_dense: jnp.ndarray  # [Rd, N] cnt_match dense rows
+    cnt_total: jnp.ndarray  # [T]
+    oa_tab: jnp.ndarray  # cnt_own_anti
+    oa_dense: jnp.ndarray
+    of_tab: jnp.ndarray  # cnt_own_aff
+    of_dense: jnp.ndarray
+    wa_tab: jnp.ndarray  # w_own_aff_pref
+    wa_dense: jnp.ndarray
+    wn_tab: jnp.ndarray  # w_own_anti_pref
+    wn_dense: jnp.ndarray
+    vg_free: jnp.ndarray  # [N, V] f32
+    sdev_free: jnp.ndarray  # [N, SD] bool
+    gpu_free: jnp.ndarray  # [N, GD] f32
+    ports_used: jnp.ndarray  # [N, P]
+    vols_any: jnp.ndarray  # [N, W]
+    vols_rw: jnp.ndarray  # [N, W]
+
+
+def _compress_rows(full, ids_tab, ids_dense, rep):
+    """Split one [rows, N] plane into its ([Rt, D] histogram, [Rd, N] dense)
+    carried pair.  The histogram is a representative-node GATHER (domains
+    without a node read 0), not a reduction — exact for domain-constant
+    rows by construction."""
+    tab = jnp.take_along_axis(full[ids_tab], jnp.clip(rep, 0), axis=1)
+    tab = jnp.where(rep >= 0, tab, 0.0)
+    return tab.astype(COUNT_DTYPE), full[ids_dense].astype(COUNT_DTYPE)
+
+
+def _expand_rows(tab, dense, ids_tab, ids_dense, keys, nds):
+    """Rebuild the [rows, N] float32 plane: one gather of each histogram row
+    through the key's node_dom_small ids, dense rows cast back.  Integer-
+    valued casts both ways — bit-identical to never having compressed."""
+    rows = tab.shape[0] + dense.shape[0]
+    n = nds.shape[1]
+    full = jnp.zeros((rows, n), jnp.float32)
+    if tab.shape[0]:
+        idx = nds[keys]  # [Rt, N]
+        vals = jnp.take_along_axis(
+            tab.astype(jnp.float32), jnp.clip(idx, 0), axis=1
+        )
+        full = full.at[ids_tab].set(jnp.where(idx >= 0, vals, 0.0))
+    if dense.shape[0]:
+        full = full.at[ids_dense].set(dense.astype(jnp.float32))
+    return full
+
+
+def _compress_state_fn(spec: CompactSpecDev, state: SchedState) -> CompactState:
+    cm_tab, cm_dense = _compress_rows(
+        state.cnt_match, spec.t_tab, spec.t_dense, spec.t_rep
+    )
+    oa = _compress_rows(state.cnt_own_anti, spec.ip_tab, spec.ip_dense, spec.ip_rep)
+    of = _compress_rows(state.cnt_own_aff, spec.ip_tab, spec.ip_dense, spec.ip_rep)
+    wa = _compress_rows(
+        state.w_own_aff_pref, spec.ip_tab, spec.ip_dense, spec.ip_rep
+    )
+    wn = _compress_rows(
+        state.w_own_anti_pref, spec.ip_tab, spec.ip_dense, spec.ip_rep
+    )
+    return CompactState(
+        free=state.free,
+        cm_tab=cm_tab,
+        cm_dense=cm_dense,
+        cnt_total=state.cnt_total.astype(COUNT_DTYPE),
+        oa_tab=oa[0],
+        oa_dense=oa[1],
+        of_tab=of[0],
+        of_dense=of[1],
+        wa_tab=wa[0],
+        wa_dense=wa[1],
+        wn_tab=wn[0],
+        wn_dense=wn[1],
+        vg_free=state.vg_free,
+        sdev_free=state.sdev_free.astype(MASK_DTYPE),
+        gpu_free=state.gpu_free,
+        ports_used=state.ports_used.astype(COUNT_DTYPE),
+        vols_any=state.vols_any.astype(COUNT_DTYPE),
+        vols_rw=state.vols_rw.astype(COUNT_DTYPE),
+    )
+
+
+def _expand_state_fn(
+    spec: CompactSpecDev, cstate: CompactState, nds: jnp.ndarray
+) -> SchedState:
+    return SchedState(
+        free=cstate.free,
+        cnt_match=_expand_rows(
+            cstate.cm_tab, cstate.cm_dense, spec.t_tab, spec.t_dense,
+            spec.t_keys, nds,
+        ),
+        cnt_total=cstate.cnt_total.astype(jnp.float32),
+        cnt_own_anti=_expand_rows(
+            cstate.oa_tab, cstate.oa_dense, spec.ip_tab, spec.ip_dense,
+            spec.ip_keys, nds,
+        ),
+        cnt_own_aff=_expand_rows(
+            cstate.of_tab, cstate.of_dense, spec.ip_tab, spec.ip_dense,
+            spec.ip_keys, nds,
+        ),
+        w_own_aff_pref=_expand_rows(
+            cstate.wa_tab, cstate.wa_dense, spec.ip_tab, spec.ip_dense,
+            spec.ip_keys, nds,
+        ),
+        w_own_anti_pref=_expand_rows(
+            cstate.wn_tab, cstate.wn_dense, spec.ip_tab, spec.ip_dense,
+            spec.ip_keys, nds,
+        ),
+        vg_free=cstate.vg_free,
+        sdev_free=cstate.sdev_free,
+        gpu_free=cstate.gpu_free,
+        ports_used=cstate.ports_used.astype(jnp.float32),
+        vols_any=cstate.vols_any.astype(jnp.float32),
+        vols_rw=cstate.vols_rw.astype(jnp.float32),
+    )
+
+
+# Donation audit (docs/memory.md): neither conversion donates.  Compression
+# CANNOT reuse the dense buffers it consumes — every narrowed plane changes
+# dtype (f32 → COUNT_DTYPE), which XLA refuses to alias, so donate_argnums
+# would only emit the donated-buffers-unusable warning (the dense planes are
+# still freed at last use; the pass-through planes alias into the output
+# with or without donation).  Expansion must not donate because the compact
+# carry is routinely shared: the incremental planner copies one snapshot per
+# probe and the fault sweep reads the engine's carry without owning it.
+compress_state = jax.jit(_compress_state_fn)
+expand_state = jax.jit(_expand_state_fn)
+
+
+def ensure_dense(state, tensors):
+    """The dense SchedState view of a FREE-STANDING carried state
+    (expanding a CompactState through the memoized spec; dense states
+    pass through).  For reading an ENGINE's carry use
+    `Engine.carried_state()` instead — it enforces the dirty-carry and
+    vocabulary-change preconditions this helper, which has no engine to
+    consult, cannot."""
+    if not isinstance(state, CompactState):
+        return state
+    spec = compact_spec(tensors)
+    return expand_state(
+        spec.dev, state, node_dom_small_for(tensors, state.free.shape[0])
+    )
+
+
+def state_nbytes(state) -> dict:
+    """Per-plane byte sizes of a carried state (SchedState or CompactState)
+    — shape/dtype arithmetic only, no device sync."""
+    return {
+        name: int(np.prod(arr.shape)) * np.dtype(arr.dtype).itemsize
+        for name, arr in zip(state._fields, state)
+    }
+
+
+# Carried-state byte gauge: refreshed by Engine.place each time it stores a
+# carry, read by bench.py (`state_bytes`) and the CLI's --json engine block.
+# `dense_bytes` is what the SAME carry costs in the dense layout (the A/B
+# denominator); `compact` records which form is stored.
+STATE_GAUGE = {"carried_bytes": 0, "dense_bytes": 0, "compact": False,
+               "planes": {}}
+
+
+def update_state_gauge(stored, dense_bytes: int) -> None:
+    planes = state_nbytes(stored)
+    STATE_GAUGE["carried_bytes"] = sum(planes.values())
+    STATE_GAUGE["dense_bytes"] = int(dense_bytes)
+    STATE_GAUGE["compact"] = isinstance(stored, CompactState)
+    STATE_GAUGE["planes"] = planes
+
+
+def state_gauge() -> dict:
+    """Snapshot of the carried-state byte gauge."""
+    out = dict(STATE_GAUGE)
+    out["planes"] = dict(STATE_GAUGE["planes"])
+    return out

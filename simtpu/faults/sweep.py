@@ -80,14 +80,23 @@ def _pow2(x: int) -> int:
 
 
 def _state_bytes(state) -> int:
-    leaves = jax.tree_util.tree_leaves(state)
-    return sum(int(np.prod(x.shape)) * x.dtype.itemsize for x in leaves)
+    # chunk sizing is deliberately keyed to the DENSE state's bytes even
+    # under the compact carried layout: the vmapped drain/requeue kernels
+    # consume the dense [·, N] expansion per scenario, so dense bytes are
+    # what each scenario replica actually costs on device
+    from ..engine.state import state_nbytes
+
+    return sum(state_nbytes(state).values())
 
 
 def _base_state(pc: PlacedCluster):
     """The base carry every scenario drains from.  `place_cluster` leaves
     the engine's carried state valid; a dirtied engine (log surgery without
-    a following place) rebuilds from the log the way Engine.place would."""
+    a following place) rebuilds from the log the way Engine.place would.
+    The carry is read through `Engine.carried_state` — the engine may hold
+    it domain-tabular (engine/state.py CompactState), and the vmapped
+    drain/requeue kernels consume the dense expansion (one exact gather,
+    never donating the engine's carry)."""
     eng = pc.engine
     tensors = pc.tensors
     if (
@@ -95,7 +104,7 @@ def _base_state(pc: PlacedCluster):
         and not eng._state_dirty
         and eng._last_vocab == eng.state_vocab(tensors)
     ):
-        return eng.last_state
+        return eng.carried_state()
     r = tensors.alloc.shape[1]
     return build_state(
         tensors,

@@ -10,6 +10,12 @@ source, rebuilt when the source changes) and exposes:
 Everything degrades gracefully: ``available()`` is False when no compiler
 exists or the build fails, and every caller keeps a pure-numpy fallback — the
 package stays importable on a machine with no toolchain.
+
+``SIMTPU_NATIVE=0`` forces ``available() -> False`` and routes every entry
+point through its pure-python/numpy fallback even when the library builds —
+the A/B lever behind the fallback-parity tests (tests/test_native.py) and a
+production escape hatch if a host's toolchain miscompiles.  The env var is
+read per call, so tests can flip it without reloading the module.
 """
 
 from __future__ import annotations
@@ -101,14 +107,22 @@ def _load() -> Optional[ctypes.CDLL]:
     return _lib
 
 
+def _enabled() -> Optional[ctypes.CDLL]:
+    """The loaded library, or None when it is unavailable OR disabled via
+    SIMTPU_NATIVE=0 — the one gate every entry point consults."""
+    if os.environ.get("SIMTPU_NATIVE", "1") == "0":
+        return None
+    return _load()
+
+
 def available() -> bool:
-    return _load() is not None
+    return _enabled() is not None
 
 
 def parse_quantities(values: Sequence) -> np.ndarray:
     """Batch-parse k8s quantities; raises ValueError on any unparseable entry
     (same contract as quantity.parse_quantity). None → 0.0."""
-    lib = _load()
+    lib = _enabled()
     if lib is None:
         from ..core.quantity import parse_quantity
 
@@ -135,7 +149,7 @@ def parse_quantities(values: Sequence) -> np.ndarray:
 def scatter_add_rows(dst: np.ndarray, idx: np.ndarray, src: np.ndarray) -> bool:
     """dst[idx[i], :] += src[i, :] in place. Returns False (caller must fall
     back to np.add.at) when the native library is unavailable."""
-    lib = _load()
+    lib = _enabled()
     if lib is None:
         return False
     # dst must be updated in place: a contiguity copy would be silently lost
@@ -156,7 +170,7 @@ def scatter_add_rows(dst: np.ndarray, idx: np.ndarray, src: np.ndarray) -> bool:
 
 def scatter_add_flat(dst: np.ndarray, idx: np.ndarray, vals: np.ndarray) -> bool:
     """dst.ravel()[idx[i]] += vals[i] in place; False → caller falls back."""
-    lib = _load()
+    lib = _enabled()
     if lib is None:
         return False
     assert dst.dtype == np.float32 and dst.flags.c_contiguous

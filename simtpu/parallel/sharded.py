@@ -35,6 +35,11 @@ from ..engine.scan import (
     schedule_step,
     wavefront_scan,
 )
+from ..engine.state import (
+    CompactState,
+    _compress_state_fn,
+    _expand_state_fn,
+)
 from .mesh import NODE_AXIS, node_shard_count
 
 
@@ -147,6 +152,37 @@ def statics_sharding(mesh: Mesh) -> StaticArrays:
         gpu_total=lead,
         score_w=rep,
         node_valid=lead,
+    )
+
+
+def compact_state_sharding(mesh: Mesh) -> CompactState:
+    """Shardings for the domain-tabular carried state (engine/state.py):
+    dense [., N] row planes keep the node axis split, the [Rt, D]
+    histograms and [T] totals replicate (they are the small part — a few
+    KB — which is exactly why the compact carry moves fewer bytes per
+    GSPMD reshard)."""
+    lead2 = NamedSharding(mesh, P(NODE_AXIS, None))
+    trail = NamedSharding(mesh, P(None, NODE_AXIS))
+    rep = NamedSharding(mesh, P())
+    return CompactState(
+        free=lead2,
+        cm_tab=rep,
+        cm_dense=trail,
+        cnt_total=rep,
+        oa_tab=rep,
+        oa_dense=trail,
+        of_tab=rep,
+        of_dense=trail,
+        wa_tab=rep,
+        wa_dense=trail,
+        wn_tab=rep,
+        wn_dense=trail,
+        vg_free=lead2,
+        sdev_free=lead2,
+        gpu_free=lead2,
+        ports_used=lead2,
+        vols_any=lead2,
+        vols_rw=lead2,
     )
 
 
@@ -278,6 +314,30 @@ class _MeshMixin:
         # no-op: the sharded jits shard replicated pod inputs on entry; a
         # prefetch committed to one device would fight the mesh layout
         return tree
+
+    def _compress_call(self, spec_dev, state):
+        # mesh-compiled compression: carried compact planes keep the
+        # node-axis layout (compact_state_sharding) between batches, so
+        # the next expansion resharding moves only the small histograms.
+        # No donation — the dtype-narrowed outputs cannot alias the f32
+        # inputs (see the audit note in engine/state.py).
+        fn = _cached_jit(
+            ("compress", self.mesh),
+            lambda: jax.jit(
+                _compress_state_fn,
+                out_shardings=compact_state_sharding(self.mesh),
+            ),
+        )
+        return fn(spec_dev, state)
+
+    def _expand_call(self, spec_dev, cstate, nds):
+        fn = _cached_jit(
+            ("expand", self.mesh),
+            lambda: jax.jit(
+                _expand_state_fn, out_shardings=state_sharding(self.mesh)
+            ),
+        )
+        return fn(spec_dev, cstate, nds)
 
     def _precompile_shapes(self, statics_sds, state_sds):
         """Shard-padded executable signatures for the precompiler: the

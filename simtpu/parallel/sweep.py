@@ -53,7 +53,14 @@ def _sweep_scan(
     pods,
     flags: StepFlags = StepFlags(),
 ):
-    """vmap the scan over the candidate axis; only node_valid varies."""
+    """vmap the scan over the candidate axis; only node_valid varies.
+
+    Deliberately NOT donated (donation audit, docs/memory.md): donation
+    only enables input→output aliasing, and no input here can alias an
+    output — the [N]-shaped base carry and [S]-masks come out vmapped to
+    [S, N] — so donate_argnums would buy nothing and emit the
+    donated-buffers-unusable warning on every sweep.  XLA frees the
+    inputs at last use regardless."""
 
     def one(valid):
         st = statics._replace(node_valid=statics.node_valid & valid)

@@ -576,10 +576,12 @@ class Applier:
         ctx = contextlib.nullcontext()
         if trace_dir:
             ctx = jax.profiler.trace(trace_dir)
-        from ..engine.scan import wave_counts, wave_enabled
+        from ..engine.scan import fetch_counts, wave_counts, wave_enabled
+        from ..engine.state import state_gauge
 
         search, bulk, mesh = _resolve_engines(self.opts, cluster, apps)
         waves_before = wave_counts()
+        fetch_before = fetch_counts()
         # auto-ON for apply on accelerator backends: the one-shot CLI user
         # always pays the cold path, which is exactly what the background
         # AOT pipeline attacks.  CPU backends stay off under auto (the
@@ -625,6 +627,7 @@ class Applier:
         # "search"/"bulk" distinguish the non-reference-exact fast path)
         from ..parallel.mesh import NODE_AXIS
 
+        gauge = state_gauge()
         plan.engine = {
             "search": search,
             "bulk": bool(bulk) if search != "incremental" else True,
@@ -641,5 +644,20 @@ class Applier:
             "wavefront": {
                 k: wave_counts()[k] - waves_before[k] for k in waves_before
             },
+            # transfer + carried-state byte telemetry (ISSUE 5): blocking
+            # device→host round-trips and bytes this plan paid, plus the
+            # final engine carry's per-plane byte breakdown under the
+            # active layout (compact = the domain-tabular carry,
+            # SIMTPU_COMPACT A/B — placements are identical either way)
+            "fetch": {
+                k: fetch_counts()[k] - fetch_before[k] for k in fetch_before
+            },
+            # `compact` is the gauge's own record of what the final carry
+            # actually was — NOT the SIMTPU_COMPACT default, which an
+            # engine attribute or a spec with no tabular keys can override
+            # (popped so the byte breakdown under `state_bytes` holds only
+            # the carried/dense/per-plane numbers, not a duplicate flag)
+            "compact": gauge.pop("compact"),
+            "state_bytes": gauge,
         }
         return plan

@@ -87,6 +87,7 @@ from ..core.objects import (
 from ..core.tensorize import slice_batch
 from ..engine.rounds import RoundsEngine
 from ..engine.scan import REASON_TEXT
+from ..engine.state import CompactState
 from .capacity import PlanResult, _env_cap, meet_resource_requests
 
 
@@ -416,7 +417,19 @@ def _plan_capacity_incremental(
 
     # -- snapshot + cheap probes ------------------------------------------
     t0 = time.perf_counter()
+    # the snapshot is the base engine's carry AS STORED — under the compact
+    # layout (engine/state.py CompactState) that is the domain-tabular
+    # form, and the probes inject it VERBATIM: place()'s reuse branch
+    # expands a compact carry without donating or mutating it and then
+    # stores a fresh carry, so the shared snapshot stays intact across
+    # probes.  A dense snapshot must be copied per probe — the reuse
+    # branch hands it straight to a donating dispatch.
     snapshot = base_eng.last_state
+    copy_snapshot = (
+        (lambda: snapshot)
+        if isinstance(snapshot, CompactState)
+        else (lambda: _copy_state(snapshot))
+    )
 
     def probe(i: int) -> tuple:
         """Completion probe: from the base snapshot, place the clone
@@ -427,7 +440,7 @@ def _plan_capacity_incremental(
         idx = np.flatnonzero(base_failed | ((clone_of >= 0) & (clone_of < i)))
         probe_batch = slice_batch(batch, idx)
         eng = make_engine(valid_mask(i), plan_batch=probe_batch)
-        eng.last_state = _copy_state(snapshot)
+        eng.last_state = copy_snapshot()
         eng._last_vocab = vocab
         eng._state_dirty = False
         nodes, reasons, extras = eng.place(probe_batch)

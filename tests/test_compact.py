@@ -1,0 +1,328 @@
+"""The compact carried-state layout (engine/state.py, ISSUE 5): the
+between-dispatch carry stores kind-1 topology keys' count rows as domain
+histograms with integer dtypes, and expands back to the dense in-kernel
+SchedState through one gather.
+
+Pinned here:
+- compress → expand is a BIT-identical round trip on a really-placed state
+  (the exactness the whole layout rests on);
+- placements are bit-identical with the compact carry on vs off across the
+  serial scan, the bulk rounds engine, the speculative wavefront, GSPMD
+  sharding, the incremental planner, and the fault sweep (the acceptance
+  A/B);
+- the carried bytes shrink on a multi-domain problem (the gauge the bench's
+  `state_bytes` reports);
+- the donated-state reuse guard: a dispatch that fails AFTER donating the
+  carry must not leave place() reusing a dead buffer — the retry rebuilds
+  from the placement log and lands the exact same placements.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from simtpu.core.tensorize import Tensorizer
+from simtpu.engine.rounds import RoundsEngine
+from simtpu.engine.scan import Engine
+from simtpu.engine.state import (
+    CompactState,
+    compact_spec,
+    compress_state,
+    ensure_dense,
+    state_gauge,
+    state_nbytes,
+)
+from simtpu.synth import make_node, synth_apps, synth_cluster
+from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
+
+
+def _round_robin_pods(apps):
+    """Expand apps to pods, round-robined across deployments so the FIRST
+    half of the list already contains a pod of every group: the second
+    `place()` batch then interns no new groups/terms, the vocabulary stays
+    stable, and the carry-REUSE branch of Engine.place (expansion of the
+    stored compact state) really runs — a front-half/back-half split would
+    cut across deployments, grow the vocab, and silently route every
+    second batch through the from-log rebuild instead.  (synth_apps emits
+    one app object per pod; the "app" label is the group identity.)"""
+    per_dep: dict = {}
+    for a in apps:
+        for p in get_valid_pods_exclude_daemonset(a.resource):
+            lbl = ((p.get("metadata") or {}).get("labels") or {}).get("app")
+            per_dep.setdefault(lbl, []).append(p)
+    deps = list(per_dep.values())
+    pods = []
+    for i in range(max(len(ps) for ps in deps)):
+        for ps in deps:
+            if i < len(ps):
+                pods.append(ps[i])
+    assert len(pods) // 2 >= len(deps), "first half must cover every group"
+    return pods
+
+
+def _mixed_problem():
+    """A small cluster + pod list exercising zone AND hostname topology keys
+    (tabular and dense rows), extended resources, and hard constraints.
+    > DOM_SMALL nodes, or the hostname key itself would count as
+    small-domain and the dense row class would be empty."""
+    cluster = synth_cluster(
+        72, seed=41, zones=3, taint_frac=0.1, gpu_frac=0.3, storage_frac=0.4
+    )
+    apps = synth_apps(
+        90,
+        seed=42,
+        zones=3,
+        pods_per_deployment=15,
+        selector_frac=0.2,
+        toleration_frac=0.1,
+        anti_affinity_frac=0.4,
+        anti_affinity_hard_frac=0.5,
+        spread_frac=0.3,
+        spread_hard_frac=0.5,
+        gpu_frac=0.2,
+        storage_frac=0.2,
+        affinity_frac=0.2,
+    )
+    return cluster, _round_robin_pods(apps)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    return _mixed_problem()
+
+
+def _place_batches(factory, cluster, pods, compact, speculate=False):
+    """Two place() calls through one engine (the second takes the carry
+    reuse path — expansion of the stored compact state)."""
+    tz = Tensorizer(cluster.nodes, storage_classes=cluster.storage_classes)
+    eng = factory(tz)
+    eng.compact = compact
+    if speculate:
+        eng.speculate = True
+    half = len(pods) // 2
+    n1, r1, _ = eng.place(tz.add_pods(pods[:half]))
+    b2 = tz.add_pods(pods[half:])
+    # the reuse precondition: were the vocabulary to grow here, place()
+    # would rebuild from the log and the carry-reuse path under test
+    # (compact expansion of the stored state) would go dark
+    assert eng.state_vocab(tz.freeze()) == eng._last_vocab
+    n2, r2, _ = eng.place(b2)
+    return eng, np.concatenate([n1, n2]), np.concatenate([r1, r2])
+
+
+class TestRoundTrip:
+    def test_compress_expand_bit_identical(self, problem):
+        """The dense carry of a REAL placement survives compress → expand
+        with every plane bit-identical (dtype included)."""
+        import jax
+        import jax.numpy as jnp
+
+        cluster, pods = problem
+        eng, _, _ = _place_batches(RoundsEngine, cluster, pods, compact=True)
+        tensors = eng.tensorizer.freeze()
+        spec = compact_spec(tensors)
+        assert spec.enabled, "the mixed problem must have tabular keys"
+        # both row classes must be populated, or the test is vacuous
+        assert spec.dev.t_tab.shape[0] > 0
+        assert spec.dev.t_dense.shape[0] > 0
+        dense = eng.carried_state()
+        copy = jax.tree_util.tree_map(jnp.copy, dense)
+        again = ensure_dense(compress_state(spec.dev, copy), tensors)
+        for name in dense._fields:
+            want = np.asarray(getattr(dense, name))
+            got = np.asarray(getattr(again, name))
+            assert got.dtype == want.dtype, name
+            assert np.array_equal(got, want), (
+                f"plane {name} not bit-identical after compress/expand"
+            )
+
+    def test_carry_is_compact_and_integer(self, problem):
+        cluster, pods = problem
+        eng, _, _ = _place_batches(RoundsEngine, cluster, pods, compact=True)
+        carry = eng.last_state
+        assert isinstance(carry, CompactState)
+        for name in ("cm_tab", "cm_dense", "cnt_total", "ports_used",
+                     "vols_any", "vols_rw"):
+            assert np.issubdtype(
+                np.asarray(getattr(carry, name)).dtype, np.integer
+            ), name
+        assert np.asarray(carry.sdev_free).dtype == np.bool_
+
+
+class TestPlacementAB:
+    """Placements bit-identical with the compact carry on vs off."""
+
+    @pytest.mark.parametrize("factory", [Engine, RoundsEngine])
+    def test_engines(self, problem, factory):
+        cluster, pods = problem
+        _, n_on, r_on = _place_batches(factory, cluster, pods, compact=True)
+        _, n_off, r_off = _place_batches(factory, cluster, pods, compact=False)
+        assert np.array_equal(n_on, n_off)
+        assert np.array_equal(r_on, r_off)
+
+    def test_wavefront(self, problem):
+        """The speculative wavefront dispatcher over a compact-carrying
+        engine matches the dense-carrying pod-at-a-time scan."""
+        cluster, pods = problem
+        _, n_on, _ = _place_batches(
+            Engine, cluster, pods, compact=True, speculate=True
+        )
+        _, n_off, _ = _place_batches(
+            Engine, cluster, pods, compact=False, speculate=False
+        )
+        assert np.array_equal(n_on, n_off)
+
+    def test_sharded(self, problem):
+        from simtpu.parallel.mesh import make_mesh
+        from simtpu.parallel.sharded import ShardedRoundsEngine
+
+        cluster, pods = problem
+        mesh = make_mesh(sweep=1)
+
+        def run(compact):
+            tz = Tensorizer(
+                cluster.nodes, storage_classes=cluster.storage_classes
+            )
+            eng = ShardedRoundsEngine(tz, mesh)
+            eng.compact = compact
+            half = len(pods) // 2
+            n1, _, _ = eng.place(tz.add_pods(pods[:half]))
+            n2, _, _ = eng.place(tz.add_pods(pods[half:]))
+            return np.concatenate([n1, n2])
+
+        assert np.array_equal(run(True), run(False))
+
+    def test_incremental_planner(self, monkeypatch):
+        """The probe sweep copies and expands COMPACT snapshots; the plan
+        answer must match the dense-carry run (nodes_added > 0 so probes
+        really run)."""
+        from simtpu.plan.incremental import plan_capacity_incremental
+
+        cluster = synth_cluster(6, seed=13, zones=3, taint_frac=0.0)
+        apps = synth_apps(
+            400, seed=14, zones=3, pods_per_deployment=40,
+            selector_frac=0.0, toleration_frac=0.0, anti_affinity_frac=0.1,
+            spread_frac=0.3,
+        )
+        template = make_node(
+            "tmpl", 64000, 256,
+            {"kubernetes.io/hostname": "tmpl",
+             "topology.kubernetes.io/zone": "zone-plan"},
+        )
+        got = {}
+        for env in ("1", "0"):
+            monkeypatch.setenv("SIMTPU_COMPACT", env)
+            plan = plan_capacity_incremental(
+                cluster, apps, template, max_new_nodes=24, materialize=False
+            )
+            got[env] = (plan.success, plan.nodes_added, dict(plan.probes))
+        assert got["1"] == got["0"]
+        assert got["1"][0] and got["1"][1] > 0, (
+            "the scenario must require added nodes or the probe path is "
+            f"untested: {got['1']}"
+        )
+
+    def test_fault_sweep(self, problem, monkeypatch):
+        """The batched scenario sweep drains from the engine's carry —
+        identical per-scenario outcomes whether that carry is compact or
+        dense."""
+        from simtpu.faults import (
+            place_cluster,
+            single_node_scenarios,
+            sweep_scenarios,
+        )
+
+        cluster, _ = problem
+        apps = synth_apps(
+            60, seed=52, zones=3, pods_per_deployment=12,
+            selector_frac=0.1, anti_affinity_frac=0.2, spread_frac=0.2,
+        )
+        ref = None
+        for env in ("1", "0"):
+            monkeypatch.setenv("SIMTPU_COMPACT", env)
+            pc = place_cluster(cluster, apps)
+            assert isinstance(
+                pc.engine.last_state, CompactState
+            ) == (env == "1")
+            scen = single_node_scenarios(pc.n_nodes, nodes=cluster.nodes)
+            sw = sweep_scenarios(pc, scen)
+            if ref is None:
+                ref = (sw.unplaced.copy(), sw.requeue_nodes.copy())
+            else:
+                assert np.array_equal(ref[0], sw.unplaced)
+                assert np.array_equal(ref[1], sw.requeue_nodes)
+
+
+class TestBytesShrink:
+    def test_multi_domain_carry_smaller(self):
+        """Zone-dominated constraints → the compact carry is measurably
+        smaller than the dense one (the bench asserts >= 2x at its shape;
+        at this tiny node count the fixed planes weigh more, so just pin a
+        real reduction and the gauge plumbing)."""
+        cluster = synth_cluster(120, seed=21, zones=4, taint_frac=0.0)
+        apps = synth_apps(
+            300, seed=22, zones=4, pods_per_deployment=30,
+            selector_frac=0.1, anti_affinity_frac=0.0, spread_frac=0.8,
+            affinity_frac=0.5,
+        )
+        pods = _round_robin_pods(apps)
+        eng, _, _ = _place_batches(RoundsEngine, cluster, pods, compact=True)
+        g = state_gauge()
+        assert g["compact"] is True
+        assert g["carried_bytes"] == sum(state_nbytes(eng.last_state).values())
+        assert g["carried_bytes"] < g["dense_bytes"], g
+        assert set(g["planes"]) == set(CompactState._fields)
+
+
+class TestDonatedReuseGuard:
+    """Engine.place's cache bookkeeping runs only after a successful
+    dispatch: a dispatch that raises AFTER donating the carry must leave
+    the engine rebuilding from the log — never re-validating (and reading)
+    a donated buffer on the retry."""
+
+    # two cases cover both engines AND both carry layouts (the dense case
+    # is where the donated buffer itself would be re-read on a buggy
+    # retry; the compact case pins the expand-before-donate ordering)
+    @pytest.mark.parametrize(
+        "factory,compact", [(Engine, False), (RoundsEngine, True)]
+    )
+    def test_failed_dispatch_then_retry(self, problem, factory, compact):
+        cluster, pods = problem
+        half = len(pods) // 2
+
+        # oracle: the same two batches through an unsabotaged engine
+        _, want_nodes, want_reasons = _place_batches(
+            factory, cluster, pods, compact
+        )
+
+        tz = Tensorizer(cluster.nodes, storage_classes=cluster.storage_classes)
+        eng = factory(tz)
+        eng.compact = compact
+        eng.place(tz.add_pods(pods[:half]))
+        assert eng.last_state is not None and not eng._state_dirty
+
+        real_dispatch = eng._dispatch
+
+        def boom(statics, state, pod_arrays, flags):
+            # run the REAL dispatch first so the carried state genuinely
+            # gets donated/consumed, then fail before place() can store
+            real_dispatch(statics, state, pod_arrays, flags)
+            raise RuntimeError("injected post-donation failure")
+
+        eng._dispatch = boom
+        b2 = tz.add_pods(pods[half:])
+        # vocab-stable second batch (round-robin pod order): the retry
+        # below WOULD take the reuse branch — and re-read the donated
+        # buffer — were the guard not disarming it
+        assert eng.state_vocab(tz.freeze()) == eng._last_vocab
+        with pytest.raises(RuntimeError, match="post-donation"):
+            eng.place(b2)
+        # the guard: the failed run left the reuse branch disarmed
+        assert eng._state_dirty
+        eng._dispatch = real_dispatch
+        n2, r2, _ = eng.place(b2)  # must rebuild from the log and succeed
+        assert np.array_equal(n2, want_nodes[half:])
+        assert np.array_equal(r2, want_reasons[half:])
+        # and the carry is live again for a further batch
+        assert not eng._state_dirty

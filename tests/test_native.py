@@ -70,3 +70,93 @@ def test_out_of_range_indices_skipped():
     src = np.ones((3, 2), np.float32)
     assert native.scatter_add_rows(dst, idx, src)
     assert dst.sum() == 2.0  # only row 0 landed
+
+
+class TestEnvOverride:
+    """SIMTPU_NATIVE=0 forces the pure-python/numpy fallbacks even when the
+    library builds — and the fallbacks must be bit-identical to the native
+    path (they back the SAME state rebuilds; a drift would silently change
+    placements on toolchain-less hosts)."""
+
+    def test_available_forced_off(self, monkeypatch):
+        monkeypatch.setenv("SIMTPU_NATIVE", "0")
+        assert not native.available()
+        monkeypatch.setenv("SIMTPU_NATIVE", "1")
+        if not native.available():  # toolchain-less host: only the forced-
+            pytest.skip("native toolchain unavailable")  # off half applies
+        assert native.available()  # the override is live, not sticky
+
+    def test_scatter_entry_points_decline(self, monkeypatch):
+        """Under the override the scatter helpers return False (caller
+        falls back) and leave dst untouched."""
+        monkeypatch.setenv("SIMTPU_NATIVE", "0")
+        dst = np.ones((4, 3), np.float32)
+        before = dst.copy()
+        assert not native.scatter_add_rows(
+            dst, np.zeros(2, np.int32), np.ones((2, 3), np.float32)
+        )
+        assert not native.scatter_add_flat(
+            dst, np.zeros(2, np.int64), np.ones(2, np.float32)
+        )
+        np.testing.assert_array_equal(dst, before)
+
+    def test_parse_quantities_fallback_bit_identical(self, monkeypatch):
+        if not native.available():
+            pytest.skip("native toolchain unavailable — nothing to compare")
+        want = native.parse_quantities(CORPUS)
+        monkeypatch.setenv("SIMTPU_NATIVE", "0")
+        got = native.parse_quantities(CORPUS)
+        # bit-identical, not allclose: both paths implement one grammar
+        np.testing.assert_array_equal(got, want)
+
+    def test_state_rebuild_fallback_bit_identical(self, monkeypatch):
+        """build_state (the scatter kernels' real consumer) produces
+        bit-identical planes through the numpy fallback and the native
+        path."""
+        if not native.available():
+            pytest.skip("native toolchain unavailable — nothing to compare")
+        from simtpu.core.tensorize import Tensorizer
+        from simtpu.engine.rounds import RoundsEngine
+        from simtpu.synth import synth_apps, synth_cluster
+        from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
+
+        cluster = synth_cluster(
+            10, seed=71, zones=3, taint_frac=0.1, storage_frac=0.3
+        )
+        apps = synth_apps(
+            36, seed=72, zones=3, pods_per_deployment=9,
+            selector_frac=0.2, anti_affinity_frac=0.3, spread_frac=0.3,
+        )
+        pods = []
+        for app in apps:
+            pods.extend(get_valid_pods_exclude_daemonset(app.resource))
+
+        # place once natively to seed the placement log, then rebuild the
+        # state from that log through both scatter paths
+        tz = Tensorizer(cluster.nodes, storage_classes=cluster.storage_classes)
+        eng = RoundsEngine(tz)
+        eng.place(tz.add_pods(pods))
+        from simtpu.engine.state import build_state
+
+        tensors = eng.tensorizer.freeze()
+        r = tensors.alloc.shape[1]
+
+        def rebuild(env):
+            monkeypatch.setenv("SIMTPU_NATIVE", env)
+            return build_state(
+                tensors,
+                np.asarray(eng.placed_group, np.int32),
+                np.asarray(eng.placed_node, np.int32),
+                eng.log_req_matrix(r),
+                eng.ext_log,
+            )
+
+        a, b = rebuild("1"), rebuild("0")
+        for name in a._fields:
+            want = np.asarray(getattr(a, name))
+            got = np.asarray(getattr(b, name))
+            assert got.dtype == want.dtype, name
+            assert np.array_equal(got, want), (
+                f"build_state plane {name} differs between the native and "
+                "numpy scatter paths"
+            )

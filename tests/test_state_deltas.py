@@ -69,7 +69,7 @@ class TestApplyUndoRoundTrip:
         statics = statics_from(tensors, eng.sched_config)
         r = tensors.alloc.shape[1]
         ext = tensors.ext
-        base = eng.last_state
+        base = eng.carried_state()  # dense view of the (compact) carry
         assert base is not None and not eng._state_dirty
         # a mixed batch: every 3rd entry, which spans groups/nodes/extended
         indices = list(range(0, len(eng.placed_node), 3))
@@ -114,7 +114,7 @@ class TestApplyUndoRoundTrip:
         statics = statics_from(tensors, eng.sched_config)
         r = tensors.alloc.shape[1]
         ext = tensors.ext
-        base = eng.last_state
+        base = eng.carried_state()
         entries = _entries_of(eng, range(len(eng.placed_node)))
         packed = pack_delta_entries(
             entries,
@@ -147,7 +147,7 @@ class TestApplyUndoRoundTrip:
         statics = statics_from(tensors, eng.sched_config)
         r = tensors.alloc.shape[1]
         ext = tensors.ext
-        base = eng.last_state
+        base = eng.carried_state()
         packed = pack_delta_entries(
             [],
             r,

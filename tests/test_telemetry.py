@@ -1,0 +1,153 @@
+"""Byte-level transfer + carried-state telemetry (ISSUE 5): the
+`fetch_counts` round-trip/byte counters, the `state_gauge` per-plane
+carried-state breakdown, and their surfacing through `simtpu apply --json`'s
+engine block — present and consistent under the SIMTPU_WAVEFRONT and
+shard/no-shard A/Bs (the counters are observability, never behavior).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from simtpu.core.tensorize import Tensorizer
+from simtpu.engine.rounds import RoundsEngine
+from simtpu.engine.scan import Engine, fetch_counts
+from simtpu.engine.state import CompactState, SchedState, state_gauge
+from simtpu.synth import make_node, synth_apps, synth_cluster
+from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
+
+
+@pytest.fixture(scope="module")
+def problem():
+    cluster = synth_cluster(16, seed=61, zones=4, taint_frac=0.1)
+    apps = synth_apps(
+        48, seed=62, zones=4, pods_per_deployment=12,
+        selector_frac=0.2, anti_affinity_frac=0.2, spread_frac=0.3,
+    )
+    pods = []
+    for app in apps:
+        pods.extend(get_valid_pods_exclude_daemonset(app.resource))
+    return cluster, pods
+
+
+def _place(cluster, pods, factory=RoundsEngine, speculate=False):
+    tz = Tensorizer(cluster.nodes, storage_classes=cluster.storage_classes)
+    eng = factory(tz)
+    if speculate:
+        eng.speculate = True
+    nodes, _, _ = eng.place(tz.add_pods(pods))
+    return eng, nodes
+
+
+class TestFetchCounters:
+    @pytest.mark.parametrize("speculate", [False, True])
+    def test_monotone_and_bytes_move(self, problem, speculate):
+        """Every placement pays >= 1 blocking fetch and its payload bytes;
+        both counters only ever grow — under the pod-at-a-time scan AND
+        the speculative wavefront dispatcher (SIMTPU_WAVEFRONT A/B)."""
+        cluster, pods = problem
+        before = fetch_counts()
+        assert set(before) == {"get", "bytes"}
+        _, nodes = _place(cluster, pods, Engine, speculate=speculate)
+        after = fetch_counts()
+        assert after["get"] > before["get"]
+        assert after["bytes"] > before["bytes"]
+        # a placement's outputs are at least one int32 per pod (nodes +
+        # reasons ride one batched fetch)
+        assert after["bytes"] - before["bytes"] >= nodes.size * 4
+
+
+class TestStateGauge:
+    def test_gauge_tracks_last_store(self, problem):
+        cluster, pods = problem
+        eng, _ = _place(cluster, pods)
+        g = state_gauge()
+        assert g["carried_bytes"] > 0
+        assert g["dense_bytes"] >= g["carried_bytes"]
+        assert g["compact"] == isinstance(eng.last_state, CompactState)
+        fields = (
+            CompactState._fields if g["compact"] else SchedState._fields
+        )
+        assert set(g["planes"]) == set(fields)
+        assert sum(g["planes"].values()) == g["carried_bytes"]
+
+    def test_gauge_survives_compact_off(self, problem, monkeypatch):
+        monkeypatch.setenv("SIMTPU_COMPACT", "0")
+        cluster, pods = problem
+        eng, _ = _place(cluster, pods)
+        g = state_gauge()
+        assert isinstance(eng.last_state, SchedState)
+        assert g["compact"] is False
+        assert g["carried_bytes"] == g["dense_bytes"] > 0
+
+
+class TestApplyJsonEngineBlock:
+    """plan.engine (the `simtpu apply --json` engine block) carries the
+    fetch/state-byte telemetry, under both the sharded and unsharded
+    planner (--shard/--no-shard A/B)."""
+
+    def _applier(self, shard):
+        from simtpu.plan import capacity as cap
+
+        cluster = synth_cluster(6, seed=63, zones=3, taint_frac=0.0)
+        apps = synth_apps(
+            240, seed=64, zones=3, pods_per_deployment=40,
+            selector_frac=0.0, toleration_frac=0.0, spread_frac=0.2,
+        )
+        template = make_node(
+            "tmpl", 64000, 256,
+            {"kubernetes.io/hostname": "tmpl",
+             "topology.kubernetes.io/zone": "zone-plan"},
+        )
+        applier = cap.Applier.__new__(cap.Applier)
+        applier.opts = cap.ApplierOptions(
+            search="incremental", shard=shard, precompile=False
+        )
+        applier.load_apps = lambda: list(apps)
+        applier.load_cluster = lambda: cluster
+        applier.load_new_node = lambda: template
+        return applier
+
+    @pytest.mark.parametrize("shard", [False, True])
+    def test_engine_block_fields(self, shard):
+        plan = self._applier(shard).run()
+        assert plan.success, plan.message
+        eng = plan.engine
+        assert set(eng["fetch"]) == {"get", "bytes"}
+        assert eng["fetch"]["get"] > 0 and eng["fetch"]["bytes"] > 0
+        assert isinstance(eng["compact"], bool)
+        sb = eng["state_bytes"]
+        assert sb["carried_bytes"] > 0
+        assert sb["dense_bytes"] >= sb["carried_bytes"]
+        assert sb["planes"]
+        assert eng["shards"] == (0 if not shard else eng["shards"])
+        if shard:
+            assert eng["shards"] > 1
+
+    def test_plan_json_serializes(self):
+        """cli._plan_json must emit the telemetry verbatim as valid JSON
+        (the --json contract scripted consumers read)."""
+        from simtpu.cli import _plan_json
+
+        plan = self._applier(False).run()
+        doc = json.loads(_plan_json(plan))
+        assert doc["engine"]["state_bytes"]["carried_bytes"] > 0
+        assert doc["engine"]["fetch"]["get"] > 0
+        assert "compact" in doc["engine"]
+
+
+class TestShardAB:
+    def test_sharded_vs_unsharded_plan_identical(self):
+        """The telemetry A/B never changes answers: the sharded and
+        unsharded planner agree on the plan (and both leave counters
+        populated)."""
+        t = TestApplyJsonEngineBlock()
+        a = t._applier(False).run()
+        b = t._applier(True).run()
+        assert (a.success, a.nodes_added) == (b.success, b.nodes_added)
+        assert np.array_equal(
+            sorted(a.probes.items()), sorted(b.probes.items())
+        )
