@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all install lint test test-all test-perf bench bench-cold bench-faults bench-layout clean
+.PHONY: all install lint test test-all test-perf bench bench-cold bench-faults bench-layout bench-durable clean
 
 all: test
 
@@ -74,6 +74,21 @@ bench-layout:
 	SIMTPU_BENCH_SMALL=0 SIMTPU_BENCH_HARD=0 SIMTPU_BENCH_MATRIX=0 \
 	SIMTPU_BENCH_PLAN=0 SIMTPU_BENCH_BIG=0 SIMTPU_BENCH_FAULTS=0 \
 	$(PY) bench.py
+
+# durable-execution smoke (mirrors bench-layout): checkpoint a small
+# incremental plan, kill it mid-search, resume, and ASSERT the resumed
+# PlanResult is bit-identical to the uninterrupted run; plus an injected
+# RESOURCE_EXHAUSTED on the bulk dispatcher asserting the chunk-halving
+# backoff converges with identical placements — durable_* and
+# backoff_events land in the JSON line (CI runs this alongside the fast
+# tier)
+bench-durable:
+	SIMTPU_BENCH_DURABLE=1 SIMTPU_BENCH_DURABLE_ASSERT=1 \
+	SIMTPU_BENCH_NODES=500 SIMTPU_BENCH_PODS=2000 \
+	SIMTPU_BENCH_SCAN_PODS=200 SIMTPU_BENCH_BASELINE_PODS=50 \
+	SIMTPU_BENCH_SMALL=0 SIMTPU_BENCH_HARD=0 SIMTPU_BENCH_MATRIX=0 \
+	SIMTPU_BENCH_PLAN=0 SIMTPU_BENCH_BIG=0 SIMTPU_BENCH_FAULTS=0 \
+	SIMTPU_BENCH_LAYOUT=0 $(PY) bench.py
 
 clean:
 	rm -rf build dist *.egg-info simtpu/native/_build

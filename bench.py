@@ -63,7 +63,11 @@ to force the background AOT precompile pipeline off/on (unset = auto: on
 for accelerator backends; `make bench-cold` runs a small-shape cold-start
 smoke with the persistent cache off), SIMTPU_BENCH_LAYOUT=1/0 to force/skip
 the carried-state layout A/B point (`state_bytes` vs `state_bytes_dense`,
-SIMTPU_COMPACT A/B, `make bench-layout` = the small-shape asserting smoke).
+SIMTPU_COMPACT A/B, `make bench-layout` = the small-shape asserting smoke),
+SIMTPU_BENCH_DURABLE=1/0 to force/skip the durable-execution smoke
+(checkpoint→kill→resume bit-identity + injected-OOM backoff A/B, `make
+bench-durable` = the asserting smoke; `backoff_events`/`backoff_chunk_min`
+ride every run's JSON line).
 
 Byte telemetry rides every run: `fetch_bytes` (device→host payload of one
 warm placement, next to the `fetches` round-trip count),
@@ -449,6 +453,171 @@ def layout_point() -> dict:
         "state_compact_ratio": round(ratio, 2),
         "layout_compact_s": round(compact_s, 2),
         "layout_dense_s": round(dense_s, 2),
+    }
+
+
+def durable_point() -> dict:
+    """Durable-execution smoke (ISSUE 6, docs/robustness.md): (1) a small
+    incremental plan checkpointed, killed mid-search, and resumed — the
+    resumed PlanResult must be bit-identical (node count, per-node pod
+    names) to the uninterrupted checkpointed run; (2) an injected
+    RESOURCE_EXHAUSTED on the bulk dispatcher's first chunk — the
+    chunk-halving backoff must converge to bit-identical placements and
+    record its events.  `make bench-durable` runs this alone with
+    SIMTPU_BENCH_DURABLE_ASSERT=1, which fails the run on any divergence."""
+    import shutil
+    import tempfile
+
+    from simtpu.core.objects import ResourceTypes
+    from simtpu.core.tensorize import Tensorizer
+    from simtpu.durable import (
+        PlanCheckpoint,
+        PlanInterrupted,
+        RunControl,
+        backoff_counts,
+        plan_fingerprint,
+    )
+    from simtpu.engine.rounds import RoundsEngine
+    from simtpu.plan.incremental import plan_capacity_incremental
+    from simtpu.synth import make_node, synth_apps
+    from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
+
+    n_pods = int(os.environ.get("SIMTPU_BENCH_DURABLE_PODS", 60))
+    note(f"durable point: checkpoint→kill→resume on a {n_pods}-pod plan")
+    cluster = ResourceTypes()
+    cluster.nodes = [
+        make_node(
+            f"node-{i}", 8000, 16,
+            {"topology.kubernetes.io/zone": f"zone-{i % 2}",
+             "kubernetes.io/hostname": f"node-{i}"},
+        )
+        for i in range(3)
+    ]
+    apps = synth_apps(
+        n_pods, seed=7, zones=2, pods_per_deployment=10,
+        anti_affinity_frac=0.2, spread_frac=0.3,
+    )
+    template = make_node(
+        "tmpl", 16000, 64,
+        {"kubernetes.io/hostname": "tmpl",
+         "topology.kubernetes.io/zone": "zone-0"},
+    )
+    fp = plan_fingerprint(cluster, apps, template, extra={})
+
+    class _Kill(RunControl):
+        """Interrupt after `n` candidate boundaries — the deterministic
+        stand-in for a mid-bisection kill."""
+
+        def __init__(self, n):
+            super().__init__()
+            self.n = n
+
+        def check(self):
+            self.n -= 1
+            if self.n < 0:
+                raise PlanInterrupted("bench kill")
+            super().check()
+
+    def placements(plan):
+        return {
+            s.node["metadata"]["name"]: sorted(
+                p["metadata"]["name"] for p in s.pods
+            )
+            for s in plan.result.node_status
+        }
+
+    tmp = tempfile.mkdtemp(prefix="simtpu-durable-")
+    try:
+        ck_full = PlanCheckpoint(
+            os.path.join(tmp, "full"), kind="incremental", fingerprint=fp
+        )
+        t0 = time.perf_counter()
+        full = plan_capacity_incremental(
+            cluster, apps, template, max_new_nodes=30, checkpoint=ck_full
+        )
+        full_s = time.perf_counter() - t0
+        ck_dir = os.path.join(tmp, "killed")
+        ck = PlanCheckpoint(ck_dir, kind="incremental", fingerprint=fp)
+        part = plan_capacity_incremental(
+            cluster, apps, template, max_new_nodes=30,
+            checkpoint=ck, control=_Kill(2),
+        )
+        ck_r = PlanCheckpoint(
+            ck_dir, kind="incremental", fingerprint=fp, resume=True
+        )
+        t0 = time.perf_counter()
+        resumed = plan_capacity_incremental(
+            cluster, apps, template, max_new_nodes=30, checkpoint=ck_r
+        )
+        resume_s = time.perf_counter() - t0
+        resume_ok = (
+            part.partial
+            and full.success
+            and resumed.success
+            and resumed.nodes_added == full.nodes_added
+            and placements(resumed) == placements(full)
+        )
+        records = len(ck_full)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    note(
+        f"durable: resume bit-identical={resume_ok} "
+        f"(full {full_s:.2f}s, resumed {resume_s:.2f}s, "
+        f"{records} checkpoint records)"
+    )
+
+    # injected-OOM backoff A/B on the bulk dispatcher: first chunk OOMs,
+    # the halving replay must land the exact same placements
+    pods = []
+    for a in apps:
+        pods.extend(get_valid_pods_exclude_daemonset(a.resource))
+
+    def place():
+        tz = Tensorizer(
+            cluster.nodes, storage_classes=cluster.storage_classes
+        )
+        eng = RoundsEngine(tz)
+        nodes, _, _ = eng.place(tz.add_pods(pods))
+        return np.asarray(nodes)
+
+    clean = place()
+    b0 = backoff_counts()
+    real = RoundsEngine._dispatch_bulk_chunk
+    hits = [0]
+
+    def fail_first(self, *args, **kwargs):
+        hits[0] += 1
+        if hits[0] <= 1:
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected bench OOM")
+        return real(self, *args, **kwargs)
+
+    RoundsEngine._dispatch_bulk_chunk = fail_first
+    try:
+        oomed = place()
+    finally:
+        RoundsEngine._dispatch_bulk_chunk = real
+    b1 = backoff_counts()
+    backoff_ok = (
+        np.array_equal(clean, oomed) and b1["events"] - b0["events"] >= 1
+    )
+    note(
+        f"durable: backoff bit-identical={backoff_ok} "
+        f"({b1['events'] - b0['events']} events, "
+        f"min chunk {b1['chunk_min']})"
+    )
+    if os.environ.get("SIMTPU_BENCH_DURABLE_ASSERT", "0") == "1":
+        assert resume_ok, (
+            "resumed PlanResult diverged from the uninterrupted run"
+        )
+        assert backoff_ok, (
+            "backoff replay diverged or recorded no events"
+        )
+    return {
+        "durable_resume_identical": bool(resume_ok),
+        "durable_checkpoint_records": records,
+        "durable_full_plan_s": round(full_s, 2),
+        "durable_resume_s": round(resume_s, 2),
+        "durable_backoff_identical": bool(backoff_ok),
     }
 
 
@@ -847,13 +1016,33 @@ def main() -> int:
         except Exception as exc:  # noqa: BLE001 - report, keep the line
             note(f"layout point failed: {type(exc).__name__}: {exc}")
             record["layout_error"] = f"{type(exc).__name__}: {exc}"
+    # durable-execution smoke (ISSUE 6): on by default at north-star runs,
+    # SIMTPU_BENCH_DURABLE=1 forces it at any configuration (`make
+    # bench-durable` = the small-shape asserting smoke), =0 skips
+    durable_env = os.environ.get("SIMTPU_BENCH_DURABLE", "")
+    if durable_env != "0" and (north_star or durable_env == "1"):
+        try:
+            record.update(durable_point())
+        except Exception as exc:  # noqa: BLE001 - report, keep the line
+            note(f"durable point failed: {type(exc).__name__}: {exc}")
+            record["durable_error"] = f"{type(exc).__name__}: {exc}"
+    # OOM-backoff telemetry (durable/backoff.py): process-lifetime
+    # counters — nonzero only when a dispatch really hit
+    # RESOURCE_EXHAUSTED (or the durable point injected one)
+    from simtpu.durable import backoff_counts as _backoff_counts
+
+    bc = _backoff_counts()
+    record["backoff_events"] = bc["events"]
+    record["backoff_chunk_min"] = bc["chunk_min"]
     print(json.dumps(record))
-    # a failed plan/big/fault/layout phase keeps the placement record but
-    # signals the failure through the exit status (drivers record both)
+    # a failed plan/big/fault/layout/durable phase keeps the placement
+    # record but signals the failure through the exit status (drivers
+    # record both)
     return 1 if any(
         key in record
         for key in (
-            "plan_error", "big_point_error", "fault_error", "layout_error"
+            "plan_error", "big_point_error", "fault_error", "layout_error",
+            "durable_error",
         )
     ) else 0
 

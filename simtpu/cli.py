@@ -62,6 +62,10 @@ def _plan_json(plan, resilience: dict = None) -> str:
         "success": plan.success,
         "nodes_added": plan.nodes_added,
         "message": plan.message,
+        # the partial-result contract (docs/robustness.md): True when a
+        # deadline/SIGINT interrupted the search and nodes_added reports
+        # only the best candidate verified so far (-1 = none)
+        "partial": plan.partial,
         "engine": plan.engine,
         "probes": {str(k): v for k, v in sorted(plan.probes.items())},
         "timings": {k: round(v, 3) for k, v in plan.timings.items()},
@@ -143,6 +147,12 @@ def cmd_apply(args: argparse.Namespace) -> int:
         shard=args.shard,
         precompile=args.precompile,
         corrected_ds_overhead=args.corrected_ds_overhead,
+        checkpoint=args.checkpoint or "",
+        resume=args.resume,
+        deadline=args.deadline,
+        # first ^C = graceful partial result + flushed checkpoint; second
+        # ^C = the default KeyboardInterrupt (durable/deadline.py)
+        install_sigint=True,
     )
     def fail_early(exc: Exception) -> int:
         # the --json contract holds on EVERY exit: config/load failures
@@ -214,6 +224,8 @@ def cmd_apply(args: argparse.Namespace) -> int:
         elif fault_error is not None:
             resilience = {"error": fault_error}
         print(_plan_json(plan, resilience=resilience))
+        if plan.partial:
+            return EXIT_PARTIAL
         return 0 if plan.success else 1
     if plan.success:
         print(f"{C.COLOR_GREEN}Success!{C.COLOR_RESET}")
@@ -242,7 +254,7 @@ def cmd_apply(args: argparse.Namespace) -> int:
         print(C.COLOR_RED, end="")
         print(report(plan.result.node_status, opts.extended_resources))
         print(C.COLOR_RESET, end="")
-    return 1
+    return EXIT_PARTIAL if plan.partial else 1
 
 
 def cmd_resilience(args: argparse.Namespace) -> int:
@@ -274,29 +286,74 @@ def cmd_resilience(args: argparse.Namespace) -> int:
     def progress(msg: str) -> None:
         print(f"{C.COLOR_YELLOW}{msg}{C.COLOR_RESET}", file=progress_stream)
 
+    if not args.plan and (
+        args.checkpoint or args.resume or args.deadline is not None
+    ):
+        # the assessment mode is ONE sweep — there are no candidate
+        # boundaries to checkpoint between or to poll a deadline at
+        return fail_early(
+            ValueError("--checkpoint/--resume/--deadline require --plan "
+                       "(the assessment sweep has no candidate "
+                       "boundaries)")
+        )
     try:
         cluster = applier.load_cluster()
         apps = applier.load_apps()
         sched_config = applier._sched_config()
         if args.plan:
+            from .durable import PlanCheckpoint, RunControl, plan_fingerprint
+            from .durable.checkpoint import file_digest
             from .plan.resilience import plan_resilience
 
             new_node = applier.load_new_node()
-            plan = plan_resilience(
-                cluster,
-                apps,
-                new_node,
-                spec=args.faults,
-                quantile=args.quantile,
-                samples=args.samples,
-                seed=args.seed,
-                max_new_nodes=args.max_new_nodes,
-                extended_resources=opts.extended_resources,
-                progress=progress,
-                sched_config=sched_config,
-            )
+            checkpoint = None
+            if args.checkpoint:
+                checkpoint = PlanCheckpoint(
+                    args.checkpoint,
+                    kind="resilience",
+                    fingerprint=plan_fingerprint(
+                        cluster, apps, new_node,
+                        extra={
+                            "spec": args.faults,
+                            "quantile": args.quantile,
+                            "samples": args.samples,
+                            "seed": args.seed,
+                            "max_new_nodes": args.max_new_nodes,
+                            "extended_resources": list(
+                                opts.extended_resources
+                            ),
+                            # CONTENT digest (see plan/capacity.py):
+                            # editing the sched-config between a kill
+                            # and a --resume must refuse
+                            "sched_config": file_digest(
+                                opts.default_scheduler_config
+                            ),
+                        },
+                    ),
+                    resume=args.resume,
+                )
+            elif args.resume:
+                raise ValueError("--resume requires --checkpoint DIR")
+            control = RunControl(deadline=args.deadline)
+            with control.sigint():
+                plan = plan_resilience(
+                    cluster,
+                    apps,
+                    new_node,
+                    spec=args.faults,
+                    quantile=args.quantile,
+                    samples=args.samples,
+                    seed=args.seed,
+                    max_new_nodes=args.max_new_nodes,
+                    extended_resources=opts.extended_resources,
+                    progress=progress,
+                    sched_config=sched_config,
+                    checkpoint=checkpoint,
+                    control=control,
+                )
             if args.json:
                 doc = plan.counters()
+                doc["partial"] = plan.partial
                 doc["message"] = plan.message
                 doc["probes"] = {
                     str(i): rec for i, rec in sorted(plan.probes.items())
@@ -304,17 +361,20 @@ def cmd_resilience(args: argparse.Namespace) -> int:
                 if plan.sweep is not None:
                     doc["worst"] = [[lbl, n] for lbl, n in plan.sweep.worst()]
                 print(json.dumps(doc))
-                return 0 if plan.success else 1
-            color = C.COLOR_GREEN if plan.success else C.COLOR_RED
-            print(f"{color}{plan.message}{C.COLOR_RESET}")
-            if plan.success:
-                print(
-                    f"minimum nodes added for survivability: {plan.nodes_added}"
-                )
-            if plan.sweep is not None:
-                from .report import resilience_report
+            else:
+                color = C.COLOR_GREEN if plan.success else C.COLOR_RED
+                print(f"{color}{plan.message}{C.COLOR_RESET}")
+                if plan.success:
+                    print(
+                        "minimum nodes added for survivability: "
+                        f"{plan.nodes_added}"
+                    )
+                if plan.sweep is not None:
+                    from .report import resilience_report
 
-                print(resilience_report(plan.sweep))
+                    print(resilience_report(plan.sweep))
+            if plan.partial:
+                return EXIT_PARTIAL
             return 0 if plan.success else 1
 
         from .faults import generate_scenarios, place_cluster, sweep_scenarios
@@ -366,6 +426,43 @@ def cmd_resilience(args: argparse.Namespace) -> int:
 def cmd_version(_args: argparse.Namespace) -> int:
     print(f"simtpu version {__version__}")
     return 0
+
+
+#: exit code for a plan interrupted by --deadline or SIGINT: the run ended
+#: cleanly with a flushed checkpoint and a `partial=true` report, but the
+#: search did not complete — distinct from 1 ("the plan ran and failed")
+EXIT_PARTIAL = 3
+
+
+def _add_durable_flags(p: argparse.ArgumentParser) -> None:
+    """Durable-execution flags shared by the planning commands
+    (docs/robustness.md)."""
+    p.add_argument(
+        "--checkpoint",
+        metavar="DIR",
+        help="persist a versioned checkpoint record after each completed "
+        "search candidate under DIR; a killed or interrupted run loses at "
+        "most the in-flight candidate",
+    )
+    p.add_argument(
+        "--resume",
+        action="store_true",
+        help="replay the completed candidates recorded under --checkpoint "
+        "DIR instead of re-simulating them (refuses loudly when the "
+        "config/cluster fingerprint does not match); the resumed result "
+        "is bit-identical to an uninterrupted run",
+    )
+    p.add_argument(
+        "--deadline",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="wall-clock budget for the plan search; on expiry (or on the "
+        "first ^C) the run flushes a final checkpoint and exits with code "
+        f"{EXIT_PARTIAL} and a structured partial result (best candidate "
+        "verified so far, partial=true under --json) instead of a "
+        "traceback",
+    )
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -501,6 +598,7 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="SEED",
         help="deterministic seed for sampled fault scenarios (default 0)",
     )
+    _add_durable_flags(apply_p)
     apply_p.set_defaults(func=cmd_apply)
 
     res_p = sub.add_parser(
@@ -580,6 +678,7 @@ def build_parser() -> argparse.ArgumentParser:
         "survived, fault_scenarios_per_s, worst scenarios, critical nodes) "
         "instead of the report tables",
     )
+    _add_durable_flags(res_p)
     res_p.set_defaults(func=cmd_resilience)
 
     ver_p = sub.add_parser("version", help="print version")

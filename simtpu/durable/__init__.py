@@ -1,0 +1,48 @@
+"""Durable execution: checkpoint/resume, OOM-aware backoff, deadlines.
+
+The planners and dispatch loops are minutes-to-hours long at the
+north-star shape, and before this package every run was all-or-nothing: a
+device RESOURCE_EXHAUSTED, a SIGINT, or a wall-clock limit threw away all
+completed search candidates, every placed chunk, and the warm AOT
+registry.  Three independent levers (docs/robustness.md):
+
+- `checkpoint`  — versioned on-disk plan checkpoints (`PlanCheckpoint`):
+  every completed search candidate's placement record persists under
+  `--checkpoint DIR`, and `--resume` replays the search from the records,
+  producing a `PlanResult` bit-identical to an uninterrupted run.  A
+  config/cluster fingerprint mismatch refuses loudly
+  (`CheckpointMismatch`).
+- `backoff`     — the chunk dispatchers (engine/scan.py, engine/rounds.py,
+  faults/sweep.py) catch XLA RESOURCE_EXHAUSTED, halve the chunk /
+  scenario-block size, and replay the failed chunk; placements are
+  chunk-size-invariant by construction, so results stay bit-identical.
+  `backoff_counts()` is the fetch_counts()-style telemetry the bench and
+  `--json` report.
+- `deadline`    — `RunControl` turns `--deadline SECONDS` and SIGINT into
+  a `PlanInterrupted` raised between candidates; the planners flush a
+  final checkpoint and return a structured partial result
+  (`PlanResult.partial`) instead of a traceback.
+"""
+
+from .backoff import backoff_counts, is_resource_exhausted, record_backoff
+from .checkpoint import (
+    CHECKPOINT_VERSION,
+    CheckpointMismatch,
+    PlanCheckpoint,
+    name_seed,
+    plan_fingerprint,
+)
+from .deadline import PlanInterrupted, RunControl
+
+__all__ = [
+    "CHECKPOINT_VERSION",
+    "CheckpointMismatch",
+    "PlanCheckpoint",
+    "PlanInterrupted",
+    "RunControl",
+    "backoff_counts",
+    "is_resource_exhausted",
+    "name_seed",
+    "plan_fingerprint",
+    "record_backoff",
+]

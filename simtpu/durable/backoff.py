@@ -1,0 +1,74 @@
+"""OOM-aware adaptive chunk backoff: shared predicate + telemetry.
+
+The chunk dispatchers (the serial scan's pow2 segments in
+`engine/scan.run_scan_chunked`, the bulk stretch chunks in
+`engine/rounds.RoundsEngine._dispatch`, and the scenario blocks in
+`faults/sweep.sweep_scenarios`) catch an XLA allocation failure, halve the
+failed chunk, and replay it from the same carried state.  Correctness
+rests on the chunking contracts those loops already pin:
+
+- serial scan segments are serial-EQUIVALENT — a chunk boundary never
+  changes a per-pod step, so any split of [a, b) replays to bit-identical
+  placements;
+- bulk backoff splits a chunk's SEGMENT list, never a segment: each run
+  still dispatches as its own consecutive rounds in the same order (the
+  round-start normalizers see the same state), so placements are
+  bit-identical.  A single round too large for memory propagates — a
+  mid-run split would move the normalizer boundary (the MAX_RUN contract);
+- fault-sweep scenario rows are independent — any block split is exact.
+
+Halved sizes stay powers of two, so retries re-snap into the existing
+shape buckets (PR 1) instead of tracing a fresh executable per shrink:
+at most log2(chunk) new shapes can ever appear under backoff.
+
+Donation caveat (docs/robustness.md): the dispatchers donate their
+carried state.  An allocation failure raised while XLA sets up the launch
+(the common RESOURCE_EXHAUSTED shape, and the injected-failure tests)
+leaves the donated buffers intact, so the replay reuses them; a failure
+after execution started invalidates them, in which case the replay's own
+error propagates and `Engine.place`'s dirty-carry guard rebuilds from the
+placement log on the next call.
+"""
+
+from __future__ import annotations
+
+import threading
+
+#: Monotone process-wide counters, fetch_counts()-style (engine/scan.py):
+#: "events" RESOURCE_EXHAUSTED catches, "splits" sub-dispatches created by
+#: the halving replays, "chunk_min" the smallest chunk/block size any
+#: backoff re-dispatched at (0 = no backoff yet).
+BACKOFF_COUNTS = {"events": 0, "splits": 0, "chunk_min": 0}
+_LOCK = threading.Lock()
+
+#: substrings that identify an allocator failure across jaxlib versions
+#: (XlaRuntimeError renders the status code name; older paths render the
+#: allocator message) — and the injected test fakes, by contract
+_MARKERS = ("RESOURCE_EXHAUSTED", "resource exhausted", "out of memory")
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    """True for XLA RESOURCE_EXHAUSTED / allocation failures (and the
+    injected test fakes, which carry the same marker in their message).
+    Deliberately message-based: jaxlib's XlaRuntimeError carries no typed
+    status code, and the class itself moved modules across versions."""
+    msg = str(exc).lower()
+    return any(m.lower() in msg for m in _MARKERS)
+
+
+def record_backoff(size_from: int, size_to: int) -> None:
+    """Count one caught RESOURCE_EXHAUSTED that split a chunk of
+    `size_from` into replays of `size_to`."""
+    with _LOCK:
+        BACKOFF_COUNTS["events"] += 1
+        BACKOFF_COUNTS["splits"] += 2
+        lo = BACKOFF_COUNTS["chunk_min"]
+        BACKOFF_COUNTS["chunk_min"] = (
+            int(size_to) if lo == 0 else min(lo, int(size_to))
+        )
+
+
+def backoff_counts() -> dict:
+    """Snapshot of the backoff counters (monotone over a process)."""
+    with _LOCK:
+        return dict(BACKOFF_COUNTS)

@@ -1,0 +1,227 @@
+"""Versioned on-disk plan checkpoints.
+
+One checkpoint directory holds one plan's search progress: a
+`manifest.json` (format version, planner kind, config/cluster
+fingerprint, record index) plus one `.npz` per completed search candidate
+(its placement vectors and verdict scalars).  Every write is atomic
+(tmp + os.replace), and the manifest is rewritten after each record — a
+kill at ANY point leaves a loadable checkpoint describing exactly the
+candidates that completed.
+
+Resume contract: the planners re-run their deterministic search, and
+every candidate with a record returns its persisted outcome instead of
+dispatching — so the resumed `PlanResult` is bit-identical to an
+uninterrupted run (pinned by tests/test_durable.py).  Bit-identity rests
+on two existing pins: candidate evaluation is deterministic given the
+ingest objects, and an engine carry rebuilt from the placement log equals
+the dispatched carry (the donated-state reuse guard tests).
+
+The fingerprint refuses cross-problem resumes loudly: it hashes the RAW
+ingest objects (cluster / apps / new-node manifests, before expansion —
+pod-name hash suffixes are random per process and deliberately excluded)
+plus the options that steer the search (engine selection, occupancy caps,
+fault spec...).  A mismatch raises `CheckpointMismatch` instead of
+silently replaying records from a different problem.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+#: bump when the record layout changes; older checkpoints refuse to resume
+CHECKPOINT_VERSION = 1
+
+_MANIFEST = "manifest.json"
+
+
+class CheckpointMismatch(ValueError):
+    """The checkpoint on disk does not match this plan (format version,
+    planner kind, or config/cluster fingerprint) — resuming would replay
+    records from a different problem, so we refuse loudly."""
+
+
+def file_digest(path: Optional[str]) -> str:
+    """Content digest of a config file for fingerprint `extra` entries
+    ("" when no path).  Hashing the CONTENT, not the path: editing e.g.
+    the scheduler-config between a kill and a --resume must change the
+    fingerprint and refuse, even though the path string is unchanged."""
+    if not path:
+        return ""
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _strip_provenance(obj):
+    """Drop the YAML loader's source-file stamp (`expand.SOURCE_KEY`)
+    from ingest objects before hashing: the stamp varies with how the
+    config path was spelled (relative vs absolute, cwd), and the
+    fingerprint must identify the PROBLEM, not the path to it."""
+    from ..workloads.expand import SOURCE_KEY
+
+    if isinstance(obj, list):
+        return [_strip_provenance(x) for x in obj]
+    if isinstance(obj, dict):
+        # the loader stamps top-level only, but recurse anyway — one
+        # nested copy leaking in later must not silently split problems
+        return {
+            k: _strip_provenance(v) for k, v in obj.items()
+            if k != SOURCE_KEY
+        }
+    return obj
+
+
+def plan_fingerprint(cluster, apps, new_node: Optional[dict], extra: Optional[dict] = None) -> str:
+    """Config/cluster fingerprint of one planning problem.
+
+    Hashes the raw ingest objects (pre-expansion: the YAML-shaped dicts,
+    stable across processes; manifest-path provenance stamps stripped)
+    and the search-steering options in `extra`.  Two runs with equal
+    fingerprints walk the same candidate sequence and produce identical
+    per-candidate outcomes — the precondition for replaying checkpoint
+    records.
+    """
+    h = hashlib.sha256()
+
+    def upd(tag: str, obj) -> None:
+        h.update(tag.encode())
+        h.update(b"\x00")
+        h.update(
+            json.dumps(
+                _strip_provenance(obj), sort_keys=True, default=str
+            ).encode()
+        )
+        h.update(b"\x01")
+
+    upd("cluster", {k: v for k, v in sorted(vars(cluster).items())})
+    for app in apps:
+        upd(f"app:{app.name}", {k: v for k, v in sorted(vars(app.resource).items())})
+    upd("new_node", new_node or {})
+    upd("extra", extra or {})
+    return h.hexdigest()
+
+
+def name_seed(fingerprint: str, cand: int = 0) -> int:
+    """Deterministic pod-name-suffix stream seed for one checkpointed
+    candidate evaluation.
+
+    Generated pod names carry a random hash suffix drawn from a process-
+    global stream (`workloads.expand`), so the same candidate evaluated at
+    a different stream position — a resumed run skips the recorded
+    candidates — would expand differently-named pods.  Checkpointed plans
+    therefore re-seed the stream per candidate from (fingerprint, cand):
+    every candidate's expansion becomes a pure function of the problem,
+    and a resumed run is bit-identical to the uninterrupted one INCLUDING
+    pod names, across processes."""
+    h = hashlib.sha256(f"{fingerprint}:{int(cand)}".encode()).digest()
+    return int.from_bytes(h[:8], "big")
+
+
+class PlanCheckpoint:
+    """Record store for one plan's completed search candidates.
+
+    `get(phase, cand)` returns the persisted record dict (numpy arrays /
+    0-d scalars) or None; `put(phase, cand, **entries)` persists one
+    atomically and updates the manifest — "persist after each completed
+    candidate" is exactly one `put` per candidate.  Records are keyed by
+    (phase, candidate index), phases being planner-defined ("base",
+    "probe", "verify", "cand", ...).
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        kind: str,
+        fingerprint: str,
+        resume: bool = False,
+    ):
+        self.directory = directory
+        self.kind = kind
+        self.fingerprint = fingerprint
+        self._records: Dict[str, str] = {}  # "phase:cand" -> npz filename
+        os.makedirs(directory, exist_ok=True)
+        mpath = os.path.join(directory, _MANIFEST)
+        if resume:
+            if not os.path.isfile(mpath):
+                raise CheckpointMismatch(
+                    f"--resume: no checkpoint manifest under {directory!r}"
+                )
+            with open(mpath) as f:
+                man = json.load(f)
+            if man.get("version") != CHECKPOINT_VERSION:
+                raise CheckpointMismatch(
+                    f"checkpoint format v{man.get('version')} != "
+                    f"v{CHECKPOINT_VERSION}; refusing to resume"
+                )
+            if man.get("kind") != kind:
+                raise CheckpointMismatch(
+                    f"checkpoint was written by the {man.get('kind')!r} "
+                    f"planner, this run selected {kind!r}; refusing to "
+                    "resume (pass the same engine flags)"
+                )
+            if man.get("fingerprint") != fingerprint:
+                raise CheckpointMismatch(
+                    "checkpoint config/cluster fingerprint mismatch: the "
+                    "records under "
+                    f"{directory!r} were written for a different problem "
+                    "or different options; refusing to resume"
+                )
+            self._records = dict(man.get("records") or {})
+        else:
+            # fresh plan: start a clean index (stale record files from an
+            # unrelated plan are harmless — the manifest is the index)
+            self._write_manifest()
+
+    # -- record IO --------------------------------------------------------
+
+    @staticmethod
+    def _key(phase: str, cand: int) -> str:
+        return f"{phase}:{int(cand)}"
+
+    def get(self, phase: str, cand: int) -> Optional[dict]:
+        """The persisted record for (phase, cand), or None.  Values load
+        as numpy arrays (scalars as 0-d arrays; strings as 0-d unicode)."""
+        fname = self._records.get(self._key(phase, cand))
+        if fname is None:
+            return None
+        path = os.path.join(self.directory, fname)
+        with np.load(path, allow_pickle=False) as z:
+            return {k: z[k] for k in z.files}
+
+    def put(self, phase: str, cand: int, **entries) -> None:
+        """Persist one completed candidate's record atomically and index
+        it in the manifest (also rewritten atomically)."""
+        key = self._key(phase, cand)
+        fname = f"rec_{phase}_{int(cand)}.npz"
+        path = os.path.join(self.directory, fname)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez_compressed(
+                f, **{k: np.asarray(v) for k, v in entries.items()}
+            )
+        os.replace(tmp, path)
+        self._records[key] = fname
+        self._write_manifest()
+
+    def __len__(self) -> int:
+        return len(self._records)
+
+    def _write_manifest(self) -> None:
+        mpath = os.path.join(self.directory, _MANIFEST)
+        tmp = mpath + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "version": CHECKPOINT_VERSION,
+                    "kind": self.kind,
+                    "fingerprint": self.fingerprint,
+                    "records": self._records,
+                },
+                f,
+                indent=1,
+            )
+        os.replace(tmp, mpath)

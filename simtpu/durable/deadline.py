@@ -1,0 +1,108 @@
+"""Deadline + interrupt safety for long plans.
+
+`RunControl` is the cooperative cancellation token the planners poll
+between search candidates (`control.check()`): a wall-clock deadline or a
+delivered SIGINT turns the NEXT check into a `PlanInterrupted`, which the
+planners catch to flush a final checkpoint and return a structured
+partial result (`PlanResult.partial`) instead of dying with a traceback.
+
+Polling granularity is the candidate boundary by design: a candidate's
+placement is one pipelined device workload (interrupting it mid-flight
+would discard it anyway), and every completed candidate is exactly what
+the checkpoint persists — so the deadline can overshoot by at most one
+candidate's wall-clock, documented in docs/robustness.md.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import signal
+import time
+from typing import Optional
+
+
+class PlanInterrupted(RuntimeError):
+    """A plan was cooperatively interrupted (deadline or SIGINT).  The
+    planners catch this and produce a partial PlanResult; it escaping to
+    the user is a bug."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+def partial_message(
+    reason: str,
+    best: Optional[int],
+    checkpoint=None,
+    what: str = "plan",
+    none_note: str = "no feasible candidate found yet",
+) -> str:
+    """The one partial-result message all three planners emit — drivers
+    parse it, so the wording lives in exactly one place."""
+    note = f"best candidate so far: {best} node(s)" if best is not None else none_note
+    msg = f"{what} interrupted ({reason}): {note}"
+    if checkpoint is not None:
+        msg += f"; checkpoint flushed to {checkpoint.directory}"
+    return msg
+
+
+class RunControl:
+    """Cooperative deadline/interrupt token threaded through a plan.
+
+    `deadline` is seconds from construction (None = none).  `trigger()`
+    flags an external interrupt (the SIGINT handler calls it); the next
+    `check()` raises `PlanInterrupted`.  Construction is cheap and the
+    object is single-plan: the deadline clock starts at __init__.
+    """
+
+    def __init__(self, deadline: Optional[float] = None):
+        self._t0 = time.monotonic()
+        self.deadline = deadline
+        self._interrupt: Optional[str] = None
+
+    @property
+    def interrupted(self) -> Optional[str]:
+        return self._interrupt
+
+    def trigger(self, reason: str = "interrupt") -> None:
+        self._interrupt = reason
+
+    def remaining(self) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return self.deadline - (time.monotonic() - self._t0)
+
+    def check(self) -> None:
+        """Raise PlanInterrupted when flagged or past the deadline."""
+        if self._interrupt is not None:
+            raise PlanInterrupted(self._interrupt)
+        rem = self.remaining()
+        if rem is not None and rem <= 0:
+            raise PlanInterrupted(
+                f"deadline of {self.deadline:g}s exceeded"
+            )
+
+    @contextlib.contextmanager
+    def sigint(self):
+        """Install a SIGINT handler that flags this control (first ^C =
+        graceful partial result; second ^C = the default KeyboardInterrupt
+        so a stuck run can still be killed).  Restores the previous
+        handler on exit.  No-op outside the main thread (signal.signal
+        refuses there — library callers on worker threads just don't get
+        the handler)."""
+
+        def handler(signum, frame):
+            if self._interrupt is not None:
+                raise KeyboardInterrupt
+            self.trigger("SIGINT")
+
+        try:
+            prev = signal.signal(signal.SIGINT, handler)
+        except ValueError:  # not the main thread
+            yield self
+            return
+        try:
+            yield self
+        finally:
+            signal.signal(signal.SIGINT, prev)

@@ -86,6 +86,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensorize import DOM_SMALL
+from ..durable.backoff import is_resource_exhausted, record_backoff
 from ..kernels.filters import _RES_EPS, interpod_filter, topology_spread_filter
 from .scan import (
     Engine,
@@ -1329,6 +1330,49 @@ class RoundsEngine(Engine):
             work["quota"], work["self_aff"], work["ext_mats"],
         )
 
+    def _bulk_backoff(self, statics, state, work, pods, tensors, flags):
+        """Replay an OOM'd bulk chunk as two half-chunks, each re-chunked
+        through `_chunk_runs` so it carries its own term-row union
+        (durable/backoff.py).  Splits the SEGMENT list only: each run
+        still dispatches as its own consecutive rounds in the same order,
+        so the round-start normalizers see the same states and placements
+        are bit-identical.  A single round too large for memory
+        propagates — a mid-run split would move the normalizer boundary
+        (the MAX_RUN contract).  Returns (state, [(chunk, ext_mats,
+        outs_dev), ...]) matching the dispatcher's pending-entry shape."""
+        chunk = work["chunk"]
+        quota, self_aff, ext_mats = (
+            work["quota"], work["self_aff"], work["ext_mats"],
+        )
+        h = max(len(chunk) // 2, 1)
+        record_backoff(len(chunk), h)
+        batch = self._current_batch
+        done = []
+        for half in (chunk[:h], chunk[h:]):
+            if not half:
+                continue
+            for sub, rows_p in self._chunk_runs(
+                half, batch, tensors,
+                max_segs=self.MATS_CHUNK if ext_mats else None,
+            ):
+                w2 = self._prepare_bulk_chunk(
+                    sub, rows_p, pods, tensors, flags, quota, self_aff,
+                    ext_mats,
+                )
+                try:
+                    state, outs = self._dispatch_bulk_chunk(
+                        statics, state, w2, tensors, flags
+                    )
+                    done.append((w2["chunk"], ext_mats, outs))
+                except Exception as exc:
+                    if not is_resource_exhausted(exc) or len(w2["chunk"]) <= 1:
+                        raise
+                    state, sub_done = self._bulk_backoff(
+                        statics, state, w2, pods, tensors, flags
+                    )
+                    done.extend(sub_done)
+        return state, done
+
     def _bulk_chunk(
         self, statics, state, chunk, rows_p, pods, tensors, flags,
         quota=False, self_aff=False, ext_mats=False,
@@ -1466,17 +1510,29 @@ class RoundsEngine(Engine):
                 else None
             )
             while work is not None:
-                state, outs_dev = self._dispatch_bulk_chunk(
-                    statics, state, work, tensors, flags
-                )
+                try:
+                    state, outs_dev = self._dispatch_bulk_chunk(
+                        statics, state, work, tensors, flags
+                    )
+                    done = [(work["chunk"], work["ext_mats"], outs_dev)]
+                except Exception as exc:
+                    # OOM backoff: replay the chunk as half-chunks from the
+                    # carried state (placements bit-identical — the split
+                    # is at segment granularity; see _bulk_backoff)
+                    if not is_resource_exhausted(exc) or len(work["chunk"]) <= 1:
+                        raise
+                    state, done = self._bulk_backoff(
+                        statics, state, work, pods, tensors, flags
+                    )
                 # start the device→host copies NOW: the transfers ride the
                 # tunnel concurrently with later dispatches, so the fetch
                 # below waits on completion instead of paying one serial
                 # round-trip per array
-                for o in outs_dev:
-                    if hasattr(o, "copy_to_host_async"):
-                        o.copy_to_host_async()
-                pending.append((work["chunk"], work["ext_mats"], outs_dev))
+                for _, _, outs_dev_c in done:
+                    for o in outs_dev_c:
+                        if hasattr(o, "copy_to_host_async"):
+                            o.copy_to_host_async()
+                pending.extend(done)
                 nxt = next(items, None)
                 work = (
                     self._prepare_bulk_chunk(

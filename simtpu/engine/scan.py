@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensorize import ClusterTensors, PodBatch
+from ..durable.backoff import is_resource_exhausted, record_backoff
 from ..kernels.filters import (
     attach_limits_ok,
     interpod_filter,
@@ -1210,11 +1211,11 @@ def run_scan_chunked(
 
     inv_g_cache = {}
 
-    def prep_seg(di):
+    def prep_range(i, a, b):
         """Host-gather + pad + (optionally) start the device transfer of
-        dispatch di's pod segment.  Pure function of the plan — safe to
-        run one dispatch ahead of the dispatch point."""
-        i, _, a, b, _ = dispatches[di]
+        [a, b)'s pod segment under chunk plan entry i's group slicing.
+        Pure function of the plan — safe to run one dispatch ahead of the
+        dispatch point, and re-entrant for the OOM-backoff replays."""
         gs_p = plan[i][2]
         seg_arrays = [arr[a:b] for arr in pods]
         if gs_p is not None:
@@ -1226,6 +1227,11 @@ def run_scan_chunked(
             seg_arrays[0] = inv_g[np.asarray(seg_arrays[0])]
         seg = pad_pods_pow2(tuple(seg_arrays), _pow2_up(b - a))
         return prefetch(seg) if prefetch is not None else seg
+
+    def prep_seg(di):
+        """Dispatch di's prepared pod segment (see prep_range)."""
+        i, _, a, b, _ = dispatches[di]
+        return prep_range(i, a, b)
 
     # active slice context: the (group set, term-row set) the current
     # eff_statics / sliced count planes were built for
@@ -1244,6 +1250,26 @@ def run_scan_chunked(
         )
         ctx_rows, full_match, full_total = None, None, None
         return state
+
+    def backoff_scan(state, i, a, b, eff):
+        """Replay [a, b) through the general scan in halves after a
+        RESOURCE_EXHAUSTED (durable/backoff.py): scan chunking is
+        serial-equivalent, so any split replays to bit-identical
+        placements, and the pow2 halves re-snap into existing shape
+        buckets.  Returns (state, [(outs, real, None), ...])."""
+        mid = a + (b - a) // 2
+        entries = []
+        for x, y in ((a, mid), (mid, b)):
+            try:
+                state, outs = call(eff, state, prep_range(i, x, y), flags)
+                entries.append((outs, y - x, None))
+            except Exception as exc:
+                if not is_resource_exhausted(exc) or y - x <= 1:
+                    raise
+                record_backoff(y - x, (y - x + 1) // 2)
+                state, sub = backoff_scan(state, i, x, y, eff)
+                entries.extend(sub)
+        return state, entries
 
     outs_dev = []
     eff_statics = statics
@@ -1294,14 +1320,26 @@ def run_scan_chunked(
                 ctx_rows = rows_p
             ctx_key = key
         seg = next_seg
-        if kind == "wave":
-            state, outs, accepts = wave_call(
-                eff_statics, state, seg, flags,
-                wave_static_spec(tensors, w_mode[0], w_mode[1]),
-            )
-        else:
-            state, outs = call(eff_statics, state, seg, flags)
-            accepts = None
+        try:
+            if kind == "wave":
+                state, outs, accepts = wave_call(
+                    eff_statics, state, seg, flags,
+                    wave_static_spec(tensors, w_mode[0], w_mode[1]),
+                )
+            else:
+                state, outs = call(eff_statics, state, seg, flags)
+                accepts = None
+            entries = [(outs, b - a, accepts)]
+        except Exception as exc:
+            # OOM backoff: halve the segment and replay from the carried
+            # state through the general scan (an OOM'd WAVEFRONT also
+            # replays through the scan — placements are bit-identical by
+            # the speculation contract, it merely forfeits that run's
+            # accept telemetry).  Single-pod segments cannot shrink.
+            if not is_resource_exhausted(exc) or b - a <= 1:
+                raise
+            record_backoff(b - a, (b - a + 1) // 2)
+            state, entries = backoff_scan(state, i, a, b, eff_statics)
         # double buffer: the next segment starts its transfer while this
         # one executes (the dispatch above is async)
         if di + 1 < len(dispatches):
@@ -1309,7 +1347,7 @@ def run_scan_chunked(
         # keep outputs on device: a per-chunk device_get would sync the
         # tunnel once per chunk; all dispatches queue first and one
         # batched transfer materializes everything afterwards
-        outs_dev.append((outs, b - a, accepts))
+        outs_dev.extend(entries)
     state = flush(state)
     fetched = fetch_outputs([(o, acc) for o, _, acc in outs_dev])
     outs_host = []

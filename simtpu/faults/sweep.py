@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..durable.backoff import is_resource_exhausted, record_backoff
 from ..engine.scan import (
     StepFlags,
     build_pod_arrays,
@@ -290,10 +291,10 @@ def sweep_scenarios(
     )
     _, pods_full = build_pod_arrays(pc.batch, r)
 
-    def gather_block(s0: int, s1: int):
+    def gather_block(s0: int, s1: int, sb: int):
         """Assemble one chunk's (valid, entries, pods, rq_idx) arrays,
-        padding the scenario axis with empty (failure-free) rows."""
-        sb = s_chunk
+        padding the scenario axis to `sb` with empty (failure-free)
+        rows."""
         ev_idx = np.full((sb, e_pad), -1, np.int64)
         rq_idx = np.full((sb, r_pad), -1, np.int64)
         valid = np.ones((sb, n), bool) & base_valid[None, :]
@@ -339,10 +340,25 @@ def sweep_scenarios(
     rq_nodes = np.full((s_total, r_pad), -1, np.int64)
     rq_reasons = np.zeros((s_total, r_pad), np.int32)
     t_sweep = 0.0
-    for s0 in range(0, s_total, s_chunk):
-        s1 = min(s0 + s_chunk, s_total)
+    backoff_events = 0
+    # a sharded sweep cannot shrink a block below one scenario per shard
+    min_block = 1
+    if mesh is not None:
+        from ..parallel.mesh import SWEEP_AXIS as _SW
+
+        min_block = int(mesh.shape[_SW])
+    # worklist of (s0, s1, block) scenario blocks: an OOM'd block halves
+    # and replays (durable/backoff.py) — scenario rows are independent, so
+    # any split is exact, and the pow2 halves keep the compiled-shape set
+    # at most log2(s_chunk) larger
+    blocks = [
+        (s0, min(s0 + s_chunk, s_total), s_chunk)
+        for s0 in range(0, s_total, s_chunk)
+    ]
+    while blocks:
+        s0, s1, sb = blocks.pop(0)
         ta = time.perf_counter()
-        valid, entries, pods, rq_idx = gather_block(s0, s1)
+        valid, entries, pods, rq_idx = gather_block(s0, s1, sb)
         if shardings is not None:
             valid = jax.device_put(jnp.asarray(valid), shardings[0])
             entries = jax.device_put(entries, shardings[1])
@@ -350,19 +366,40 @@ def sweep_scenarios(
         timings["assemble_s"] += time.perf_counter() - ta
         td = time.perf_counter()
         args = (statics, valid, state, entries, pods)
-        if pipeline is not None:
-            nodes_b, reasons_b = pipeline.call(
-                "fault_sweep", (flags,), args, lambda: _fault_sweep(*args, flags)
-            )
-        else:
-            nodes_b, reasons_b = _fault_sweep(*args, flags)
-        nodes_b = np.asarray(nodes_b)[: s1 - s0]
-        reasons_b = np.asarray(reasons_b)[: s1 - s0]
+        try:
+            if pipeline is not None:
+                nodes_b, reasons_b = pipeline.call(
+                    "fault_sweep", (flags,), args, lambda: _fault_sweep(*args, flags)
+                )
+            else:
+                nodes_b, reasons_b = _fault_sweep(*args, flags)
+            nodes_b = np.asarray(nodes_b)[: s1 - s0]
+            reasons_b = np.asarray(reasons_b)[: s1 - s0]
+        except Exception as exc:
+            if not is_resource_exhausted(exc) or sb <= min_block:
+                raise
+            half = max(sb // 2, min_block)
+            if mesh is not None:
+                half -= half % min_block
+                half = max(half, min_block)
+            record_backoff(sb, half)
+            backoff_events += 1
+            t_sweep += time.perf_counter() - td
+            # requeue [s0, s1) as blocks of AT MOST `half` scenarios each:
+            # every sub-block's span must fit its pad `half` (an odd span,
+            # or mesh rounding shrinking `half` below span/2, would
+            # otherwise overflow gather_block's arrays)
+            blocks[:0] = [
+                (x, min(x + half, s1), half) for x in range(s0, s1, half)
+            ]
+            continue
         t_sweep += time.perf_counter() - td
         rq_rows[s0:s1] = rq_idx[: s1 - s0]
         rq_nodes[s0:s1] = np.where(rq_idx[: s1 - s0] >= 0, nodes_b, -1)
         rq_reasons[s0:s1] = np.where(rq_idx[: s1 - s0] >= 0, reasons_b, 0)
     timings["sweep_s"] = t_sweep
+    if backoff_events:
+        timings["backoff_events"] = float(backoff_events)
     timings["total_s"] = time.perf_counter() - t0
     timings["scenarios_per_s"] = s_total / t_sweep if t_sweep > 0 else 0.0
 

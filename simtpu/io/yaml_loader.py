@@ -45,13 +45,27 @@ def parse_file_paths(path: str) -> List[str]:
     return out
 
 
+class SourcedText(str):
+    """A YAML document string that remembers its manifest file, so spec
+    diagnostics (`workloads.validate.SpecError`) can name it.  Plain-str
+    everywhere else — consumers that don't care never notice."""
+
+    source: str = ""
+
+    def __new__(cls, text: str, source: str):
+        self = super().__new__(cls, text)
+        self.source = source
+        return self
+
+
 def get_yaml_content_from_directory(path: str) -> List[str]:
-    """Return raw YAML strings for every .yaml/.yml under path."""
+    """Return raw YAML strings for every .yaml/.yml under path (each one
+    a `SourcedText` carrying its file path)."""
     docs = []
     for fp in parse_file_paths(path):
         if os.path.splitext(fp)[1] in (".yaml", ".yml"):
             with open(fp) as f:
-                docs.append(f.read())
+                docs.append(SourcedText(f.read(), fp))
     return docs
 
 
@@ -65,10 +79,18 @@ def decode_yaml_content(text: str) -> List[dict]:
 
 
 def get_objects_from_yaml_content(docs: List[str]) -> ResourceTypes:
-    """Type-switch decoded docs into ResourceTypes; unknown kinds are skipped."""
+    """Type-switch decoded docs into ResourceTypes; unknown kinds are
+    skipped (reference parity — app bundles legitimately carry Services,
+    ConfigMaps...).  Objects from `SourcedText` docs are stamped with
+    their manifest file for spec diagnostics."""
+    from ..workloads.expand import SOURCE_KEY
+
     resources = ResourceTypes()
     for text in docs:
+        source = getattr(text, "source", None)
         for obj in decode_yaml_content(text):
+            if source:
+                obj[SOURCE_KEY] = source
             resources.add(obj)
     return resources
 

@@ -46,6 +46,7 @@ from ..core.objects import (
     set_label,
 )
 from ..core.quantity import parse_quantity
+from ..durable.deadline import PlanInterrupted
 from ..io.cluster import (
     create_cluster_resource_from_client,
     create_cluster_resource_from_cluster_config,
@@ -79,6 +80,12 @@ class PlanResult:
     # observability behind the shape-bucketed probe sweep and bench.py's
     # cold-path tracking
     compiles: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    # True when the plan was interrupted (deadline / SIGINT) and this
+    # result reports only the best candidate verified BEFORE the
+    # interrupt (nodes_added = that candidate, or -1 when none) — the
+    # structured partial-result contract (docs/robustness.md); rides the
+    # CLI's --json as "partial"
+    partial: bool = False
 
 
 def new_fake_nodes(template: dict, count: int) -> List[dict]:
@@ -216,13 +223,27 @@ def plan_capacity(
     sched_config=None,
     corrected_ds_overhead: bool = False,
     precompile: bool = False,
+    checkpoint=None,
+    control=None,
 ) -> PlanResult:
-    """Find the minimum clone count of `new_node` that deploys everything."""
+    """Find the minimum clone count of `new_node` that deploys everything.
+
+    Durable execution (docs/robustness.md): with `checkpoint` (a
+    `durable.checkpoint.PlanCheckpoint`) every completed candidate's
+    verdict persists, and a resumed plan replays recorded candidates
+    instead of re-simulating them (the winning candidate re-simulates
+    once to materialize its SimulateResult — deterministic, so the
+    PlanResult is bit-identical to the uninterrupted run).  With
+    `control` (a `durable.deadline.RunControl`) the deadline/SIGINT check
+    runs before each candidate; an interrupt yields a partial PlanResult
+    (`partial=True`) instead of a traceback."""
     say = progress or (lambda s: None)
     probes: Dict[int, int] = {}
     all_daemon_sets = list(cluster.daemon_sets)
     for app in apps:
         all_daemon_sets += app.resource.daemon_sets
+    best_candidate: list = [None]  # lowest candidate found feasible
+    last_result: list = [None]  # most recent live SimulateResult
 
     def run(i: int) -> SimulateResult:
         say(f"add {i} node(s)")
@@ -237,6 +258,7 @@ def plan_capacity(
             precompile=precompile,
         )
         probes[i] = len(result.unscheduled_pods)
+        last_result[0] = result
         return result
 
     def diagnose(result: SimulateResult) -> Optional[str]:
@@ -278,96 +300,168 @@ def plan_capacity(
             say(reason.rstrip("\n"))
         return ok, reason
 
-    def linear_from(start: int, last_result: SimulateResult) -> PlanResult:
+    def evaluate(i: int, need_result: bool = False):
+        """(feasible, unscheduled, diagnosis, result) for candidate i —
+        replayed from the checkpoint record when one exists (resume
+        path; result is then None), else one live simulation, recorded
+        afterwards.  `need_result` forces the live run: the winning
+        candidate materializes its SimulateResult, and determinism makes
+        the re-run bit-identical to the recorded verdict's run."""
+        nonlocal cap_rejected
+        rec = None if checkpoint is None else checkpoint.get("cand", i)
+        if rec is not None and not need_result:
+            probes[i] = int(rec["unscheduled"])
+            if bool(rec["cap_rejected"]):
+                cap_rejected = True
+            ok = bool(rec["feasible"])
+            msg = str(rec["message"]) or None
+            if ok and (best_candidate[0] is None or i < best_candidate[0]):
+                best_candidate[0] = i
+            return ok, probes[i], msg, None
+        if control is not None:
+            control.check()
+        if checkpoint is not None:
+            # pin the pod-name suffix stream per candidate so a resumed
+            # run's live evaluations expand the exact pods the
+            # uninterrupted run's would — including the replayed winner's
+            # re-materialization (durable.checkpoint.name_seed)
+            from ..durable.checkpoint import name_seed
+            from ..workloads.expand import seed_name_hashes
+
+            seed_name_hashes(name_seed(checkpoint.fingerprint, i))
+        result = run(i)
+        ok, _ = feasible(result)
+        msg = diagnose(result) if result.unscheduled_pods else None
+        if checkpoint is not None:
+            # a cap rejection is per-candidate (fully scheduled, cap
+            # missed) — exactly the records whose replay must re-trigger
+            # the linear fallback on resume
+            checkpoint.put(
+                "cand", i,
+                unscheduled=probes[i], feasible=ok,
+                cap_rejected=(not ok) and not result.unscheduled_pods,
+                message=msg or "",
+            )
+        if ok and (best_candidate[0] is None or i < best_candidate[0]):
+            best_candidate[0] = i
+        return ok, probes[i], msg, result
+
+    def final_success(i: int, result) -> PlanResult:
+        if result is None:  # checkpoint-replayed winner: materialize live
+            _, _, _, result = evaluate(i, need_result=True)
+        return PlanResult(True, i, result, "Success!", probes)
+
+    def linear_from(start: int) -> PlanResult:
         """The reference-exact linear walk over [start, max_new_nodes);
         candidates already probed and found UNSCHEDULABLE are skipped
         (schedulability is monotone — more clones cannot unschedule
         them... fewer cannot schedule them), cap-rejected ones re-run."""
-        result = last_result
         for i in range(start, max_new_nodes):
             if i in probes and probes[i] > 0:
                 continue  # known unschedulable
-            result = run(i)
-            ok, _ = feasible(result)
+            ok, unsched, msg, result = evaluate(i)
             if ok:
-                return PlanResult(True, i, result, "Success!", probes)
-            if result.unscheduled_pods:
-                msg = diagnose(result)
-                if msg:
-                    return PlanResult(False, i, result, msg, probes)
-        return PlanResult(False, max_new_nodes, result, fail_msg, probes)
+                return final_success(i, result)
+            if unsched and msg:
+                return PlanResult(
+                    False, i, result or last_result[0], msg, probes
+                )
+        return PlanResult(
+            False, max_new_nodes, last_result[0], fail_msg, probes
+        )
 
     fail_msg = f"we have added {max_new_nodes} nodes but it still failed!!"
-    result = run(0)
-    ok, _ = feasible(result)
-    if ok:
-        return PlanResult(True, 0, result, "Success!", probes)
-    if result.unscheduled_pods:
-        msg = diagnose(result)
-        if msg:
-            return PlanResult(False, 0, result, msg, probes)
 
-    # the reference's loop is `for i := 0; i < MaxNumNewNode; i++`
-    # (apply.go:183) — the largest candidate ever tried is max_new_nodes-1
-    if search == "linear":
-        return linear_from(1, result)
-
-    def cap_fallback() -> PlanResult:
-        """A cap rejection makes feasibility potentially non-monotone —
-        bisection could skip the window the reference's walk would find.
-        Fall back loudly to the linear scan (pinned by
-        tests/test_plan.py's DaemonSet-overhead adversary)."""
-        import sys
-
-        msg = (
-            "simtpu: an occupancy cap rejected a fully-scheduled candidate; "
-            "cap feasibility can be non-monotone in the clone count "
-            "(DaemonSet overhead) — falling back to the reference's linear "
-            "scan"
-        )
-        print(msg, file=sys.stderr)
-        say(msg)
-        return linear_from(1, result)
-
-    # doubling probe then binary search (feasibility monotone in clone count)
-    hi, hi_result = None, None
-    probe = 1
-    while probe < max_new_nodes:
-        result = run(probe)
-        ok, _ = feasible(result)
-        if cap_rejected:
-            return cap_fallback()
+    def search_candidates() -> PlanResult:
+        nonlocal cap_rejected
+        ok, unsched, msg, result = evaluate(0)
         if ok:
+            return final_success(0, result)
+        if unsched and msg:
+            return PlanResult(False, 0, result or last_result[0], msg, probes)
+
+        # the reference's loop is `for i := 0; i < MaxNumNewNode; i++`
+        # (apply.go:183) — the largest candidate ever tried is
+        # max_new_nodes-1
+        if search == "linear":
+            return linear_from(1)
+
+        def cap_fallback() -> PlanResult:
+            """A cap rejection makes feasibility potentially non-monotone —
+            bisection could skip the window the reference's walk would
+            find.  Fall back loudly to the linear scan (pinned by
+            tests/test_plan.py's DaemonSet-overhead adversary)."""
+            import sys
+
+            msg = (
+                "simtpu: an occupancy cap rejected a fully-scheduled "
+                "candidate; cap feasibility can be non-monotone in the "
+                "clone count (DaemonSet overhead) — falling back to the "
+                "reference's linear scan"
+            )
+            print(msg, file=sys.stderr)
+            say(msg)
+            return linear_from(1)
+
+        # doubling probe then binary search (feasibility monotone in
+        # clone count)
+        hi, hi_result = None, None
+        probe = 1
+        while probe < max_new_nodes:
+            ok, unsched, msg, result = evaluate(probe)
+            if cap_rejected:
+                return cap_fallback()
+            if ok:
+                hi, hi_result = probe, result
+                break
+            if unsched and msg:
+                return PlanResult(
+                    False, probe, result or last_result[0], msg, probes
+                )
+            probe *= 2
+        if hi is None:
+            probe = max_new_nodes - 1
+            if probe in probes:  # already tried as the last doubling step
+                return PlanResult(
+                    False, max_new_nodes, last_result[0], fail_msg, probes
+                )
+            ok, unsched, msg, result = evaluate(probe)
+            if cap_rejected:
+                return cap_fallback()
+            if not ok:
+                return PlanResult(
+                    False, max_new_nodes, result or last_result[0],
+                    fail_msg, probes,
+                )
             hi, hi_result = probe, result
-            break
-        if result.unscheduled_pods:
-            msg = diagnose(result)
-            if msg:
-                return PlanResult(False, probe, result, msg, probes)
-        probe *= 2
-    if hi is None:
-        probe = max_new_nodes - 1
-        if probe in probes:  # already tried as the last doubling step
-            return PlanResult(False, max_new_nodes, result, fail_msg, probes)
-        result = run(probe)
-        ok, _ = feasible(result)
-        if cap_rejected:
-            return cap_fallback()
-        if not ok:
-            return PlanResult(False, max_new_nodes, result, fail_msg, probes)
-        hi, hi_result = probe, result
-    lo = hi // 2  # lowest infeasible known is hi//2 (or 0)
-    while hi - lo > 1:
-        mid = (lo + hi) // 2
-        result = run(mid)
-        ok, _ = feasible(result)
-        if cap_rejected:
-            return cap_fallback()
-        if ok:
-            hi, hi_result = mid, result
-        else:
-            lo = mid
-    return PlanResult(True, hi, hi_result, "Success!", probes)
+        lo = hi // 2  # lowest infeasible known is hi//2 (or 0)
+        while hi - lo > 1:
+            mid = (lo + hi) // 2
+            ok, _, _, result = evaluate(mid)
+            if cap_rejected:
+                return cap_fallback()
+            if ok:
+                hi, hi_result = mid, result
+            else:
+                lo = mid
+        return final_success(hi, hi_result)
+
+    try:
+        return search_candidates()
+    except PlanInterrupted as exc:
+        # deadline / SIGINT between candidates: the structured partial
+        # result — every completed candidate is already checkpointed
+        from ..durable.deadline import partial_message
+
+        best = best_candidate[0]
+        return PlanResult(
+            False,
+            -1 if best is None else best,
+            None,
+            partial_message(exc.reason, best, checkpoint),
+            probes,
+            partial=True,
+        )
 
 
 @dataclass
@@ -406,6 +500,17 @@ class ApplierOptions:
     # account daemonset overhead on the template node in the can-ever-fit
     # diagnostic (off = faithful to the reference's NewNodeNamePrefix quirk)
     corrected_ds_overhead: bool = False
+    # durable execution (docs/robustness.md): checkpoint directory for
+    # per-candidate plan records ("" = no checkpointing), `resume` replays
+    # a prior run's records from it (fingerprint-guarded), `deadline`
+    # bounds the plan's wall-clock in seconds (None = none), and
+    # `install_sigint` makes the first ^C a graceful interrupt (partial
+    # result + flushed checkpoint) — the CLI sets it; library callers
+    # keep their own signal handling
+    checkpoint: str = ""
+    resume: bool = False
+    deadline: Optional[float] = None
+    install_sigint: bool = False
 
 
 # Auto-engine thresholds: below both, the serial scan keeps its per-pod
@@ -576,12 +681,56 @@ class Applier:
         ctx = contextlib.nullcontext()
         if trace_dir:
             ctx = jax.profiler.trace(trace_dir)
+        from ..durable.backoff import backoff_counts
         from ..engine.scan import fetch_counts, wave_counts, wave_enabled
         from ..engine.state import state_gauge
 
         search, bulk, mesh = _resolve_engines(self.opts, cluster, apps)
         waves_before = wave_counts()
         fetch_before = fetch_counts()
+        backoff_before = backoff_counts()
+
+        # durable execution (docs/robustness.md): per-candidate checkpoint
+        # records under --checkpoint DIR, fingerprint-guarded resume, and
+        # a deadline/SIGINT control polled at candidate boundaries
+        checkpoint = None
+        control = None
+        if self.opts.checkpoint:
+            from ..durable.checkpoint import (
+                PlanCheckpoint,
+                file_digest,
+                plan_fingerprint,
+            )
+
+            fingerprint = plan_fingerprint(
+                cluster, apps, new_node,
+                extra={
+                    "search": search,
+                    "bulk": bool(bulk),
+                    "extended_resources": list(self.opts.extended_resources),
+                    "corrected_ds_overhead": self.opts.corrected_ds_overhead,
+                    # CONTENT digest: editing the sched-config between a
+                    # kill and a --resume must refuse, same path or not
+                    "sched_config": file_digest(
+                        self.opts.default_scheduler_config
+                    ),
+                    "caps": [
+                        _env_cap(C.ENV_MAX_CPU),
+                        _env_cap(C.ENV_MAX_MEMORY),
+                        _env_cap(C.ENV_MAX_VG),
+                    ],
+                },
+            )
+            checkpoint = PlanCheckpoint(
+                self.opts.checkpoint, kind=search, fingerprint=fingerprint,
+                resume=self.opts.resume,
+            )
+        elif self.opts.resume:
+            raise ValueError("--resume requires --checkpoint DIR")
+        if self.opts.deadline is not None or self.opts.install_sigint:
+            from ..durable.deadline import RunControl
+
+            control = RunControl(deadline=self.opts.deadline)
         # auto-ON for apply on accelerator backends: the one-shot CLI user
         # always pays the cold path, which is exactly what the background
         # AOT pipeline attacks.  CPU backends stay off under auto (the
@@ -592,7 +741,12 @@ class Applier:
             self.opts.precompile is None and jax.default_backend() != "cpu"
         )
         t0 = _time.perf_counter()
-        with ctx:
+        sig_ctx = (
+            control.sigint()
+            if control is not None and self.opts.install_sigint
+            else contextlib.nullcontext()
+        )
+        with ctx, sig_ctx:
             if search == "incremental":
                 from .incremental import plan_capacity_incremental
 
@@ -606,6 +760,8 @@ class Applier:
                     corrected_ds_overhead=self.opts.corrected_ds_overhead,
                     mesh=mesh,
                     precompile=precompile,
+                    checkpoint=checkpoint,
+                    control=control,
                 )
             else:
                 plan = plan_capacity(
@@ -619,6 +775,8 @@ class Applier:
                     sched_config=self._sched_config(),
                     corrected_ds_overhead=self.opts.corrected_ds_overhead,
                     precompile=precompile,
+                    checkpoint=checkpoint,
+                    control=control,
                 )
         timings["plan"] = _time.perf_counter() - t0
         plan.timings = timings
@@ -651,6 +809,19 @@ class Applier:
             # SIMTPU_COMPACT A/B — placements are identical either way)
             "fetch": {
                 k: fetch_counts()[k] - fetch_before[k] for k in fetch_before
+            },
+            # OOM-backoff telemetry (docs/robustness.md): caught
+            # RESOURCE_EXHAUSTED events, the sub-dispatches their halving
+            # replays created, and the smallest chunk any replay
+            # re-dispatched at ("chunk_min" is a process-lifetime floor,
+            # not a delta — 0 = no backoff this process)
+            "backoff": {
+                k: (
+                    backoff_counts()[k] - backoff_before[k]
+                    if k != "chunk_min"
+                    else backoff_counts()[k]
+                )
+                for k in backoff_before
             },
             # `compact` is the gauge's own record of what the final carry
             # actually was — NOT the SIMTPU_COMPACT default, which an

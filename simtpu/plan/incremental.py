@@ -85,6 +85,7 @@ from ..core.objects import (
     namespace_of,
 )
 from ..core.tensorize import slice_batch
+from ..durable.deadline import PlanInterrupted
 from ..engine.rounds import RoundsEngine
 from ..engine.scan import REASON_TEXT
 from ..engine.state import CompactState
@@ -194,6 +195,8 @@ def plan_capacity_incremental(
     precompile: bool = False,
     pipeline=None,
     speculate=None,
+    checkpoint=None,
+    control=None,
 ) -> PlanResult:
     """Minimum clone count of `new_node` deploying everything, via the
     incremental probe strategy described in the module docstring.
@@ -236,8 +239,26 @@ def plan_capacity_incremental(
         return _plan_capacity_incremental(
             cluster, apps, new_node, max_new_nodes, extended_resources,
             progress, sched_config, corrected_ds_overhead, verify,
-            materialize, mesh, pipeline, speculate,
+            materialize, mesh, pipeline, speculate, checkpoint, control,
         )
+    except PlanInterrupted as exc:
+        # deadline / SIGINT between candidates (docs/robustness.md): the
+        # structured partial result — every completed candidate is
+        # already checkpointed, so a later --resume loses nothing
+        from ..durable.deadline import partial_message
+
+        best = getattr(exc, "best_candidate", None)
+        out = PlanResult(
+            False,
+            -1 if best is None else best,
+            None,
+            partial_message(exc.reason, best, checkpoint),
+            getattr(exc, "probes", {}),
+            partial=True,
+        )
+        out.timings = getattr(exc, "timings", {})
+        out.compiles = getattr(exc, "compiles", {})
+        return out
     finally:
         if own_pipeline is not None:
             own_pipeline.shutdown()
@@ -257,6 +278,8 @@ def _plan_capacity_incremental(
     mesh,
     pipeline,
     speculate,
+    checkpoint,
+    control,
 ) -> PlanResult:
     from ..engine.scan import statics_from, trace_counts
     from ..parallel.sweep import assemble_planning_problem
@@ -266,6 +289,24 @@ def _plan_capacity_incremental(
     compiles: Dict[str, Dict[str, int]] = {}
     probes: Dict[int, int] = {}
     fail_msg = f"we have added {max_new_nodes} nodes but it still failed!!"
+    # the best candidate any probe/verify found feasible so far — what an
+    # interrupted plan reports as its partial answer
+    best_candidate: List[Optional[int]] = [None]
+
+    def check() -> None:
+        """Deadline/SIGINT poll at the candidate boundary; the raised
+        PlanInterrupted carries the search progress so the wrapper can
+        assemble the partial PlanResult."""
+        if control is None:
+            return
+        try:
+            control.check()
+        except PlanInterrupted as exc:
+            exc.probes = dict(probes)
+            exc.timings = dict(timings)
+            exc.compiles = dict(compiles)
+            exc.best_candidate = best_candidate[0]
+            raise
 
     def mark_compiles(phase: str, before: dict) -> None:
         after = trace_counts()
@@ -286,6 +327,16 @@ def _plan_capacity_incremental(
 
     t0 = time.perf_counter()
     max_new = max(max_new_nodes - 1, 0)  # reference walks i in [0, max)
+    if checkpoint is not None:
+        # pin the pod-name suffix stream to the problem fingerprint: the
+        # ONE expansion below then produces identical pods (names
+        # included) in the interrupted and the resuming process, which is
+        # what makes the recorded placement vectors replayable across
+        # processes (durable.checkpoint.name_seed)
+        from ..durable.checkpoint import name_seed
+        from ..workloads.expand import seed_name_hashes
+
+        seed_name_hashes(name_seed(checkpoint.fingerprint))
     tz, all_nodes, n_base, ordered = assemble_planning_problem(
         cluster, apps, new_node, max_new, extended_resources
     )
@@ -331,16 +382,82 @@ def _plan_capacity_incremental(
         m[n_base + i :] = False
         return m
 
+    r_res = tensors.alloc.shape[1]
+    req_pad = batch.req
+    if req_pad.shape[1] < r_res:
+        req_pad = np.pad(req_pad, ((0, 0), (0, r_res - req_pad.shape[1])))
+
+    def replay_engine(i, rows, nodes_arr, lvm, dev, gpu, with_state):
+        """An engine equivalent to one that just completed the recorded
+        run (checkpoint resume): placement log + ext_log rebuilt from the
+        record's placement vectors, and — when the caller needs the carry
+        (the base candidate, whose snapshot seeds every probe) — the
+        carried state rebuilt from that log, which is bit-identical to
+        the dispatched carry (the donated-state reuse guard's pinned
+        contract).  `rows` maps record positions to batch rows (None =
+        identity: a full fresh run)."""
+        from ..engine.state import build_state
+
+        eng = make_engine(valid_mask(i))
+        ok = np.flatnonzero(nodes_arr >= 0)
+        rows_ok = ok if rows is None else np.asarray(rows)[ok]
+        eng.placed_group = np.asarray(batch.group)[rows_ok].tolist()
+        eng.placed_node = nodes_arr[ok].tolist()
+        eng.placed_req = list(req_pad[rows_ok])
+        eng.ext_log = {
+            "node": nodes_arr[ok].tolist(),
+            "vg_alloc": list(lvm[ok]),
+            "sdev_take": list(dev[ok]),
+            "gpu_shares": list(gpu[ok]),
+            "gpu_mem": np.asarray(batch.ext["gpu_mem"])[rows_ok].tolist(),
+        }
+        if with_state:
+            dense = build_state(
+                tensors,
+                np.asarray(eng.placed_group, np.int32),
+                np.asarray(eng.placed_node, np.int32),
+                eng.log_req_matrix(r_res),
+                eng.ext_log,
+            )
+            eng.last_state = eng._store_state(tensors, dense)
+            eng._last_vocab = vocab
+            eng._state_dirty = False
+        return eng
+
     def fresh_run(i: int, phase: str = "verify"):
         """Full placement of every pod against base + i clones (the
-        reference's per-candidate semantics, minus re-tensorization)."""
+        reference's per-candidate semantics, minus re-tensorization).
+        With a checkpoint, a completed record for (phase, i) replays
+        instead of dispatching — the resume path."""
+        rec = checkpoint.get(phase, i) if checkpoint is not None else None
+        phantom = clone_of >= i
+        if rec is not None:
+            nodes = np.asarray(rec["nodes"])
+            reasons = np.asarray(rec["reasons"])
+            lvm, dev, gpu = (
+                np.asarray(rec["lvm"]),
+                np.asarray(rec["dev"]),
+                np.asarray(rec["gpu"]),
+            )
+            eng = replay_engine(
+                i, None, nodes, lvm, dev, gpu, with_state=(phase == "base")
+            )
+            failed = (nodes < 0) & ~phantom
+            probes[i] = int(failed.sum())
+            return eng, nodes, reasons, failed, gpu
+        check()
         c0 = trace_counts()
         eng = make_engine(valid_mask(i), plan_batch=batch)
         nodes, reasons, extras = eng.place(batch)
-        phantom = clone_of >= i
         failed = (nodes < 0) & ~phantom
         probes[i] = int(failed.sum())
         mark_compiles(phase, c0)
+        if checkpoint is not None:
+            checkpoint.put(
+                phase, i,
+                nodes=nodes, reasons=reasons, lvm=extras["lvm_alloc"],
+                dev=extras["dev_take"], gpu=extras["gpu_shares"],
+            )
         return eng, nodes, reasons, failed, extras["gpu_shares"]
 
     # -- base candidate: i = 0 -------------------------------------------
@@ -376,6 +493,7 @@ def _plan_capacity_incremental(
         return finalize(PlanResult(True, i, result, "Success!", probes))
 
     if probes[0] == 0:
+        best_candidate[0] = 0
         done = finish(0, base_eng, base_nodes_arr, base_reasons, base_gpu)
         if done is not None:
             return done
@@ -434,10 +552,26 @@ def _plan_capacity_incremental(
     def probe(i: int) -> tuple:
         """Completion probe: from the base snapshot, place the clone
         DaemonSet pods for clones < i plus every base failure, in original
-        order. Feasible iff all of them place."""
+        order. Feasible iff all of them place.  With a checkpoint, a
+        completed record for ("probe", i) replays instead of dispatching
+        (idx is deterministic given the — itself checkpointed — base)."""
+        idx = np.flatnonzero(base_failed | ((clone_of >= 0) & (clone_of < i)))
+        rec = checkpoint.get("probe", i) if checkpoint is not None else None
+        if rec is not None:
+            nodes = np.asarray(rec["nodes"])
+            reasons = np.asarray(rec["reasons"])
+            lvm, dev, gpu = (
+                np.asarray(rec["lvm"]),
+                np.asarray(rec["dev"]),
+                np.asarray(rec["gpu"]),
+            )
+            eng = replay_engine(i, idx, nodes, lvm, dev, gpu, with_state=False)
+            failed = nodes < 0
+            probes[i] = int(failed.sum())
+            return eng, idx, nodes, reasons, failed, gpu
+        check()
         say(f"add {i} node(s)")
         c0 = trace_counts()
-        idx = np.flatnonzero(base_failed | ((clone_of >= 0) & (clone_of < i)))
         probe_batch = slice_batch(batch, idx)
         eng = make_engine(valid_mask(i), plan_batch=probe_batch)
         eng.last_state = copy_snapshot()
@@ -447,6 +581,12 @@ def _plan_capacity_incremental(
         failed = nodes < 0
         probes[i] = int(failed.sum())
         mark_compiles("probes", c0)
+        if checkpoint is not None:
+            checkpoint.put(
+                "probe", i,
+                nodes=nodes, reasons=reasons, lvm=extras["lvm_alloc"],
+                dev=extras["dev_take"], gpu=extras["gpu_shares"],
+            )
         return eng, idx, nodes, reasons, failed, extras["gpu_shares"]
 
     # resource lower bound: the base failures must at least FIT the added
@@ -476,6 +616,8 @@ def _plan_capacity_incremental(
         eng_i, idx_i, nodes_i, reasons_i, failed_i, gpu_i = probe(cand)
         if probes[cand] == 0:
             hi, hi_run = cand, (eng_i, idx_i, nodes_i, gpu_i)
+            if best_candidate[0] is None or cand < best_candidate[0]:
+                best_candidate[0] = cand
         else:
             lo = max(lo, cand)
             msg = diagnose(idx_i[failed_i])
@@ -503,6 +645,8 @@ def _plan_capacity_incremental(
             say(f"verify {i} node(s) with a fresh placement")
             eng_v, nodes_v, reasons_v, failed_v, gpu_v = fresh_run(i)
             if probes[i] == 0:
+                if best_candidate[0] is None or i < best_candidate[0]:
+                    best_candidate[0] = i
                 timings["verify"] = time.perf_counter() - t0
                 done = finish(i, eng_v, nodes_v, reasons_v, gpu_v)
                 if done is not None:

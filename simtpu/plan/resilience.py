@@ -46,6 +46,7 @@ import numpy as np
 
 from .. import constants as C
 from ..core.objects import AppResource, ResourceTypes
+from ..durable.deadline import PlanInterrupted
 from ..faults.drain import PlacedCluster
 from ..faults.scenarios import generate_scenarios
 from ..faults.sweep import SweepResult, sweep_scenarios
@@ -66,6 +67,10 @@ class ResiliencePlan:
     #: the winning candidate's sweep (None when the search failed)
     sweep: Optional[SweepResult] = None
     timings: Dict[str, float] = field(default_factory=dict)
+    #: True when the search was interrupted (deadline / SIGINT) and this
+    #: plan reports only the best candidate verified so far — the
+    #: structured partial-result contract (docs/robustness.md)
+    partial: bool = False
 
     def counters(self) -> Dict[str, object]:
         """Machine-readable summary (CLI --json, bench)."""
@@ -77,6 +82,8 @@ class ResiliencePlan:
             "candidates_probed": len(self.probes),
             "plan_resilience_s": round(self.timings.get("total_s", 0.0), 2),
         }
+        if self.partial:
+            out["partial"] = True
         if self.sweep is not None:
             out.update(self.sweep.counters())
         return out
@@ -143,6 +150,8 @@ def plan_resilience(
     pipeline=None,
     s_chunk: Optional[int] = None,
     corrected_ds_overhead: bool = False,
+    checkpoint=None,
+    control=None,
 ) -> ResiliencePlan:
     """Minimum clone count of `new_node` whose cluster still fully places
     every workload under the failure model.
@@ -152,7 +161,16 @@ def plan_resilience(
     passes when its base placement strands nothing AND the surviving
     fraction of its scenario sweep is >= `quantile` (1.0 = every scenario).
     `new_node=None` assesses only the as-is cluster (candidate 0) and
-    reports success/failure without searching."""
+    reports success/failure without searching.
+
+    Durable execution (docs/robustness.md): with `checkpoint` (a
+    `durable.checkpoint.PlanCheckpoint`) every completed candidate's sweep
+    verdict persists, and a resumed search replays recorded candidates
+    (the winner re-sweeps once to materialize its SweepResult —
+    deterministic seeds make the replayed plan bit-identical).  With
+    `control` (a `durable.deadline.RunControl`) the deadline/SIGINT poll
+    runs before each candidate; an interrupt yields a partial
+    ResiliencePlan (`partial=True`) instead of a traceback."""
     from ..engine.scan import statics_from
     from ..parallel.sweep import assemble_planning_problem
 
@@ -171,6 +189,14 @@ def plan_resilience(
     max_new = max(max_new_nodes - 1, 0) if new_node is not None else 0
     template = new_node if new_node is not None else cluster.nodes[0]
     t0 = time.perf_counter()
+    if checkpoint is not None:
+        # pin the pod-name suffix stream to the problem fingerprint so the
+        # one expansion below matches across the interrupted and resuming
+        # processes (durable.checkpoint.name_seed; see plan/incremental.py)
+        from ..durable.checkpoint import name_seed
+        from ..workloads.expand import seed_name_hashes
+
+        seed_name_hashes(name_seed(checkpoint.fingerprint))
     tz, all_nodes, n_base, ordered = assemble_planning_problem(
         cluster, apps, template, max_new, extended_resources
     )
@@ -199,8 +225,32 @@ def plan_resilience(
         m[n_base + i :] = False
         return m
 
-    def probe(i: int) -> bool:
-        """Base placement + fault sweep for candidate i; True = survives."""
+    best_candidate: list = [None]  # lowest candidate found surviving
+
+    def probe(i: int, need_sweep: bool = False) -> bool:
+        """Base placement + fault sweep for candidate i; True = survives.
+
+        With a checkpoint, a completed record for ("resil", i) replays its
+        verdict instead of dispatching (scenario seeds are `seed + i`, so
+        the recorded sweep is the one a live run would produce);
+        `need_sweep` forces the live run — the winning candidate
+        materializes its SweepResult for the report."""
+        rec_d = None if checkpoint is None else checkpoint.get("resil", i)
+        if rec_d is not None and not need_sweep:
+            rec = {
+                "scenarios": int(rec_d["scenarios"]),
+                "survived": int(rec_d["survived"]),
+                "base_unplaced": int(rec_d["base_unplaced"]),
+            }
+            probes[i] = rec
+            if bool(rec_d["doomed"]):
+                raise _Doomed(str(rec_d["message"]))
+            ok = bool(rec_d["ok"])
+            if ok and (best_candidate[0] is None or i < best_candidate[0]):
+                best_candidate[0] = i
+            return ok
+        if control is not None:
+            control.check()
         say(f"resilience probe: {i} node(s) added, faults={fault_spec}")
         valid = valid_mask(i)
         if mesh is not None:
@@ -218,7 +268,18 @@ def plan_resilience(
         base_unplaced = int(((nodes < 0) & ~phantom).sum())
         rec = {"scenarios": 0, "survived": 0, "base_unplaced": base_unplaced}
         probes[i] = rec
+
+        def record(ok: bool, doomed_msg: str = "") -> None:
+            if checkpoint is not None:
+                checkpoint.put(
+                    "resil", i, ok=ok,
+                    scenarios=rec["scenarios"], survived=rec["survived"],
+                    base_unplaced=rec["base_unplaced"],
+                    doomed=bool(doomed_msg), message=doomed_msg,
+                )
+
         if base_unplaced:
+            record(False)
             return False
         pc = PlacedCluster(
             tz=tz, tensors=tensors, batch=batch, engine=eng,
@@ -239,14 +300,38 @@ def plan_resilience(
                 sweep, batch, new_node, all_ds, corrected_ds_overhead
             )
             if doomed and (len(scen) - doomed) / len(scen) < quantile - 1e-12:
+                record(False, doomed_msg=msg or "")
                 raise _Doomed(msg)
+        record(ok)
+        if ok and (best_candidate[0] is None or i < best_candidate[0]):
+            best_candidate[0] = i
         return ok
 
     def finish(i: int) -> ResiliencePlan:
+        if i not in sweeps and checkpoint is not None:
+            # checkpoint-replayed winner: one live re-sweep materializes
+            # its SweepResult (deterministic — seeds are `seed + i`)
+            probe(i, need_sweep=True)
         timings["total_s"] = time.perf_counter() - t_start
         return ResiliencePlan(
             True, i, k, quantile, "Success!",
             probes=probes, sweep=sweeps.get(i), timings=timings,
+        )
+
+    def interrupted(exc: PlanInterrupted) -> ResiliencePlan:
+        # deadline / SIGINT between candidates: the structured partial
+        # result — every completed candidate is already checkpointed
+        from ..durable.deadline import partial_message
+
+        best = best_candidate[0]
+        msg = partial_message(
+            exc.reason, best, checkpoint, what="resilience plan",
+            none_note="no surviving candidate found yet",
+        )
+        timings["total_s"] = time.perf_counter() - t_start
+        return ResiliencePlan(
+            False, -1 if best is None else best, k, quantile, msg,
+            probes=probes, sweep=None, timings=timings, partial=True,
         )
 
     def fail(msg: str) -> ResiliencePlan:
@@ -311,8 +396,14 @@ def plan_resilience(
     except _Doomed as exc:
         timings["search"] = time.perf_counter() - t0
         return fail(str(exc))
+    except PlanInterrupted as exc:
+        timings["search"] = time.perf_counter() - t0
+        return interrupted(exc)
     timings["search"] = time.perf_counter() - t0
-    return finish(hi)
+    try:
+        return finish(hi)
+    except PlanInterrupted as exc:  # interrupt during the winner re-sweep
+        return interrupted(exc)
 
 
 def _passed(rec: Dict[str, int], quantile: float) -> bool:

@@ -19,6 +19,7 @@ workload object, not the pod template — `SetObjectMetaFromObject`,
 
 from __future__ import annotations
 
+import contextlib
 import json
 import random
 from typing import List, Optional
@@ -36,9 +37,38 @@ from ..core.objects import (
     set_annotation,
 )
 from ..core.quantity import parse_quantity
-from .validate import validate_node, validate_pod
+from .validate import SpecError, ValidationError, validate_node, validate_pod
 
 _rng = random.Random()
+
+
+@contextlib.contextmanager
+def spec_context(kind: str, obj: dict):
+    """Attach ingest context to spec failures raised while expanding one
+    workload (docs/robustness.md, structured ingest diagnostics).
+
+    A `SpecError` raised deeper (it knows the field path, not the
+    workload) gains the kind/name/source-file; a plain ValidationError or
+    ValueError (bad quantity in a storage template, malformed JSON
+    annotation...) is wrapped whole.  The result renders as ONE
+    actionable line in `simtpu apply` instead of a mid-tensorize
+    traceback."""
+    name = f"{namespace_of(obj)}/{name_of(obj)}"
+    source = obj.get(SOURCE_KEY)
+    try:
+        yield
+    except SpecError as exc:
+        raise exc.attach(source=source, kind=kind, name=name)
+    except (ValidationError, ValueError) as exc:
+        raise SpecError(
+            str(exc), source=source, kind=kind, name=name
+        ) from exc
+
+
+#: top-level key the YAML loader stamps each decoded object with so spec
+#: diagnostics can name the manifest file; never part of the k8s object
+#: model, and nothing downstream iterates top-level keys
+SOURCE_KEY = "__simtpu_source__"
 
 
 def seed_name_hashes(seed: Optional[int]) -> None:
@@ -96,6 +126,7 @@ def make_valid_pod(pod: dict) -> dict:
     converts PVC volumes to hostPath, then validates.
     """
     pod = deep_copy(pod)
+    pod.pop(SOURCE_KEY, None)  # ingest-only provenance, not pod model
     m = ensure_meta(pod)
     m.setdefault("labels", {})
     m.setdefault("annotations", {})
@@ -303,7 +334,8 @@ def new_daemon_pod(ds: dict, node_name: str) -> dict:
 
 def make_valid_pods_by_daemonset(ds: dict, nodes: List[dict]) -> List[dict]:
     """One pod per node that should run it (`utils.go:356-370`)."""
-    proto = _prototype(ds, C.KIND_DS)
+    with spec_context(C.KIND_DS, ds):
+        proto = _prototype(ds, C.KIND_DS)
     pods = []
     for node in nodes:
         pod = _pin_daemon_clone(proto, name_of(node))
@@ -333,18 +365,21 @@ def get_valid_pods_exclude_daemonset(resources: ResourceTypes) -> List[dict]:
     sets, replication controllers, stateful sets, jobs, cron jobs.
     """
     pods: List[dict] = []
-    for item in resources.pods:
-        pods.append(make_valid_pod_by_pod(item))
-    for item in resources.deployments:
-        pods.extend(make_valid_pods_by_deployment(item))
-    for item in resources.replica_sets:
-        pods.extend(make_valid_pods_by_replica_set(item))
-    for item in resources.replication_controllers:
-        pods.extend(make_valid_pods_by_replication_controller(item))
-    for item in resources.stateful_sets:
-        pods.extend(make_valid_pods_by_stateful_set(item))
-    for item in resources.jobs:
-        pods.extend(make_valid_pods_by_job(item))
-    for item in resources.cron_jobs:
-        pods.extend(make_valid_pods_by_cron_job(item))
+    expanders = [
+        (resources.pods, "Pod", lambda it: [make_valid_pod_by_pod(it)]),
+        (resources.deployments, C.KIND_DEPLOYMENT, make_valid_pods_by_deployment),
+        (resources.replica_sets, C.KIND_RS, make_valid_pods_by_replica_set),
+        (
+            resources.replication_controllers,
+            C.KIND_RC,
+            make_valid_pods_by_replication_controller,
+        ),
+        (resources.stateful_sets, C.KIND_STS, make_valid_pods_by_stateful_set),
+        (resources.jobs, C.KIND_JOB, make_valid_pods_by_job),
+        (resources.cron_jobs, C.KIND_CRON_JOB, make_valid_pods_by_cron_job),
+    ]
+    for items, kind, expander in expanders:
+        for item in items:
+            with spec_context(kind, item):
+                pods.extend(expander(item))
     return pods

@@ -42,6 +42,58 @@ class ValidationError(ValueError):
     pass
 
 
+class SpecError(ValidationError):
+    """A malformed workload spec, carrying enough context to fix it.
+
+    Before this class, a bad resource quantity or malformed field
+    surfaced as a raw `ValueError` traceback mid-tensorize with no hint
+    of WHICH manifest was broken.  A SpecError renders as one actionable
+    line — `<file>: <Kind> <ns>/<name>: <field.path>: <reason>` — and
+    `simtpu apply` prints exactly that (plus the same line under --json's
+    "message") instead of a stack.
+
+    The reason is raised where the malformed value is seen (with the
+    field path when known); the expansion boundary
+    (`expand.spec_context`) attaches the workload kind/name and source
+    file, which only the ingest layer knows."""
+
+    def __init__(
+        self,
+        reason: str,
+        source: str = None,
+        kind: str = None,
+        name: str = None,
+        field: str = None,
+    ):
+        self.reason = reason
+        self.source = source
+        self.kind = kind
+        self.name = name
+        self.field = field
+        super().__init__(reason)
+
+    def attach(
+        self, source: str = None, kind: str = None, name: str = None
+    ) -> "SpecError":
+        """Fill ingest context the raise site didn't know (missing attrs
+        only — the innermost context wins)."""
+        self.source = self.source or source
+        self.kind = self.kind or kind
+        self.name = self.name or name
+        return self
+
+    def __str__(self) -> str:
+        parts = []
+        if self.source:
+            parts.append(str(self.source))
+        if self.kind or self.name:
+            parts.append(f"{self.kind or 'object'} {self.name or '?'}")
+        if self.field:
+            parts.append(self.field)
+        parts.append(self.reason)
+        return ": ".join(parts)
+
+
 def _validate_name(name: str, what: str) -> None:
     if not name or len(name) > 253 or not _DNS1123.match(name):
         raise ValidationError(f"invalid {what} name: {name!r}")
@@ -216,6 +268,41 @@ def _validate_ports(pod: dict) -> None:
                 raise ValidationError(f"{who}: invalid port protocol {proto!r}")
 
 
+def _validate_quantities(pod: dict) -> None:
+    """Resource quantities, walked per container so a bad value reports
+    its exact FIELD PATH (`spec.containers[1].resources.requests.cpu`)
+    instead of the raw `unparseable quantity` ValueError the aggregated
+    `pod_requests` sum would throw mid-tensorize."""
+    from ..core.quantity import parse_quantity
+
+    spec = pod_spec(pod)
+    walks = [
+        (f"spec.containers[{i}]", c)
+        for i, c in enumerate(spec.get("containers") or [])
+    ] + [
+        (f"spec.initContainers[{i}]", c)
+        for i, c in enumerate(spec.get("initContainers") or [])
+    ]
+    entries = [
+        (f"{where}.resources.{section}.{k}", v)
+        for where, c in walks
+        for section in ("requests", "limits")
+        for k, v in ((c.get("resources") or {}).get(section) or {}).items()
+    ] + [
+        (f"spec.overhead.{k}", v)
+        for k, v in (spec.get("overhead") or {}).items()
+    ]
+    for field, v in entries:
+        try:
+            q = parse_quantity(v)
+        except Exception:
+            raise SpecError(
+                f"unparseable resource quantity {v!r}", field=field
+            ) from None
+        if q < 0:
+            raise SpecError(f"negative resource quantity {v!r}", field=field)
+
+
 def validate_pod(pod: dict) -> None:
     _validate_name(name_of(pod), "pod")
     _validate_name(namespace_of(pod), "namespace")
@@ -231,9 +318,7 @@ def validate_pod(pod: dict) -> None:
         if cname in seen:
             raise ValidationError(f"pod {name_of(pod)} has duplicate container name {cname}")
         seen.add(cname)
-    for k, v in pod_requests(pod).items():
-        if v < 0:
-            raise ValidationError(f"pod {name_of(pod)} has negative request {k}={v}")
+    _validate_quantities(pod)
     restart = (pod.get("spec") or {}).get("restartPolicy", "Always")
     if restart not in ("Always", "OnFailure", "Never"):
         raise ValidationError(f"pod {name_of(pod)} has invalid restartPolicy {restart!r}")

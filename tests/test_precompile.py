@@ -214,3 +214,54 @@ def test_incremental_plan_precompile_identical():
     assert piped.nodes_added == base.nodes_added
     assert "compile_wall" in piped.timings
     assert "compile_wall" not in base.timings
+
+
+def test_fault_sweep_signature_failed_aot_falls_back_loud(caplog, monkeypatch):
+    """The scenario-batched fault-sweep signature gets the same loud
+    warn-and-fallback contract as the scan/bulk signatures (ISSUE 6
+    satellite): a failed background compile of the "fault_sweep"
+    executable warns ONCE, every chunk falls back to the plain jit, and
+    the sweep's outcome is identical to the un-pipelined run."""
+    import logging
+
+    import simtpu.faults.sweep as sweep_mod
+    from simtpu.engine.precompile import AotPipeline
+    from simtpu.faults import generate_scenarios, place_cluster, sweep_scenarios
+    from simtpu.synth import synth_apps, synth_cluster
+
+    cluster = synth_cluster(8, seed=13, zones=2)
+    apps = synth_apps(24, seed=14, zones=2, pods_per_deployment=8)
+    pc = place_cluster(cluster, apps)
+    scen = generate_scenarios(cluster.nodes, "k=1")
+    base = sweep_scenarios(pc, scen, s_chunk=4)
+
+    class _NoLower:
+        """The compiled sweep entry point with AOT lowering broken: the
+        background compile fails, the jit fallback still works."""
+
+        def __init__(self, real):
+            self.real = real
+
+        def lower(self, *args, **kwargs):
+            raise RuntimeError("AOT lowering rejected (injected)")
+
+        def __call__(self, *args, **kwargs):
+            return self.real(*args, **kwargs)
+
+    monkeypatch.setattr(
+        sweep_mod, "_fault_sweep", _NoLower(sweep_mod._fault_sweep)
+    )
+    pipe = AotPipeline(workers=1)
+    try:
+        with caplog.at_level(logging.WARNING, logger="simtpu.precompile"):
+            out = sweep_scenarios(pc, scen, s_chunk=4, pipeline=pipe)
+        assert pipe.stats()["failures"] >= 1
+        warned = [
+            rec for rec in caplog.records if "fault_sweep" in rec.message
+        ]
+        assert len(warned) == 1  # loud once, not per chunk
+        assert np.array_equal(out.requeue_rows, base.requeue_rows)
+        assert np.array_equal(out.requeue_nodes, base.requeue_nodes)
+        assert np.array_equal(out.requeue_reasons, base.requeue_reasons)
+    finally:
+        pipe.shutdown()

@@ -17,6 +17,12 @@ Usage:
     python tools/run_tests.py -k PATTERN  # forwarded to pytest
 Exit status: 0 iff every module's pytest exits 0 (or 5 = nothing
 collected, which --fast can legitimately produce).
+
+Hang safety (ISSUE 6): any test running longer than --timeout seconds
+(SIMTPU_TEST_TIMEOUT, default 1200) makes pytest's faulthandler dump
+every thread's stack to the module's captured output, and a module still
+alive 25% past the budget is killed with whatever it printed — a hung
+tier-1 run produces STACKS, never a silent kill.
 """
 
 from __future__ import annotations
@@ -36,6 +42,13 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--fast", action="store_true", help="deselect @pytest.mark.slow tests")
     ap.add_argument("-k", default=None, help="forwarded to pytest -k")
+    ap.add_argument(
+        "--timeout",
+        type=float,
+        default=float(os.environ.get("SIMTPU_TEST_TIMEOUT", 1200)),
+        help="per-test faulthandler stack-dump budget in seconds; the "
+        "module subprocess is killed at 1.25x this (0 = no timeout)",
+    )
     ap.add_argument("modules", nargs="*", help="module paths (default: tests/test_*.py)")
     args = ap.parse_args()
 
@@ -49,6 +62,12 @@ def main() -> int:
         extra += ["-m", "not slow"]
     if args.k:
         extra += ["-k", args.k]
+    if args.timeout > 0:
+        # pytest's built-in faulthandler plugin: a test exceeding the
+        # budget dumps EVERY thread's stack into the module's output (the
+        # hang evidence), without killing the run — the subprocess kill
+        # below is the backstop
+        extra += ["-o", f"faulthandler_timeout={args.timeout:g}"]
 
     totals = {"passed": 0, "failed": 0, "errors": 0, "skipped": 0, "deselected": 0}
     failures = []
@@ -57,28 +76,44 @@ def main() -> int:
     for mod in modules:
         rel = os.path.relpath(mod, REPO)
         t0 = time.perf_counter()
-        proc = subprocess.run(
-            [sys.executable, "-m", "pytest", rel, "-q", "--no-header", *extra],
-            cwd=REPO,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-        )
+        timed_out = False
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "pytest", rel, "-q", "--no-header", *extra],
+                cwd=REPO,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                timeout=args.timeout * 1.25 if args.timeout > 0 else None,
+            )
+            out, rc = proc.stdout, proc.returncode
+        except subprocess.TimeoutExpired as exc:
+            # the faulthandler dump (armed at 1x the budget) is already in
+            # the captured output — surface it instead of a silent kill
+            out = exc.stdout or ""
+            if isinstance(out, bytes):
+                out = out.decode(errors="replace")
+            rc, timed_out = -1, True
         dt = time.perf_counter() - t0
         timings.append((dt, rel))
-        tail = proc.stdout.strip().splitlines()
+        tail = out.strip().splitlines()
         summary = tail[-1] if tail else ""
+        if timed_out:
+            summary = (
+                f"TIMEOUT after {dt:.0f}s (faulthandler stacks in the "
+                f"module output below; budget {args.timeout:g}s/test)"
+            )
         for key in totals:
             # pytest prints singular forms too ("1 error in 0.5s")
             m = re.search(rf"(\d+) {key.rstrip('s')}s?", summary)
             if m:
                 totals[key] += int(m.group(1))
-        ok = proc.returncode in (0, 5)  # 5: no tests collected (e.g. --fast)
+        ok = rc in (0, 5)  # 5: no tests collected (e.g. --fast)
         print(f"{'ok  ' if ok else 'FAIL'} {rel:42s} {dt:7.1f}s  {summary}", flush=True)
         if not ok:
             failures.append(rel)
             # keep the evidence: everything pytest printed for the module
-            print(proc.stdout, flush=True)
+            print(out, flush=True)
     wall = time.perf_counter() - t_all
     print(
         f"\n== {totals['passed']} passed, {totals['failed']} failed, "
