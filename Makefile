@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all install lint test test-all test-perf bench bench-cold bench-faults bench-layout bench-durable clean
+.PHONY: all install lint test test-all test-perf bench bench-cold bench-faults bench-layout bench-durable bench-audit fuzz-smoke clean
 
 all: test
 
@@ -89,6 +89,29 @@ bench-durable:
 	SIMTPU_BENCH_SMALL=0 SIMTPU_BENCH_HARD=0 SIMTPU_BENCH_MATRIX=0 \
 	SIMTPU_BENCH_PLAN=0 SIMTPU_BENCH_BIG=0 SIMTPU_BENCH_FAULTS=0 \
 	SIMTPU_BENCH_LAYOUT=0 $(PY) bench.py
+
+# trust-but-verify smoke (mirrors bench-durable): mutation-kill every
+# corruption class ASSERTING 100% auditor detection, plus a small
+# incremental plan with the auditor auto-on asserting a clean verdict and
+# < 10% audit overhead — audit_s / audit_violations / audit_kill_rate
+# land in the JSON line (CI runs this alongside the fast tier)
+bench-audit:
+	SIMTPU_BENCH_AUDIT=1 SIMTPU_BENCH_AUDIT_ASSERT=1 \
+	SIMTPU_BENCH_NODES=500 SIMTPU_BENCH_PODS=2000 \
+	SIMTPU_BENCH_SCAN_PODS=200 SIMTPU_BENCH_BASELINE_PODS=50 \
+	SIMTPU_BENCH_SMALL=0 SIMTPU_BENCH_HARD=0 SIMTPU_BENCH_MATRIX=0 \
+	SIMTPU_BENCH_PLAN=0 SIMTPU_BENCH_BIG=0 SIMTPU_BENCH_FAULTS=0 \
+	SIMTPU_BENCH_LAYOUT=0 SIMTPU_BENCH_DURABLE=0 $(PY) bench.py
+
+# differential fuzz over the fixed seed corpus at small shapes, across
+# the FULL engine-config matrix — 8 forced host devices arm the
+# GSPMD-sharded cell on CPU-only CI runners (the conftest trick); any
+# divergence from the serial baseline or dirty audit fails the target
+# with a shrunk reproducer YAML left under /tmp/simtpu-fuzz
+fuzz-smoke:
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	$(PY) -m simtpu.cli fuzz --cases 6 --nodes 12 --pods 48 --seed 0 \
+	--out /tmp/simtpu-fuzz --json
 
 clean:
 	rm -rf build dist *.egg-info simtpu/native/_build

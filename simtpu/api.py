@@ -1090,6 +1090,8 @@ def simulate(
     bulk: bool = False,
     sched_config=None,
     precompile: bool = False,
+    audit: bool = False,
+    _audit_inject: bool = False,
 ) -> SimulateResult:
     """One-shot simulation (`pkg/simulator/core.go:64-103`): expand cluster
     workloads, run the cluster, then schedule each app in configured order.
@@ -1108,7 +1110,14 @@ def simulate(
     (containers, volumes, affinity, ...) are shared READ-ONLY with the input
     objects — treat returned pods as immutable below those layers, or
     deep-copy before mutating (at million-pod scale a full deep copy per
-    placed pod costs more than the placement itself)."""
+    placed pod costs more than the placement itself).
+
+    With `audit=True` the independent placement auditor (simtpu/audit)
+    certifies the final state — engine placement log, preemption
+    legality — and attaches its `AuditReport` as `result.audit` before
+    the simulator closes.  `_audit_inject` is the SIMTPU_AUDIT_INJECT
+    test lever: it corrupts the audit's VIEW (never the result) so the
+    planners' divergence-fallback path can be driven end-to-end."""
     if bulk:
         if engine_factory is not None:
             raise ValueError("bulk=True and engine_factory are mutually exclusive")
@@ -1131,6 +1140,10 @@ def simulate(
         result = sim.run_cluster(cluster)
         for app in apps:
             result = sim.schedule_app(app)
+        if audit:
+            from .audit.checker import audit_simulation
+
+            result.audit = audit_simulation(sim, inject=_audit_inject)
         return result
     finally:
         sim.close()

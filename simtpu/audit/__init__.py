@@ -1,0 +1,40 @@
+"""Trust-but-verify: independent placement auditing + differential fuzzing.
+
+- `checker` — a second, engine-independent implementation of the
+  feasibility semantics that certifies a finished placement against the
+  raw tensorized inputs (`audit_placement` / `audit_simulation`),
+  producing a structured `AuditReport`.  The planners run it auto-on over
+  every accepted candidate (`--no-audit` opts out) and fall back to the
+  serial-exact engine on failure (docs/robustness.md).
+- `fuzz` — the seeded differential fuzz harness (`simtpu fuzz`): replay
+  generated gnarly cases across the engine-config matrix asserting
+  identical, audit-clean placements; shrink failures to minimal
+  reproducer YAML; mutation-kill mode corrupts accepted placements and
+  asserts the auditor flags 100% of them.
+"""
+
+from .checker import (
+    AuditReport,
+    Violation,
+    audit_enabled,
+    audit_placed_cluster,
+    audit_placement,
+    audit_simulation,
+    divergence_diagnostic,
+    extras_from_log,
+    inject_divergence,
+    inject_divergence_enabled,
+)
+
+__all__ = [
+    "AuditReport",
+    "Violation",
+    "audit_enabled",
+    "audit_placed_cluster",
+    "audit_placement",
+    "audit_simulation",
+    "divergence_diagnostic",
+    "extras_from_log",
+    "inject_divergence",
+    "inject_divergence_enabled",
+]

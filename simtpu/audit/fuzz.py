@@ -1,0 +1,619 @@
+"""Differential fuzz harness + mutation-kill for the placement auditor.
+
+Two modes, both seeded and deterministic (`simtpu fuzz`):
+
+- **differential** (`run_differential`): generate gnarly spec/cluster
+  cases (mixed hard/soft affinity + spread + selectors + tolerations +
+  GPU + Open-Local storage + host-port collisions — `gen_case` draws the
+  feature mix from the seed), place each one with the serial-exact
+  baseline engine, then replay it across the engine-config matrix —
+  speculative wavefront on/off × compact carried state on/off × GSPMD
+  node sharding on/off (multi-device hosts) × injected-OOM chunk backoff
+  — asserting BIT-IDENTICAL landing-node vectors and an audit-clean
+  verdict on every config.  Every matrix cell is a documented
+  bit-identity contract (docs/speculation.md, docs/memory.md,
+  docs/robustness.md); the fuzzer is the runtime enforcement.
+  A failing case auto-shrinks (drop workloads, halve replicas, halve
+  nodes — greedily, while the failure reproduces) and lands as a minimal
+  reproducer YAML under `--out`.
+
+- **mutation-kill** (`run_mutation_kill`): corrupt ACCEPTED placements —
+  move a pod to an invalid/full node, collide a host port, double-book a
+  hard-anti domain, overfill a spread domain, strand a required-affinity
+  pod, forge an illegal eviction — and assert the auditor flags every
+  single one.  This is the auditor's own test harness: a corruption the
+  audit misses is a hole in the certifier, surfaced as a failure here
+  (and in `make bench-audit` / CI).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.objects import AppResource, PreemptedPod, ResourceTypes
+from ..synth import make_deployment, make_node, synth_apps, synth_cluster
+from .checker import audit_placement, audit_simulation, extras_from_log
+
+OOM_MSG = "RESOURCE_EXHAUSTED: out of memory allocating (injected by fuzz)"
+
+
+# ---------------------------------------------------------------------------
+# Case generation
+# ---------------------------------------------------------------------------
+
+
+def gen_case(
+    seed: int, n_nodes: int = 32, n_pods: int = 160
+) -> Tuple[ResourceTypes, List[AppResource], Dict[str, object]]:
+    """One seeded gnarly case: cluster + apps + the drawn feature mix."""
+    rng = np.random.default_rng(seed)
+    mix = {
+        "zones": int(rng.integers(2, 6)),
+        "taint_frac": float(rng.choice([0.0, 0.1, 0.3])),
+        "gpu_frac": float(rng.choice([0.0, 0.2])),
+        "storage_frac": float(rng.choice([0.0, 0.2])),
+        "selector_frac": float(rng.choice([0.1, 0.4])),
+        "toleration_frac": float(rng.choice([0.0, 0.2])),
+        "anti_affinity_frac": float(rng.choice([0.2, 0.5])),
+        "anti_affinity_hard_frac": float(rng.choice([0.3, 0.8])),
+        "spread_frac": float(rng.choice([0.2, 0.5])),
+        "spread_hard_frac": float(rng.choice([0.3, 0.8])),
+        "affinity_frac": float(rng.choice([0.0, 0.3])),
+        "ports": bool(rng.random() < 0.6),
+    }
+    cluster = synth_cluster(
+        n_nodes,
+        seed=seed,
+        zones=mix["zones"],
+        taint_frac=mix["taint_frac"],
+        gpu_frac=mix["gpu_frac"],
+        storage_frac=mix["storage_frac"],
+    )
+    apps = synth_apps(
+        n_pods,
+        seed=seed + 1,
+        zones=mix["zones"],
+        pods_per_deployment=max(4, n_pods // 12),
+        selector_frac=mix["selector_frac"],
+        toleration_frac=mix["toleration_frac"],
+        anti_affinity_frac=mix["anti_affinity_frac"],
+        anti_affinity_hard_frac=mix["anti_affinity_hard_frac"],
+        spread_frac=mix["spread_frac"],
+        spread_hard_frac=mix["spread_hard_frac"],
+        gpu_frac=mix["gpu_frac"] * 0.5,
+        storage_frac=mix["storage_frac"] * 0.5,
+        affinity_frac=mix["affinity_frac"],
+    )
+    if mix["ports"]:
+        # host-port collision pressure: more replicas wanting the same
+        # (protocol, port) pair than... no — exactly at capacity, so the
+        # engine must spread them one per node and a corrupted placement
+        # (or a diverging config) trips the audit
+        port_reps = int(rng.integers(2, min(8, n_nodes)))
+        apps[0].resource.deployments.append(
+            make_deployment(
+                "porty", port_reps, 100, 128, host_port=int(rng.integers(7000, 9000))
+            )
+        )
+    return cluster, apps, mix
+
+
+# ---------------------------------------------------------------------------
+# Engine-config matrix
+# ---------------------------------------------------------------------------
+
+
+class _OomFirst:
+    """Wrap a dispatch callable so its first `n` multi-pod calls raise an
+    injected RESOURCE_EXHAUSTED — driving the chunk-halving backoff
+    (durable/backoff.py) inside a normal placement."""
+
+    def __init__(self, real: Callable, n: int = 1):
+        self.real = real
+        self.left = n
+
+    def __call__(self, statics, state, seg, *rest):
+        width = int(np.asarray(seg[0]).shape[0])
+        if self.left > 0 and width > 1:
+            self.left -= 1
+            raise RuntimeError(OOM_MSG)
+        return self.real(statics, state, seg, *rest)
+
+
+def engine_configs(include_shard: Optional[bool] = None) -> List[Dict]:
+    """The matrix cells beyond the serial baseline.  `include_shard=None`
+    auto-includes the GSPMD cell when >1 device is visible."""
+    cells = [
+        {"name": "wavefront", "speculate": True, "compact": False},
+        {"name": "compact", "speculate": False, "compact": True},
+        {"name": "wavefront+compact", "speculate": True, "compact": True},
+        {"name": "oom-backoff", "speculate": False, "compact": False, "oom": 2},
+    ]
+    if include_shard is None:
+        import jax
+
+        include_shard = len(jax.devices()) > 1
+    if include_shard:
+        cells.insert(3, {"name": "sharded", "speculate": False,
+                         "compact": False, "shard": True})
+    return cells
+
+
+def _place_with(cluster, apps, cfg: Dict):
+    """Engine-level placement of one case under one matrix cell; returns
+    the `PlacedCluster` (nodes vector + tensors + batch + engine)."""
+    from ..engine.scan import Engine
+    from ..faults.drain import place_cluster
+
+    def factory(tz):
+        if cfg.get("shard"):
+            from ..parallel.mesh import planner_mesh
+            from ..parallel.sharded import ShardedEngine
+
+            mesh = planner_mesh()
+            if mesh is None:
+                raise RuntimeError("shard cell needs >1 visible device")
+            eng = ShardedEngine(tz, mesh)
+        else:
+            eng = Engine(tz)
+        eng.compact = bool(cfg.get("compact"))
+        if cfg.get("oom"):
+            eng._scan_call = _OomFirst(eng._scan_call, int(cfg["oom"]))
+        return eng
+
+    return place_cluster(
+        cluster,
+        apps,
+        bulk=False,
+        engine_factory=factory,
+        speculate=bool(cfg.get("speculate")),
+    )
+
+
+@dataclass
+class FuzzFailure:
+    seed: int
+    config: str
+    kind: str  # "divergence" | "audit" | "error"
+    detail: str
+    reproducer: str = ""  # path of the shrunk YAML, when written
+
+
+@dataclass
+class FuzzResult:
+    cases: int = 0
+    configs_run: int = 0
+    failures: List[FuzzFailure] = field(default_factory=list)
+    audits_clean: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+    def counters(self) -> Dict[str, object]:
+        return {
+            "ok": self.ok,
+            "cases": self.cases,
+            "configs_run": self.configs_run,
+            "audits_clean": self.audits_clean,
+            "failures": [
+                {"seed": f.seed, "config": f.config, "kind": f.kind,
+                 "detail": f.detail, "reproducer": f.reproducer}
+                for f in self.failures
+            ],
+        }
+
+
+def _check_case(cluster, apps, cells) -> Optional[Tuple[str, str, str]]:
+    """Run one case across the matrix.  Returns None when every config is
+    bit-identical to the serial baseline and audit-clean, else
+    (config, kind, detail)."""
+    base = _place_with(cluster, apps, {"name": "serial"})
+    rep = audit_placement(
+        base.tensors, base.batch, base.nodes, extras_from_log(base)
+    )
+    if not rep.ok:
+        return ("serial", "audit", rep.summary())
+    base_nodes = np.asarray(base.nodes)
+    for cfg in cells:
+        try:
+            pc = _place_with(cluster, apps, cfg)
+        except Exception as exc:  # an engine config crashing IS a finding
+            return (cfg["name"], "error", f"{type(exc).__name__}: {exc}")
+        if not np.array_equal(np.asarray(pc.nodes), base_nodes):
+            diff = np.flatnonzero(np.asarray(pc.nodes) != base_nodes)
+            return (
+                cfg["name"],
+                "divergence",
+                f"{len(diff)} divergent pod(s), first row {int(diff[0])}",
+            )
+        rep = audit_placement(
+            pc.tensors, pc.batch, pc.nodes, extras_from_log(pc)
+        )
+        if not rep.ok:
+            return (cfg["name"], "audit", rep.summary())
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Shrinking + reproducers
+# ---------------------------------------------------------------------------
+
+
+def _shrink(cluster, apps, cells, still_fails, rounds: int = 6):
+    """Greedy structural shrink while the failure reproduces: drop
+    deployments one at a time, halve replica counts, halve the node
+    list."""
+    import copy
+
+    cur_c, cur_a = cluster, apps
+    for _ in range(rounds):
+        shrunk = False
+        deps = cur_a[0].resource.deployments
+        for i in range(len(deps) - 1, -1, -1):
+            trial_a = copy.deepcopy(cur_a)
+            del trial_a[0].resource.deployments[i]
+            if not trial_a[0].resource.deployments:
+                continue
+            if still_fails(cur_c, trial_a, cells):
+                cur_a, shrunk = trial_a, True
+        trial_a = copy.deepcopy(cur_a)
+        for d in trial_a[0].resource.deployments:
+            d["spec"]["replicas"] = max(1, int(d["spec"].get("replicas", 1)) // 2)
+        if still_fails(cur_c, trial_a, cells):
+            cur_a, shrunk = trial_a, True
+        if len(cur_c.nodes) > 2:
+            trial_c = ResourceTypes(
+                **{k: list(v) for k, v in vars(cur_c).items()}
+            )
+            trial_c.nodes = list(cur_c.nodes[: max(2, len(cur_c.nodes) // 2)])
+            if still_fails(trial_c, cur_a, cells):
+                cur_c, shrunk = trial_c, True
+        if not shrunk:
+            break
+    return cur_c, cur_a
+
+
+def write_reproducer(cluster, apps, path: str) -> str:
+    """One multi-document YAML reproducing the case (nodes, storage
+    classes, workloads) — re-runnable through `load_resources` +
+    `simtpu fuzz --replay`."""
+    import yaml
+
+    docs: List[dict] = []
+    docs.extend(cluster.nodes)
+    docs.extend(cluster.storage_classes)
+    for app in apps:
+        docs.extend(app.resource.deployments)
+        docs.extend(app.resource.pods)
+        docs.extend(app.resource.daemon_sets)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        yaml.safe_dump_all(docs, f, sort_keys=False)
+    return path
+
+
+def load_reproducer(path: str):
+    """Load a `write_reproducer` YAML back into (cluster, apps) — the
+    `simtpu fuzz --replay` entry.  Nodes and StorageClasses form the
+    cluster; every workload kind lands in one replay app."""
+    from ..io.yaml_loader import load_resources
+
+    res = load_resources(path)
+    cluster = ResourceTypes(
+        nodes=list(res.nodes), storage_classes=list(res.storage_classes)
+    )
+    work = ResourceTypes(
+        pods=list(res.pods),
+        deployments=list(res.deployments),
+        replica_sets=list(res.replica_sets),
+        replication_controllers=list(res.replication_controllers),
+        stateful_sets=list(res.stateful_sets),
+        daemon_sets=list(res.daemon_sets),
+        jobs=list(res.jobs),
+        cron_jobs=list(res.cron_jobs),
+    )
+    return cluster, [AppResource(name="replay", resource=work)]
+
+
+def replay_case(
+    path: str, include_shard: Optional[bool] = None
+) -> Optional[Tuple[str, str, str]]:
+    """Re-run one shrunk reproducer across the engine-config matrix;
+    returns None when clean, else (config, kind, detail) — the same
+    contract as `_check_case`."""
+    cluster, apps = load_reproducer(path)
+    return _check_case(cluster, apps, engine_configs(include_shard))
+
+
+def run_differential(
+    cases: int = 16,
+    seed: int = 0,
+    n_nodes: int = 32,
+    n_pods: int = 160,
+    out_dir: str = "",
+    include_shard: Optional[bool] = None,
+    progress: Optional[Callable[[str], None]] = None,
+) -> FuzzResult:
+    """The differential fuzz loop (docstring at module top)."""
+    say = progress or (lambda s: None)
+    cells = engine_configs(include_shard)
+    result = FuzzResult(cases=cases)
+    for c in range(cases):
+        case_seed = seed + c * 1000
+        cluster, apps, mix = gen_case(case_seed, n_nodes, n_pods)
+        say(f"case {c + 1}/{cases} (seed {case_seed}): "
+            + ", ".join(k for k, v in mix.items() if v))
+        bad = _check_case(cluster, apps, cells)
+        result.configs_run += 1 + len(cells)
+        if bad is None:
+            result.audits_clean += 1 + len(cells)
+            continue
+        config, kind, detail = bad
+        failure = FuzzFailure(case_seed, config, kind, detail)
+        if out_dir:
+            say(f"  FAILURE ({kind} on {config}) — shrinking ...")
+
+            def still_fails(cl, ap, cs):
+                got = _check_case(cl, ap, cs)
+                return got is not None and got[1] == kind
+
+            s_cluster, s_apps = _shrink(cluster, apps, cells, still_fails)
+            failure.reproducer = write_reproducer(
+                s_cluster, s_apps,
+                os.path.join(out_dir, f"fuzz_{case_seed}_{config}_{kind}.yaml"),
+            )
+        result.failures.append(failure)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Mutation-kill
+# ---------------------------------------------------------------------------
+
+
+def _mutation_fixture(seed: int = 0, n_nodes: int = 16):
+    """A placed problem guaranteeing every corruption class a target:
+    headroom-light burst pods (overcommit), zone selectors (invalid node),
+    hard hostname anti-affinity, hard zone spread, required zone
+    self-affinity, and one host port per node."""
+    cluster = synth_cluster(n_nodes, seed=seed, zones=4, taint_frac=0.0)
+    res = ResourceTypes()
+    res.deployments = [
+        make_deployment("burst", 3 * n_nodes, 2000, 512),
+        make_deployment("sel", 8, 250, 256,
+                        node_selector={"topology.kubernetes.io/zone": "zone-0"}),
+        make_deployment("anti", 6, 250, 256,
+                        anti_affinity_topo="kubernetes.io/hostname",
+                        anti_affinity_required=True),
+        make_deployment("spread", 8, 250, 256,
+                        spread_topo="topology.kubernetes.io/zone",
+                        spread_hard=True),
+        make_deployment("colo", 6, 250, 256,
+                        affinity_topo="topology.kubernetes.io/zone"),
+        make_deployment("porty", 4, 100, 128, host_port=8080),
+    ]
+    apps = [AppResource(name="mut", resource=res)]
+    return cluster, apps
+
+
+MUTATION_CLASSES = (
+    "invalid-node",
+    "overcommit",
+    "affinity-break",
+    "anti-affinity-break",
+    "spread-break",
+    "port-conflict",
+    "illegal-eviction",
+)
+
+
+def _mutate_nodes(kind: str, tensors, batch, nodes: np.ndarray, rng):
+    """Corrupt the landing-node vector for one engine-level mutation
+    class; returns the corrupted copy, or None when the case lacks the
+    feature (the fixture guarantees it never does)."""
+    nodes = np.asarray(nodes).copy()
+    group = np.asarray(batch.group)
+    placed = (nodes >= 0) & ~np.asarray(batch.forced, bool)
+    static = np.asarray(tensors.static_mask, bool)
+
+    def rows_of(pred_g) -> np.ndarray:
+        gs = np.flatnonzero(pred_g)
+        return np.flatnonzero(placed & np.isin(group, gs))
+
+    if kind == "invalid-node":
+        for j in rng.permutation(np.flatnonzero(placed)):
+            bad = np.flatnonzero(~static[group[j]])
+            if len(bad):
+                nodes[j] = int(rng.choice(bad))
+                return nodes
+        return None
+    if kind == "overcommit":
+        from ..core.tensorize import RES_CPU
+
+        alloc = np.asarray(tensors.alloc)
+        req = np.asarray(batch.req)
+        target = int(np.argmin(alloc[:, RES_CPU]))
+        total = 0.0
+        moved = False
+        for j in np.flatnonzero(placed):
+            if not static[group[j], target]:
+                continue
+            nodes[j] = target
+            total += float(req[j, RES_CPU])
+            moved = True
+            if total > alloc[target, RES_CPU] * 1.01:
+                return nodes
+        return nodes if moved and total > alloc[target, RES_CPU] else None
+    if kind == "anti-affinity-break":
+        a_anti = np.asarray(tensors.a_anti_req, bool)
+        rows = rows_of(a_anti.any(axis=1))
+        if len(rows) < 2:
+            return None
+        nodes[rows[1]] = nodes[rows[0]]
+        return nodes
+    if kind == "spread-break":
+        sh = np.asarray(tensors.spread_hard)
+        rows = rows_of((sh > 0).any(axis=1))
+        if len(rows) < 3:
+            return None
+        nodes[rows] = nodes[rows[0]]
+        return nodes
+    if kind == "affinity-break":
+        a_aff = np.asarray(tensors.a_aff_req, bool)
+        for g in np.flatnonzero(a_aff.any(axis=1)):
+            rows = np.flatnonzero(placed & (group == g))
+            if len(rows) < 2:
+                continue
+            t = int(np.flatnonzero(a_aff[g])[0])
+            dom = tensors.node_dom[int(tensors.term_topo_key[t])]
+            have = set(int(d) for d in dom[nodes[rows]])
+            other = np.flatnonzero(
+                (dom >= 0) & ~np.isin(dom, list(have)) & static[g]
+            )
+            if len(other):
+                nodes[rows[-1]] = int(other[0])
+                return nodes
+        return None
+    if kind == "port-conflict":
+        ports = np.asarray(tensors.ports, bool)
+        rows = rows_of(ports.any(axis=1))
+        if len(rows) < 2:
+            return None
+        nodes[rows[1]] = nodes[rows[0]]
+        return nodes
+    return None
+
+
+def run_mutation_kill(
+    seed: int = 0,
+    per_class: int = 4,
+    n_nodes: int = 16,
+    progress: Optional[Callable[[str], None]] = None,
+) -> Dict[str, object]:
+    """Corrupt accepted placements across every MUTATION_CLASSES entry and
+    count auditor detections.  The contract is 100% kill — asserted by
+    tests/test_audit.py and `make bench-audit`."""
+    from ..faults.drain import place_cluster
+
+    say = progress or (lambda s: None)
+    rng = np.random.default_rng(seed)
+    cluster, apps = _mutation_fixture(seed, n_nodes)
+    pc = place_cluster(cluster, apps, bulk=False)
+    ext = extras_from_log(pc)
+    base = audit_placement(pc.tensors, pc.batch, pc.nodes, ext)
+    if not base.ok:
+        raise AssertionError(
+            f"mutation fixture must start audit-clean: {base.summary()}"
+        )
+    tried: Dict[str, int] = {}
+    killed: Dict[str, int] = {}
+    missed: List[str] = []
+    for kind in MUTATION_CLASSES:
+        if kind == "illegal-eviction":
+            t, k = _run_eviction_mutations(seed, per_class, say)
+            tried[kind], killed[kind] = t, k
+            if k < t:
+                missed.append(kind)
+            continue
+        tried[kind] = killed[kind] = 0
+        for trial in range(per_class):
+            mut = _mutate_nodes(
+                kind, pc.tensors, pc.batch, pc.nodes,
+                np.random.default_rng(seed + trial),
+            )
+            if mut is None:
+                continue
+            tried[kind] += 1
+            rep = audit_placement(pc.tensors, pc.batch, mut, ext)
+            if not rep.ok:
+                killed[kind] += 1
+            else:
+                missed.append(f"{kind}#{trial}")
+        say(f"mutation {kind}: {killed[kind]}/{tried[kind]} killed")
+    # a class whose mutator never found a target is a FIXTURE hole, not a
+    # pass — it must land in `missed` or the 100%-kill contract would
+    # silently shrink to "100% of whatever happened to be tried"
+    for kind in MUTATION_CLASSES:
+        if not tried.get(kind):
+            missed.append(f"{kind}#untried")
+    total_t = sum(tried.values())
+    total_k = sum(killed.values())
+    return {
+        "classes": len([k for k in tried if tried[k]]),
+        "classes_total": len(MUTATION_CLASSES),
+        "tried": total_t,
+        "killed": total_k,
+        "kill_rate": (total_k / total_t) if total_t else 1.0,
+        "by_class": {k: f"{killed[k]}/{tried[k]}" for k in tried},
+        "missed": missed,
+    }
+
+
+def _run_eviction_mutations(seed: int, per_class: int, say):
+    """Preemption-legality mutations on a Simulator run that genuinely
+    preempts: (a) forge a priority inversion on a reported eviction,
+    (b) report an eviction whose victim is still placed."""
+    from ..api import Simulator
+    from ..core.objects import name_of, namespace_of
+    from ..workloads.expand import get_valid_pods_exclude_daemonset
+
+    cluster = ResourceTypes()
+    # fixed small nodes so the filler genuinely saturates the cluster and
+    # the high-priority app MUST preempt
+    cluster.nodes = [
+        make_node(f"ev-{i}", 16000, 32, {"kubernetes.io/hostname": f"ev-{i}"})
+        for i in range(4)
+    ]
+    # fill with low-priority pods, then a high-priority app that must evict
+    filler = ResourceTypes(
+        deployments=[make_deployment("low", 30, 2000, 1024, priority=0)]
+    )
+    cluster.pods = get_valid_pods_exclude_daemonset(filler)
+    apps = [
+        AppResource(
+            name="high",
+            resource=ResourceTypes(
+                deployments=[make_deployment("high", 6, 4000, 2048, priority=100)]
+            ),
+        )
+    ]
+    sim = Simulator()
+    sim.run_cluster(cluster)
+    for app in apps:
+        sim.schedule_app(app)
+    if not sim._preempted:
+        raise AssertionError("eviction fixture produced no preemptions")
+    base = audit_simulation(sim)
+    if not base.ok:
+        raise AssertionError(
+            f"eviction fixture must start audit-clean: {base.summary()}"
+        )
+    tried = killed = 0
+    # (a) priority inversion: claim the victim outranked its preemptor
+    for pre in sim._preempted[:per_class]:
+        tried += 1
+        saved = pre.pod
+        forged = {**saved, "spec": {**(saved.get("spec") or {}), "priority": 10_000}}
+        pre.pod = forged
+        rep = audit_simulation(sim)
+        pre.pod = saved
+        if not rep.ok and "preemption" in rep.by_class:
+            killed += 1
+    # (b) evicted-but-placed: report a still-placed pod as a victim
+    victim = sim._scheduled[0]
+    by = f"{namespace_of(sim._scheduled[-1])}/{name_of(sim._scheduled[-1])}"
+    forged = PreemptedPod(pod=victim, preempted_by=by,
+                          node=victim["spec"].get("nodeName", ""))
+    sim._preempted.append(forged)
+    tried += 1
+    rep = audit_simulation(sim)
+    sim._preempted.pop()
+    if not rep.ok and "preemption" in rep.by_class:
+        killed += 1
+    say(f"mutation illegal-eviction: {killed}/{tried} killed")
+    return tried, killed

@@ -79,11 +79,33 @@ def _plan_json(plan, resilience: dict = None) -> str:
     return json.dumps(doc)
 
 
+class _SweepAuditFailure(Exception):
+    """The --faults sweep's base placement failed its audit AND the
+    serial-exact fallback did not certify either — the hardest audit
+    outcome.  Carries the audit doc so cmd_apply can surface the
+    violations/divergence record and return EXIT_AUDIT (a generic
+    sweep-failure ValueError would exit 0 with the diagnostics lost)."""
+
+    def __init__(self, message: str, audit_doc: dict):
+        super().__init__(message)
+        self.audit_doc = audit_doc
+
+
 def _apply_faults_sweep(applier, plan, spec: str, samples: int, seed: int, progress):
     """Post-plan survivability assessment for `simtpu apply --faults`: one
     batched fault sweep over the WINNING cluster (base + the clones the
     plan added).  Placement for the sweep runs engine-level without
-    preemption (the capacity-sweep contract, plan/resilience.py)."""
+    preemption (the capacity-sweep contract, plan/resilience.py).
+
+    Returns (sweep, base_unplaced, audit_doc): the sweep's drain-from
+    placement is independently audited (simtpu/audit) unless opted out,
+    with the serial-exact fallback re-placing on failure — a corrupted
+    base would silently skew EVERY scenario's verdict."""
+    from .audit.checker import (
+        audit_enabled,
+        audit_placed_cluster,
+        inject_divergence_enabled,
+    )
     from .core.objects import ResourceTypes
     from .faults import generate_scenarios, place_cluster, sweep_scenarios
     from .plan.capacity import new_fake_nodes
@@ -106,6 +128,14 @@ def _apply_faults_sweep(applier, plan, spec: str, samples: int, seed: int, progr
         extended_resources=applier.opts.extended_resources,
         sched_config=applier._sched_config(),
     )
+    opt_audit = applier.opts.audit
+    audit_doc = None
+    if audit_enabled() if opt_audit is None else opt_audit:
+        pc, audit_doc, hard_fail = audit_placed_cluster(
+            pc, progress, inject=inject_divergence_enabled()
+        )
+        if hard_fail is not None:
+            raise _SweepAuditFailure(hard_fail, audit_doc)
     # the sweep's own base placement can differ from the plan's (engine-
     # level, simulate() pod order, no preemption) — pods it strands never
     # enter a requeue, so the count MUST ride the output or the counters
@@ -117,7 +147,7 @@ def _apply_faults_sweep(applier, plan, spec: str, samples: int, seed: int, progr
             "placement — survivability is assessed over the placed set only"
         )
     scen = generate_scenarios(cluster.nodes, spec, samples=samples, seed=seed)
-    return sweep_scenarios(pc, scen), base_unplaced
+    return sweep_scenarios(pc, scen), base_unplaced, audit_doc
 
 
 def _sweep_json_doc(sweep, spec: str, samples: int, seed: int) -> dict:
@@ -153,6 +183,7 @@ def cmd_apply(args: argparse.Namespace) -> int:
         # first ^C = graceful partial result + flushed checkpoint; second
         # ^C = the default KeyboardInterrupt (durable/deadline.py)
         install_sigint=True,
+        audit=args.audit,
     )
     def fail_early(exc: Exception) -> int:
         # the --json contract holds on EVERY exit: config/load failures
@@ -203,12 +234,20 @@ def cmd_apply(args: argparse.Namespace) -> int:
     except (ValueError, FileNotFoundError) as exc:
         return fail_early(exc)
     fault_sweep, fault_base_unplaced, fault_error = None, 0, None
+    fault_audit = None
     if args.faults and plan.success:
         try:
-            fault_sweep, fault_base_unplaced = _apply_faults_sweep(
+            fault_sweep, fault_base_unplaced, fault_audit = _apply_faults_sweep(
                 applier, plan, args.faults, args.fault_samples,
                 args.fault_seed, progress,
             )
+        except _SweepAuditFailure as exc:
+            # the hardest audit outcome: neither the sweep's base
+            # placement nor the serial-exact fallback certified — keep
+            # the audit doc so the exit code and --json carry it
+            fault_error = str(exc)
+            fault_audit = exc.audit_doc
+            print(f"fault sweep audit failed: {exc}", file=sys.stderr)
         except ValueError as exc:
             # a failed post-plan sweep must not discard the successful
             # plan: record the error alongside it instead
@@ -221,17 +260,32 @@ def cmd_apply(args: argparse.Namespace) -> int:
                 fault_sweep, args.faults, args.fault_samples, args.fault_seed
             )
             resilience["base_unplaced"] = fault_base_unplaced
+            if fault_audit is not None:
+                resilience["audit"] = fault_audit
         elif fault_error is not None:
             resilience = {"error": fault_error}
+            if fault_audit is not None:
+                resilience["audit"] = fault_audit
         print(_plan_json(plan, resilience=resilience))
         if plan.partial:
             return EXIT_PARTIAL
+        if _audit_failed(plan.audit) or _audit_failed(fault_audit):
+            return EXIT_AUDIT
         return 0 if plan.success else 1
     if plan.success:
         print(f"{C.COLOR_GREEN}Success!{C.COLOR_RESET}")
         print(C.COLOR_GREEN, end="")
         print(report(plan.result.node_status, opts.extended_resources))
         print(C.COLOR_RESET, end="")
+        if plan.audit:
+            from .report import audit_report
+
+            color = C.COLOR_RED if _audit_failed(plan.audit) else C.COLOR_GREEN
+            print(f"{color}{audit_report(plan.audit)}{C.COLOR_RESET}")
+        if _audit_failed(fault_audit):
+            from .report import audit_report
+
+            print(f"{C.COLOR_RED}{audit_report(fault_audit)}{C.COLOR_RESET}")
         if fault_sweep is not None:
             from .report import resilience_report
 
@@ -248,13 +302,21 @@ def cmd_apply(args: argparse.Namespace) -> int:
         if plan.engine:
             eng = " ".join(f"{k}={v}" for k, v in plan.engine.items())
             print(f"engine selection: {eng}")
+        if _audit_failed(plan.audit) or _audit_failed(fault_audit):
+            return EXIT_AUDIT
         return 0
     print(f"{C.COLOR_RED}{plan.message}{C.COLOR_RESET}")
+    if _audit_failed(plan.audit):
+        from .report import audit_report
+
+        print(f"{C.COLOR_RED}{audit_report(plan.audit)}{C.COLOR_RESET}")
     if plan.result is not None:
         print(C.COLOR_RED, end="")
         print(report(plan.result.node_status, opts.extended_resources))
         print(C.COLOR_RESET, end="")
-    return EXIT_PARTIAL if plan.partial else 1
+    if plan.partial:
+        return EXIT_PARTIAL
+    return EXIT_AUDIT if _audit_failed(plan.audit) else 1
 
 
 def cmd_resilience(args: argparse.Namespace) -> int:
@@ -350,6 +412,7 @@ def cmd_resilience(args: argparse.Namespace) -> int:
                     sched_config=sched_config,
                     checkpoint=checkpoint,
                     control=control,
+                    audit=args.audit,
                 )
             if args.json:
                 doc = plan.counters()
@@ -369,12 +432,21 @@ def cmd_resilience(args: argparse.Namespace) -> int:
                         "minimum nodes added for survivability: "
                         f"{plan.nodes_added}"
                     )
+                if plan.audit:
+                    from .report import audit_report
+
+                    a_color = (
+                        C.COLOR_RED if _audit_failed(plan.audit) else C.COLOR_GREEN
+                    )
+                    print(f"{a_color}{audit_report(plan.audit)}{C.COLOR_RESET}")
                 if plan.sweep is not None:
                     from .report import resilience_report
 
                     print(resilience_report(plan.sweep))
             if plan.partial:
                 return EXIT_PARTIAL
+            if _audit_failed(plan.audit):
+                return EXIT_AUDIT
             return 0 if plan.success else 1
 
         from .faults import generate_scenarios, place_cluster, sweep_scenarios
@@ -390,6 +462,25 @@ def cmd_resilience(args: argparse.Namespace) -> int:
             bulk=not args.no_bulk,
             sched_config=sched_config,
         )
+        from .audit.checker import audit_enabled, inject_divergence_enabled
+
+        audit_doc = None
+        if audit_enabled() if args.audit is None else args.audit:
+            # the assessment's drain-from placement feeds EVERY scenario
+            # verdict — certify it (serial-exact fallback on failure)
+            from .audit.checker import audit_placed_cluster
+
+            pc, audit_doc, hard_fail = audit_placed_cluster(
+                pc, progress, inject=inject_divergence_enabled()
+            )
+            if hard_fail is not None:
+                if args.json:
+                    print(json.dumps({
+                        "success": False, "message": hard_fail,
+                        "audit": audit_doc,
+                    }))
+                print(hard_fail, file=sys.stderr)
+                return EXIT_AUDIT
         base_unplaced = int((pc.nodes < 0).sum())
         if base_unplaced:
             progress(
@@ -407,7 +498,11 @@ def cmd_resilience(args: argparse.Namespace) -> int:
         doc = _sweep_json_doc(sweep, args.faults, args.samples, args.seed)
         doc["success"] = survived_all
         doc["base_unplaced"] = base_unplaced
+        if audit_doc is not None:
+            doc["audit"] = audit_doc
         print(json.dumps(doc))
+        if _audit_failed(audit_doc):
+            return EXIT_AUDIT
         return 0 if survived_all else 1
     from .report import resilience_report
 
@@ -415,12 +510,107 @@ def cmd_resilience(args: argparse.Namespace) -> int:
     print(color, end="")
     print(resilience_report(sweep))
     print(C.COLOR_RESET, end="")
+    if _audit_failed(audit_doc):
+        from .report import audit_report
+
+        print(f"{C.COLOR_RED}{audit_report(audit_doc)}{C.COLOR_RESET}")
     rate = sweep.timings.get("scenarios_per_s", 0.0)
     print(
         f"{len(scen)} scenario(s), {int(sweep.survived.sum())} survived "
         f"({rate:.0f} scenarios/s)"
     )
+    if _audit_failed(audit_doc):
+        return EXIT_AUDIT
     return 0 if survived_all else 1
+
+
+def cmd_fuzz(args: argparse.Namespace) -> int:
+    """Differential fuzz / mutation-kill driver (simtpu/audit/fuzz.py).
+
+    Exit codes: 0 = every case bit-identical and audit-clean (or 100%
+    mutation kill); EXIT_AUDIT = a divergence, dirty audit, or missed
+    mutation — the finding IS the failure."""
+    import json
+
+    progress_stream = sys.stderr if args.json else sys.stdout
+
+    def progress(msg: str) -> None:
+        print(f"{C.COLOR_YELLOW}{msg}{C.COLOR_RESET}", file=progress_stream)
+
+    if args.mutation_kill:
+        from .audit.fuzz import run_mutation_kill
+
+        counters = run_mutation_kill(
+            seed=args.seed, per_class=args.per_class, progress=progress
+        )
+        ok = (
+            counters["kill_rate"] >= 1.0
+            and counters["classes"] == counters["classes_total"]
+            and not counters["missed"]
+        )
+        if args.json:
+            print(json.dumps({"ok": ok, **counters}))
+        else:
+            color = C.COLOR_GREEN if ok else C.COLOR_RED
+            print(
+                f"{color}mutation-kill: {counters['killed']}/"
+                f"{counters['tried']} corruptions detected across "
+                f"{counters['classes']} classes{C.COLOR_RESET}"
+            )
+            if counters["missed"]:
+                print(f"{C.COLOR_RED}missed: {counters['missed']}{C.COLOR_RESET}")
+        return 0 if ok else EXIT_AUDIT
+
+    if args.replay:
+        from .audit.fuzz import replay_case
+
+        try:
+            bad = replay_case(args.replay, include_shard=args.shard)
+        except (ValueError, FileNotFoundError) as exc:
+            if args.json:
+                print(json.dumps({"ok": False, "message": str(exc)}))
+            print(exc, file=sys.stderr)
+            return 1
+        if args.json:
+            doc = {"ok": bad is None, "replay": args.replay}
+            if bad is not None:
+                doc.update(config=bad[0], kind=bad[1], detail=bad[2])
+            print(json.dumps(doc))
+        elif bad is None:
+            print(f"{C.COLOR_GREEN}replay clean: every engine config "
+                  f"bit-identical and audit-clean{C.COLOR_RESET}")
+        else:
+            print(f"{C.COLOR_RED}replay FAILED on config {bad[0]} "
+                  f"({bad[1]}): {bad[2]}{C.COLOR_RESET}")
+        return 0 if bad is None else EXIT_AUDIT
+
+    from .audit.fuzz import run_differential
+
+    result = run_differential(
+        cases=args.cases,
+        seed=args.seed,
+        n_nodes=args.nodes,
+        n_pods=args.pods,
+        out_dir=args.out,
+        include_shard=args.shard,
+        progress=progress,
+    )
+    if args.json:
+        print(json.dumps(result.counters()))
+    elif result.ok:
+        print(
+            f"{C.COLOR_GREEN}fuzz clean: {result.cases} case(s), "
+            f"{result.configs_run} engine-config runs, all bit-identical "
+            f"and audit-clean{C.COLOR_RESET}"
+        )
+    else:
+        for f in result.failures:
+            repro = f" reproducer={f.reproducer}" if f.reproducer else ""
+            print(
+                f"{C.COLOR_RED}seed {f.seed} config {f.config}: {f.kind} "
+                f"— {f.detail}{repro}{C.COLOR_RESET}"
+            )
+    return 0 if result.ok else EXIT_AUDIT
 
 
 def cmd_version(_args: argparse.Namespace) -> int:
@@ -432,6 +622,45 @@ def cmd_version(_args: argparse.Namespace) -> int:
 #: cleanly with a flushed checkpoint and a `partial=true` report, but the
 #: search did not complete — distinct from 1 ("the plan ran and failed")
 EXIT_PARTIAL = 3
+
+#: exit code for an audit failure (docs/robustness.md): the independent
+#: placement auditor caught the primary engine violating its claimed
+#: constraints.  When the serial-exact fallback certified, the SHIPPED
+#: plan is the fallback's (correct) answer — the nonzero code still fires
+#: so CI and scripts notice the engine divergence; when even the fallback
+#: failed certification, no plan ships at all.  Distinct from 1 ("the
+#: plan ran and found the problem infeasible") and 3 (interrupted)
+EXIT_AUDIT = 4
+
+
+def _audit_failed(doc: Optional[dict]) -> bool:
+    """True when an audit record describes a caught divergence — the
+    primary engine's answer failed certification (whether or not the
+    serial-exact fallback then certified)."""
+    return bool(doc) and (bool(doc.get("fallback")) or not doc.get("ok", True))
+
+
+def _add_audit_flags(p: argparse.ArgumentParser) -> None:
+    """Independent-auditor opt-out shared by the planning commands
+    (docs/robustness.md, simtpu/audit)."""
+    p.add_argument(
+        "--audit",
+        dest="audit",
+        action="store_true",
+        default=None,
+        help="certify the accepted placement through the independent "
+        "auditor (default: on, SIMTPU_AUDIT=0 disables globally); an "
+        "audit failure falls back to the serial exact engines, ships "
+        "THEIR certified answer, and exits with code "
+        f"{EXIT_AUDIT}",
+    )
+    p.add_argument(
+        "--no-audit",
+        dest="audit",
+        action="store_false",
+        help="skip the independent placement audit (the plan ships "
+        "uncertified)",
+    )
 
 
 def _add_durable_flags(p: argparse.ArgumentParser) -> None:
@@ -598,6 +827,7 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="SEED",
         help="deterministic seed for sampled fault scenarios (default 0)",
     )
+    _add_audit_flags(apply_p)
     _add_durable_flags(apply_p)
     apply_p.set_defaults(func=cmd_apply)
 
@@ -678,8 +908,74 @@ def build_parser() -> argparse.ArgumentParser:
         "survived, fault_scenarios_per_s, worst scenarios, critical nodes) "
         "instead of the report tables",
     )
+    _add_audit_flags(res_p)
     _add_durable_flags(res_p)
     res_p.set_defaults(func=cmd_resilience)
+
+    fuzz_p = sub.add_parser(
+        "fuzz",
+        help="differential fuzz the engine-config matrix against the "
+        "serial baseline + the independent auditor (simtpu/audit)",
+        description="Seeded differential fuzzing (docs/robustness.md): "
+        "generate gnarly spec/cluster cases, place each across the "
+        "engine-config matrix (wavefront on/off x compact on/off x "
+        "GSPMD shard on/off x injected-OOM backoff), and assert "
+        "bit-identical, audit-clean placements.  Failing cases shrink "
+        "to a minimal reproducer YAML under --out.  --mutation-kill "
+        "instead corrupts accepted placements across every corruption "
+        "class and asserts the auditor flags 100% of them.",
+    )
+    fuzz_p.add_argument(
+        "--cases", type=int, default=16, metavar="N",
+        help="generated cases for the differential mode (default 16)",
+    )
+    fuzz_p.add_argument(
+        "--seed", type=int, default=0, metavar="SEED",
+        help="base seed; case i draws from seed + 1000*i (default 0)",
+    )
+    fuzz_p.add_argument(
+        "--nodes", type=int, default=32, metavar="N",
+        help="synthetic cluster size per case (default 32)",
+    )
+    fuzz_p.add_argument(
+        "--pods", type=int, default=160, metavar="N",
+        help="pods per case (default 160)",
+    )
+    fuzz_p.add_argument(
+        "--out", metavar="DIR", default="",
+        help="write auto-shrunk minimal reproducer YAMLs for failing "
+        "cases under DIR (skipping shrink when unset)",
+    )
+    fuzz_p.add_argument(
+        "--replay", metavar="FILE",
+        help="re-run one reproducer YAML (written by --out) across the "
+        "engine-config matrix instead of generating cases",
+    )
+    fuzz_p.add_argument(
+        "--mutation-kill", action="store_true",
+        help="corrupt accepted placements across every corruption class "
+        "(invalid node, overcommit, affinity/anti-affinity/spread "
+        "breaks, port conflicts, illegal evictions) and assert the "
+        "auditor flags every one",
+    )
+    fuzz_p.add_argument(
+        "--per-class", type=int, default=4, metavar="N",
+        help="mutation trials per corruption class (default 4)",
+    )
+    fuzz_p.add_argument(
+        "--shard", dest="shard", action="store_true", default=None,
+        help="force the GSPMD-sharded matrix cell (default: auto when "
+        ">1 device is visible)",
+    )
+    fuzz_p.add_argument(
+        "--no-shard", dest="shard", action="store_false",
+        help="skip the GSPMD-sharded matrix cell",
+    )
+    fuzz_p.add_argument(
+        "--json", action="store_true",
+        help="print machine-readable counters instead of progress text",
+    )
+    fuzz_p.set_defaults(func=cmd_fuzz)
 
     ver_p = sub.add_parser("version", help="print version")
     ver_p.set_defaults(func=cmd_version)

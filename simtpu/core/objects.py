@@ -555,3 +555,6 @@ class SimulateResult:
     unscheduled_pods: List[UnscheduledPod]
     node_status: List[NodeStatus]
     preempted_pods: List[PreemptedPod] = field(default_factory=list)
+    # independent placement audit (simtpu/audit AuditReport) when the
+    # caller asked `simulate(audit=True)`; None = not audited
+    audit: object = None

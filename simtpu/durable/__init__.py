@@ -27,6 +27,7 @@ registry.  Three independent levers (docs/robustness.md):
 from .backoff import backoff_counts, is_resource_exhausted, record_backoff
 from .checkpoint import (
     CHECKPOINT_VERSION,
+    CheckpointError,
     CheckpointMismatch,
     PlanCheckpoint,
     name_seed,
@@ -36,6 +37,7 @@ from .deadline import PlanInterrupted, RunControl
 
 __all__ = [
     "CHECKPOINT_VERSION",
+    "CheckpointError",
     "CheckpointMismatch",
     "PlanCheckpoint",
     "PlanInterrupted",

@@ -39,6 +39,15 @@ CHECKPOINT_VERSION = 1
 _MANIFEST = "manifest.json"
 
 
+class CheckpointError(ValueError):
+    """The checkpoint directory or its records are unusable — an
+    unwritable or non-directory `--checkpoint` path, an empty/corrupt
+    manifest, or an unreadable record file.  Raised UP FRONT (path
+    problems surface before any planning work) and rendered as one
+    actionable line by the CLI, never a mid-plan traceback
+    (docs/robustness.md)."""
+
+
 class CheckpointMismatch(ValueError):
     """The checkpoint on disk does not match this plan (format version,
     planner kind, or config/cluster fingerprint) — resuming would replay
@@ -143,15 +152,40 @@ class PlanCheckpoint:
         self.kind = kind
         self.fingerprint = fingerprint
         self._records: Dict[str, str] = {}  # "phase:cand" -> npz filename
-        os.makedirs(directory, exist_ok=True)
+        # fail UP FRONT on an unusable path — before any planning work,
+        # not as an OSError traceback when the first candidate persists
+        if os.path.exists(directory) and not os.path.isdir(directory):
+            raise CheckpointError(
+                f"--checkpoint: {directory!r} exists and is not a "
+                "directory; pass a directory path"
+            )
+        try:
+            os.makedirs(directory, exist_ok=True)
+        except OSError as exc:
+            raise CheckpointError(
+                f"--checkpoint: cannot create {directory!r} ({exc.strerror or exc}); "
+                "pass a writable directory"
+            ) from exc
+        if not os.access(directory, os.W_OK):
+            raise CheckpointError(
+                f"--checkpoint: {directory!r} is not writable; "
+                "pass a writable directory"
+            )
         mpath = os.path.join(directory, _MANIFEST)
         if resume:
             if not os.path.isfile(mpath):
                 raise CheckpointMismatch(
                     f"--resume: no checkpoint manifest under {directory!r}"
                 )
-            with open(mpath) as f:
-                man = json.load(f)
+            try:
+                with open(mpath) as f:
+                    man = json.load(f)
+            except (json.JSONDecodeError, UnicodeDecodeError, OSError) as exc:
+                raise CheckpointError(
+                    f"--resume: checkpoint manifest {mpath!r} is empty or "
+                    f"corrupt ({exc}); delete the checkpoint directory and "
+                    "re-run without --resume"
+                ) from exc
             if man.get("version") != CHECKPOINT_VERSION:
                 raise CheckpointMismatch(
                     f"checkpoint format v{man.get('version')} != "
@@ -189,8 +223,18 @@ class PlanCheckpoint:
         if fname is None:
             return None
         path = os.path.join(self.directory, fname)
-        with np.load(path, allow_pickle=False) as z:
-            return {k: z[k] for k in z.files}
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                return {k: z[k] for k in z.files}
+        except (OSError, ValueError, KeyError, EOFError) as exc:
+            # a truncated/empty/garbage record (a kill mid-rename window,
+            # disk-full, manual edits) must read as ONE actionable line,
+            # not a zipfile traceback mid-plan
+            raise CheckpointError(
+                f"--resume: checkpoint record {path!r} is empty or corrupt "
+                f"({exc}); delete it (or the whole checkpoint directory) "
+                "and re-run"
+            ) from exc
 
     def put(self, phase: str, cand: int, **entries) -> None:
         """Persist one completed candidate's record atomically and index

@@ -727,6 +727,26 @@ def ensure_dense(state, tensors):
     )
 
 
+def diff_state_planes(a, b) -> list:
+    """Names of carried planes whose values differ between two DENSE
+    states, each tagged with its max absolute difference (or the shape
+    mismatch) — the "differing state planes" witness of a divergence
+    diagnostic (simtpu/audit): when a plan fails its audit and the serial
+    fallback answers differently, this names WHICH state the diverging
+    engine corrupted.  Audit-readable view only: callers hand in
+    `Engine.carried_state()` / `build_state` outputs, never raw carries."""
+    out = []
+    for name, x, y in zip(a._fields, a, b):
+        x = np.asarray(x)
+        y = np.asarray(y)
+        if x.shape != y.shape:
+            out.append(f"{name}: shape {x.shape} vs {y.shape}")
+        elif x.size and not np.array_equal(x, y):
+            delta = np.max(np.abs(x.astype(np.float64) - y.astype(np.float64)))
+            out.append(f"{name}: max|d|={float(delta):g}")
+    return out
+
+
 def state_nbytes(state) -> dict:
     """Per-plane byte sizes of a carried state (SchedState or CompactState)
     — shape/dtype arithmetic only, no device sync."""

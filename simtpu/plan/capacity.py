@@ -86,6 +86,14 @@ class PlanResult:
     # structured partial-result contract (docs/robustness.md); rides the
     # CLI's --json as "partial"
     partial: bool = False
+    # the independent placement audit of the shipped candidate
+    # (simtpu/audit, docs/robustness.md): AuditReport.counters() plus —
+    # when the primary engine's answer failed its audit and the
+    # serial-exact fallback shipped instead — "fallback": true and a
+    # "divergence" diagnostic (first divergent pod, differing state
+    # planes).  {} = audit not run (--no-audit / SIMTPU_AUDIT=0);
+    # rides --json under engine.audit and decides the audit exit code
+    audit: Dict[str, object] = field(default_factory=dict)
 
 
 def new_fake_nodes(template: dict, count: int) -> List[dict]:
@@ -225,8 +233,17 @@ def plan_capacity(
     precompile: bool = False,
     checkpoint=None,
     control=None,
+    audit: Optional[bool] = None,
 ) -> PlanResult:
     """Find the minimum clone count of `new_node` that deploys everything.
+
+    `audit` (None = the SIMTPU_AUDIT default, on) runs the independent
+    placement auditor (simtpu/audit) inside every candidate simulation
+    and gates the WINNER on its verdict: an audit-dirty winner is never
+    shipped — the candidate re-simulates through the serial exact engines
+    (bulk off, wavefront off, dense carry), re-audits, and the result
+    carries the divergence diagnostic under `PlanResult.audit`
+    (docs/robustness.md).
 
     Durable execution (docs/robustness.md): with `checkpoint` (a
     `durable.checkpoint.PlanCheckpoint`) every completed candidate's
@@ -237,6 +254,8 @@ def plan_capacity(
     `control` (a `durable.deadline.RunControl`) the deadline/SIGINT check
     runs before each candidate; an interrupt yields a partial PlanResult
     (`partial=True`) instead of a traceback."""
+    from ..audit.checker import audit_enabled, inject_divergence_enabled
+
     say = progress or (lambda s: None)
     probes: Dict[int, int] = {}
     all_daemon_sets = list(cluster.daemon_sets)
@@ -244,11 +263,32 @@ def plan_capacity(
         all_daemon_sets += app.resource.daemon_sets
     best_candidate: list = [None]  # lowest candidate found feasible
     last_result: list = [None]  # most recent live SimulateResult
+    audit_on = audit_enabled() if audit is None else bool(audit)
 
-    def run(i: int) -> SimulateResult:
+    def run(i: int, serial_exact: bool = False) -> SimulateResult:
         say(f"add {i} node(s)")
         trial = ResourceTypes(**{k: list(v) for k, v in vars(cluster).items()})
         trial.nodes = list(cluster.nodes) + new_fake_nodes(new_node, i)
+        if serial_exact:
+            # the divergence-safe fallback's engines: pod-at-a-time scan,
+            # wavefront off, dense carry (docs/robustness.md) — never the
+            # engine config whose answer just failed its audit
+            from ..engine.scan import Engine
+
+            def factory(tz):
+                eng = Engine(tz)
+                eng.speculate = False
+                eng.compact = False
+                return eng
+
+            return simulate(
+                trial,
+                apps,
+                extended_resources=extended_resources,
+                engine_factory=factory,
+                sched_config=sched_config,
+                audit=True,
+            )
         result = simulate(
             trial,
             apps,
@@ -256,6 +296,8 @@ def plan_capacity(
             bulk=bulk,
             sched_config=sched_config,
             precompile=precompile,
+            audit=audit_on,
+            _audit_inject=audit_on and inject_divergence_enabled(),
         )
         probes[i] = len(result.unscheduled_pods)
         last_result[0] = result
@@ -349,7 +391,58 @@ def plan_capacity(
     def final_success(i: int, result) -> PlanResult:
         if result is None:  # checkpoint-replayed winner: materialize live
             _, _, _, result = evaluate(i, need_result=True)
-        return PlanResult(True, i, result, "Success!", probes)
+        out = PlanResult(True, i, result, "Success!", probes)
+        rep = getattr(result, "audit", None)
+        if not audit_on or rep is None:
+            return out
+        out.audit = rep.counters()
+        if rep.ok:
+            return out
+        # divergence-safe fallback: the winner's audit failed — do NOT
+        # ship it; re-simulate through the serial exact engines and
+        # re-audit (docs/robustness.md)
+        say(
+            f"audit FAILED on the winning candidate ({rep.summary()}) — "
+            "re-simulating through the serial exact engines"
+        )
+        fb = run(i, serial_exact=True)
+        rep_f = fb.audit
+        audit_doc = {
+            **rep.counters(),
+            "fallback": True,
+            "fallback_audit": rep_f.counters(),
+            "divergence": _result_divergence(result, fb, rep),
+        }
+        if not rep_f.ok or fb.unscheduled_pods:
+            out = PlanResult(
+                False, i, fb,
+                "audit failure: the winning candidate violates its claimed "
+                "constraints and the serial-exact fallback did not certify "
+                f"either ({rep_f.summary()})",
+                probes,
+            )
+            out.audit = audit_doc
+            return out
+        audit_doc["ok"] = True
+        out.result = fb
+        out.audit = audit_doc
+        return out
+
+    def _result_divergence(primary, fallback, report) -> Dict[str, object]:
+        """Divergence record for two SimulateResults.  Pod-name suffixes
+        are process-random across separate simulations, so the diagnostic
+        compares per-node pod counts rather than names."""
+
+        def by_node(res):
+            return {name_of(s.node): len(s.pods) for s in res.node_status}
+
+        pa, fb = by_node(primary), by_node(fallback)
+        changed = sorted(n for n in pa if pa.get(n) != fb.get(n))
+        return {
+            "violations": dict(report.by_class),
+            "nodes_changed": len(changed),
+            "first_changed_node": changed[0] if changed else "",
+        }
 
     def linear_from(start: int) -> PlanResult:
         """The reference-exact linear walk over [start, max_new_nodes);
@@ -511,6 +604,10 @@ class ApplierOptions:
     resume: bool = False
     deadline: Optional[float] = None
     install_sigint: bool = False
+    # None = auto (the SIMTPU_AUDIT default, on): run the independent
+    # placement auditor over the accepted candidate and fall back to the
+    # serial exact engines on failure; False = --no-audit
+    audit: Optional[bool] = None
 
 
 # Auto-engine thresholds: below both, the serial scan keeps its per-pod
@@ -762,6 +859,7 @@ class Applier:
                     precompile=precompile,
                     checkpoint=checkpoint,
                     control=control,
+                    audit=self.opts.audit,
                 )
             else:
                 plan = plan_capacity(
@@ -777,6 +875,7 @@ class Applier:
                     precompile=precompile,
                     checkpoint=checkpoint,
                     control=control,
+                    audit=self.opts.audit,
                 )
         timings["plan"] = _time.perf_counter() - t0
         plan.timings = timings
@@ -830,5 +929,10 @@ class Applier:
             # the carried/dense/per-plane numbers, not a duplicate flag)
             "compact": gauge.pop("compact"),
             "state_bytes": gauge,
+            # the independent placement audit of the shipped candidate
+            # (simtpu/audit): counters, plus fallback/divergence records
+            # when the primary engine's answer failed certification.
+            # {"enabled": False} = --no-audit / SIMTPU_AUDIT=0
+            "audit": plan.audit if plan.audit else {"enabled": False},
         }
         return plan

@@ -197,9 +197,20 @@ def plan_capacity_incremental(
     speculate=None,
     checkpoint=None,
     control=None,
+    audit: Optional[bool] = None,
 ) -> PlanResult:
     """Minimum clone count of `new_node` deploying everything, via the
     incremental probe strategy described in the module docstring.
+
+    `audit` (None = the SIMTPU_AUDIT default, on) runs the independent
+    placement auditor (simtpu/audit) over the accepted candidate's fresh
+    verify placement.  On audit failure the plan is NOT shipped: the
+    candidate re-places through the serial exact scan (wavefront off,
+    dense carry), re-audits, and the result carries a divergence
+    diagnostic under `PlanResult.audit` — graceful degradation instead of
+    a silently wrong answer (docs/robustness.md).  Audit requires the
+    default `verify=True` path (the unverified fast path is explicitly
+    uncertified).
 
     Matches `plan_capacity`'s contract (candidates 0..max_new_nodes-1,
     occupancy caps, can-never-help diagnostics, PlanResult shape); the
@@ -240,6 +251,7 @@ def plan_capacity_incremental(
             cluster, apps, new_node, max_new_nodes, extended_resources,
             progress, sched_config, corrected_ds_overhead, verify,
             materialize, mesh, pipeline, speculate, checkpoint, control,
+            audit,
         )
     except PlanInterrupted as exc:
         # deadline / SIGINT between candidates (docs/robustness.md): the
@@ -280,9 +292,16 @@ def _plan_capacity_incremental(
     speculate,
     checkpoint,
     control,
+    audit=None,
 ) -> PlanResult:
+    from ..audit.checker import audit_enabled
     from ..engine.scan import statics_from, trace_counts
     from ..parallel.sweep import assemble_planning_problem
+
+    # the auditor certifies the ACCEPTED candidate's fresh verify
+    # placement; the explicitly-unverified verify=False path stays
+    # uncertified by design
+    audit_on = (audit_enabled() if audit is None else bool(audit)) and verify
 
     say = progress or (lambda s: None)
     timings: Dict[str, float] = {}
@@ -382,6 +401,36 @@ def _plan_capacity_incremental(
         m[n_base + i :] = False
         return m
 
+    def _fallback_engine(i: int):
+        """The serial exact referee the audit falls back to: pod-at-a-time
+        scan, wavefront off, dense carry (docs/robustness.md)."""
+        from ..engine.scan import Engine
+
+        fb = Engine(tz)
+        fb.node_valid = valid_mask(i)
+        fb.speculate = False
+        fb.compact = False
+        fb.sched_config = sched_config
+        return fb
+
+    def _plane_diff(a_eng, b_eng):
+        """Which carried-state planes the two engines' logs disagree on —
+        the divergence diagnostic's state witness (engine/state.py
+        diff_state_planes; audit-readable from-log views, no carries
+        touched)."""
+        from ..engine.state import build_state, diff_state_planes
+
+        def dense(e):
+            return build_state(
+                tensors,
+                np.asarray(e.placed_group, np.int32),
+                np.asarray(e.placed_node, np.int32),
+                e.log_req_matrix(r_res),
+                e.ext_log,
+            )
+
+        return diff_state_planes(dense(a_eng), dense(b_eng))
+
     r_res = tensors.alloc.shape[1]
     req_pad = batch.req
     if req_pad.shape[1] < r_res:
@@ -444,7 +493,9 @@ def _plan_capacity_incremental(
             )
             failed = (nodes < 0) & ~phantom
             probes[i] = int(failed.sum())
-            return eng, nodes, reasons, failed, gpu
+            return eng, nodes, reasons, failed, {
+                "lvm_alloc": lvm, "dev_take": dev, "gpu_shares": gpu,
+            }
         check()
         c0 = trace_counts()
         eng = make_engine(valid_mask(i), plan_batch=batch)
@@ -458,17 +509,17 @@ def _plan_capacity_incremental(
                 nodes=nodes, reasons=reasons, lvm=extras["lvm_alloc"],
                 dev=extras["dev_take"], gpu=extras["gpu_shares"],
             )
-        return eng, nodes, reasons, failed, extras["gpu_shares"]
+        return eng, nodes, reasons, failed, extras
 
     # -- base candidate: i = 0 -------------------------------------------
     t0 = time.perf_counter()
     say("add 0 node(s)")
-    base_eng, base_nodes_arr, base_reasons, base_failed, base_gpu = fresh_run(
-        0, phase="base"
+    base_eng, base_nodes_arr, base_reasons, base_failed, base_extras = (
+        fresh_run(0, phase="base")
     )
     timings["base"] = time.perf_counter() - t0
 
-    def finish(i, eng, nodes_arr, reasons, gpu_shares_arr):
+    def finish(i, eng, nodes_arr, reasons, extras):
         ok, reason = _caps_satisfied(
             tensors,
             batch.req[nodes_arr >= 0].sum(axis=0),
@@ -482,19 +533,83 @@ def _plan_capacity_incremental(
         if not ok:
             say(reason.rstrip("\n"))
             return None
+        nodes_arr = np.asarray(nodes_arr)
+        reasons = np.asarray(reasons)
+        ext_log = eng.ext_log
+        gpu_arr = extras["gpu_shares"]
+        audit_doc: Dict[str, object] = {}
+        if audit_on:
+            from ..audit.checker import (
+                audit_placement,
+                divergence_diagnostic,
+                inject_divergence,
+                inject_divergence_enabled,
+            )
+
+            phantom = clone_of >= i
+            nodes_aud = nodes_arr
+            if inject_divergence_enabled():
+                nodes_aud = inject_divergence(tensors, batch, nodes_arr)
+            rep = audit_placement(
+                tensors, batch, nodes_aud, extras,
+                node_valid=valid_mask(i), require_all=True,
+                expect_mask=~phantom,
+            )
+            audit_doc = rep.counters()
+            if not rep.ok:
+                # divergence-safe fallback (docs/robustness.md): do NOT
+                # ship the uncertified plan — re-place through the serial
+                # exact scan, re-audit, and report the divergence
+                say(
+                    f"audit FAILED on the accepted candidate "
+                    f"({rep.summary()}) — re-placing through the serial "
+                    "exact scan"
+                )
+                fb = _fallback_engine(i)
+                nodes_f, reasons_f, extras_f = fb.place(batch)
+                nodes_f = np.asarray(nodes_f)
+                rep_f = audit_placement(
+                    tensors, batch, nodes_f, extras_f,
+                    node_valid=valid_mask(i), require_all=True,
+                    expect_mask=~phantom,
+                )
+                audit_doc = {
+                    **rep.counters(),
+                    "fallback": True,
+                    "fallback_audit": rep_f.counters(),
+                    "divergence": divergence_diagnostic(
+                        tensors, batch, nodes_aud, nodes_f, rep,
+                        planes=_plane_diff(eng, fb),
+                    ),
+                }
+                if not rep_f.ok:
+                    out = PlanResult(
+                        False, i, None,
+                        "audit failure: the accepted candidate violates "
+                        "its claimed constraints and the serial-exact "
+                        f"fallback did not certify either ({rep_f.summary()})",
+                        probes,
+                    )
+                    out.audit = audit_doc
+                    return finalize(out)
+                audit_doc["ok"] = True
+                nodes_arr, reasons = nodes_f, np.asarray(reasons_f)
+                ext_log, gpu_arr = fb.ext_log, extras_f["gpu_shares"]
         result = None
         if materialize:
             t1 = time.perf_counter()
             result = _materialize(
                 tz, all_nodes, n_base + i, batch, nodes_arr, reasons,
-                clone_of, i, eng.ext_log, gpu_shares_arr,
+                clone_of, i, ext_log, gpu_arr,
             )
             timings["materialize"] = time.perf_counter() - t1
-        return finalize(PlanResult(True, i, result, "Success!", probes))
+        out = PlanResult(True, i, result, "Success!", probes)
+        out.audit = audit_doc
+        return finalize(out)
 
     if probes[0] == 0:
         best_candidate[0] = 0
-        done = finish(0, base_eng, base_nodes_arr, base_reasons, base_gpu)
+        done = finish(0, base_eng, base_nodes_arr, base_reasons, base_extras)
         if done is not None:
             return done
         # caps failed at 0: more nodes lower the average rate — keep searching
@@ -643,12 +758,12 @@ def _plan_capacity_incremental(
         i = hi
         while i < max_new_nodes:
             say(f"verify {i} node(s) with a fresh placement")
-            eng_v, nodes_v, reasons_v, failed_v, gpu_v = fresh_run(i)
+            eng_v, nodes_v, reasons_v, failed_v, extras_v = fresh_run(i)
             if probes[i] == 0:
                 if best_candidate[0] is None or i < best_candidate[0]:
                     best_candidate[0] = i
                 timings["verify"] = time.perf_counter() - t0
-                done = finish(i, eng_v, nodes_v, reasons_v, gpu_v)
+                done = finish(i, eng_v, nodes_v, reasons_v, extras_v)
                 if done is not None:
                     return done
                 i += 1  # caps failed: monotone in node count, walk upward
@@ -663,7 +778,7 @@ def _plan_capacity_incremental(
     eng_w, idx_w, nodes_w, gpu_w = hi_run
     nodes_all = base_nodes_arr.copy()
     nodes_all[idx_w] = nodes_w
-    gpu_all = np.asarray(base_gpu).copy()
+    gpu_all = np.asarray(base_extras["gpu_shares"]).copy()
     if len(idx_w):
         gpu_all[idx_w] = gpu_w
     reasons_all = base_reasons.copy()
@@ -685,9 +800,9 @@ def _plan_capacity_incremental(
         say(reason.rstrip("\n"))
         i = hi + 1
         while i < max_new_nodes:
-            eng_v, nodes_v, reasons_v, failed_v, gpu_v = fresh_run(i)
+            eng_v, nodes_v, reasons_v, failed_v, extras_v = fresh_run(i)
             if probes[i] == 0:
-                done = finish(i, eng_v, nodes_v, reasons_v, gpu_v)
+                done = finish(i, eng_v, nodes_v, reasons_v, extras_v)
                 if done is not None:
                     return done
             i += 1

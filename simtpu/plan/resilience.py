@@ -71,6 +71,11 @@ class ResiliencePlan:
     #: plan reports only the best candidate verified so far — the
     #: structured partial-result contract (docs/robustness.md)
     partial: bool = False
+    #: independent placement audit of the winning candidate's base
+    #: placement (simtpu/audit): AuditReport.counters(), plus fallback/
+    #: divergence records when the bulk engine's answer failed its audit
+    #: and the serial-exact fallback shipped instead.  {} = not audited
+    audit: Dict[str, object] = field(default_factory=dict)
 
     def counters(self) -> Dict[str, object]:
         """Machine-readable summary (CLI --json, bench)."""
@@ -84,6 +89,8 @@ class ResiliencePlan:
         }
         if self.partial:
             out["partial"] = True
+        if self.audit:
+            out["audit"] = dict(self.audit)
         if self.sweep is not None:
             out.update(self.sweep.counters())
         return out
@@ -152,6 +159,7 @@ def plan_resilience(
     corrected_ds_overhead: bool = False,
     checkpoint=None,
     control=None,
+    audit: Optional[bool] = None,
 ) -> ResiliencePlan:
     """Minimum clone count of `new_node` whose cluster still fully places
     every workload under the failure model.
@@ -170,7 +178,14 @@ def plan_resilience(
     deterministic seeds make the replayed plan bit-identical).  With
     `control` (a `durable.deadline.RunControl`) the deadline/SIGINT poll
     runs before each candidate; an interrupt yields a partial
-    ResiliencePlan (`partial=True`) instead of a traceback."""
+    ResiliencePlan (`partial=True`) instead of a traceback.
+
+    `audit` (None = the SIMTPU_AUDIT default, on) certifies the WINNING
+    candidate's base placement through the independent auditor
+    (simtpu/audit).  An audit-dirty winner is never shipped: the base
+    placement re-runs through the serial exact scan, re-audits, and the
+    sweep re-runs over the certified placement, with the divergence
+    diagnostic under `ResiliencePlan.audit` (docs/robustness.md)."""
     from ..engine.scan import statics_from
     from ..parallel.sweep import assemble_planning_problem
 
@@ -226,6 +241,12 @@ def plan_resilience(
         return m
 
     best_candidate: list = [None]  # lowest candidate found surviving
+    # artifacts of the best OK candidate's live base placement — what the
+    # winner audit certifies (one slot: worse candidates are dropped)
+    best_run: Dict[str, object] = {}
+    from ..audit.checker import audit_enabled
+
+    audit_on = audit_enabled() if audit is None else bool(audit)
 
     def probe(i: int, need_sweep: bool = False) -> bool:
         """Base placement + fault sweep for candidate i; True = survives.
@@ -262,7 +283,7 @@ def plan_resilience(
         eng.sched_config = sched_config
         eng.bulk_shapes = shape_registry
         eng.snap_shapes = True
-        nodes, reasons, _extras = eng.place(batch)
+        nodes, reasons, extras = eng.place(batch)
         nodes = np.asarray(nodes)
         phantom = clone_of >= i
         base_unplaced = int(((nodes < 0) & ~phantom).sum())
@@ -303,20 +324,135 @@ def plan_resilience(
                 record(False, doomed_msg=msg or "")
                 raise _Doomed(msg)
         record(ok)
-        if ok and (best_candidate[0] is None or i < best_candidate[0]):
+        # <= : the winner's finish() re-probe (checkpoint-replayed runs
+        # materialize the sweep live) must also refresh the audit
+        # artifacts, or a resumed plan would ship unaudited
+        if ok and (best_candidate[0] is None or i <= best_candidate[0]):
             best_candidate[0] = i
+            best_run.update(
+                i=i, eng=eng, nodes=nodes, reasons=np.asarray(reasons),
+                extras=extras,
+            )
         return ok
 
+    def _audit_winner(i: int):
+        """Certify the winning candidate's base placement; on failure
+        re-place through the serial exact scan, re-audit, and re-sweep
+        over the certified placement (the divergence-safe fallback).
+        Returns (audit_doc, hard_failure_message_or_None)."""
+        from ..audit.checker import (
+            audit_placement,
+            divergence_diagnostic,
+            inject_divergence,
+            inject_divergence_enabled,
+        )
+        from ..engine.state import build_state, diff_state_planes
+
+        eng = best_run["eng"]
+        nodes = np.asarray(best_run["nodes"])
+        phantom = clone_of >= i
+        valid = valid_mask(i)
+        nodes_aud = nodes
+        if inject_divergence_enabled():
+            nodes_aud = inject_divergence(tensors, batch, nodes)
+        rep = audit_placement(
+            tensors, batch, nodes_aud, best_run["extras"],
+            node_valid=valid, require_all=True, expect_mask=~phantom,
+        )
+        if rep.ok:
+            return rep.counters(), None
+        say(
+            f"audit FAILED on the winning candidate ({rep.summary()}) — "
+            "re-placing through the serial exact scan"
+        )
+        from ..engine.scan import Engine
+
+        fb = Engine(tz)
+        fb.node_valid = valid
+        fb.speculate = False
+        fb.compact = False
+        fb.sched_config = sched_config
+        nodes_f, reasons_f, extras_f = fb.place(batch)
+        nodes_f = np.asarray(nodes_f)
+        rep_f = audit_placement(
+            tensors, batch, nodes_f, extras_f,
+            node_valid=valid, require_all=True, expect_mask=~phantom,
+        )
+        r = tensors.alloc.shape[1]
+
+        def dense(e):
+            return build_state(
+                tensors,
+                np.asarray(e.placed_group, np.int32),
+                np.asarray(e.placed_node, np.int32),
+                e.log_req_matrix(r),
+                e.ext_log,
+            )
+
+        audit_doc = {
+            **rep.counters(),
+            "fallback": True,
+            "fallback_audit": rep_f.counters(),
+            "divergence": divergence_diagnostic(
+                tensors, batch, nodes_aud, nodes_f, rep,
+                planes=diff_state_planes(dense(eng), dense(fb)),
+            ),
+        }
+        if not rep_f.ok:
+            return audit_doc, (
+                "audit failure: the winning candidate violates its claimed "
+                "constraints and the serial-exact fallback did not certify "
+                f"either ({rep_f.summary()})"
+            )
+        # certified fallback placement: the survivability verdict must
+        # describe IT, so the winner's sweep re-runs over it
+        audit_doc["ok"] = True
+        pc = PlacedCluster(
+            tz=tz, tensors=tensors, batch=batch, engine=fb,
+            nodes=nodes_f, reasons=np.asarray(reasons_f),
+        )
+        scen = generate_scenarios(
+            all_nodes, fault_spec, samples=samples, seed=seed + i, valid=valid
+        )
+        sweeps[i] = sweep_scenarios(
+            pc, scen, s_chunk=s_chunk, mesh=mesh, pipeline=pipeline
+        )
+        rec = probes.get(i) or {}
+        rec["survived"] = int(sweeps[i].survived.sum())
+        if sweeps[i].survival_rate < quantile - 1e-12:
+            return audit_doc, (
+                "audit fallback: the serial-exact placement does not "
+                "survive the failure model "
+                f"({rec['survived']}/{len(scen)} scenarios place fully)"
+            )
+        return audit_doc, None
+
     def finish(i: int) -> ResiliencePlan:
-        if i not in sweeps and checkpoint is not None:
-            # checkpoint-replayed winner: one live re-sweep materializes
-            # its SweepResult (deterministic — seeds are `seed + i`)
+        if (i not in sweeps or best_run.get("i") != i) and (
+            audit_on or i not in sweeps
+        ):
+            # checkpoint-replayed winner (or artifacts dropped): one live
+            # re-probe materializes its SweepResult and the audit
+            # artifacts (deterministic — seeds are `seed + i`)
             probe(i, need_sweep=True)
+        audit_doc: Dict[str, object] = {}
+        if audit_on and best_run.get("i") == i:
+            audit_doc, hard_fail = _audit_winner(i)
+            if hard_fail is not None:
+                timings["total_s"] = time.perf_counter() - t_start
+                out = ResiliencePlan(
+                    False, i, k, quantile, hard_fail,
+                    probes=probes, sweep=sweeps.get(i), timings=timings,
+                )
+                out.audit = audit_doc
+                return out
         timings["total_s"] = time.perf_counter() - t_start
-        return ResiliencePlan(
+        out = ResiliencePlan(
             True, i, k, quantile, "Success!",
             probes=probes, sweep=sweeps.get(i), timings=timings,
         )
+        out.audit = audit_doc
+        return out
 
     def interrupted(exc: PlanInterrupted) -> ResiliencePlan:
         # deadline / SIGINT between candidates: the structured partial

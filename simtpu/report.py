@@ -107,6 +107,76 @@ def resilience_report(sweep, top: int = 10) -> str:
     return "\n".join(out)
 
 
+def audit_report(doc: dict) -> str:
+    """Render one audit record (simtpu/audit `AuditReport.counters()` /
+    a planner's `PlanResult.audit` doc) as the section the CLI prints
+    under the placement report.
+
+    Clean audits render one line; a dirty audit renders the per-violation
+    witness table (pod, node, constraint class, witness values) and —
+    when the divergence-safe fallback ran — the divergence diagnostic
+    (first divergent pod, differing state planes) and the fallback's own
+    verdict."""
+    if not doc:
+        return "Audit: not run (--no-audit)"
+    out: List[str] = []
+    if doc.get("fallback"):
+        fb = doc.get("fallback_audit") or {}
+        verdict = "certified" if fb.get("ok") else "NOT certified"
+        out.append(
+            f"Audit: PRIMARY ENGINE DIVERGED — {doc.get('violations', 0)} "
+            f"violation(s) over {doc.get('checked', 0)} placements; "
+            f"serial-exact fallback {verdict}"
+        )
+    elif doc.get("ok", False):
+        return (
+            f"Audit: clean ({doc.get('checked', 0)} placements certified, "
+            f"{doc.get('wall_s', 0.0):.3f}s, {doc.get('mode', '?')} mode)"
+        )
+    else:
+        out.append(
+            f"Audit: FAILED — {doc.get('violations', 0)} violation(s) "
+            f"over {doc.get('checked', 0)} placements"
+        )
+    detail = doc.get("detail") or []
+    if detail:
+        rows = [
+            [
+                v.get("class", ""),
+                v.get("pod", ""),
+                v.get("node", ""),
+                ", ".join(f"{k}={w}" for k, w in (v.get("witness") or {}).items()),
+            ]
+            for v in detail
+        ]
+        out.append(
+            render_table(
+                ["Constraint Class", "Pod", "Node", "Witness"],
+                rows,
+                merge_col0=False,
+            )
+        )
+    div = doc.get("divergence") or {}
+    if div:
+        lines = ["Divergence diagnostic:"]
+        for key in (
+            "divergent_pods",
+            "first_divergent_row",
+            "first_divergent_pod",
+            "audited_node",
+            "serial_node",
+            "nodes_changed",
+            "first_changed_node",
+        ):
+            if key in div and div[key] not in ("", None):
+                lines.append(f"  {key}: {div[key]}")
+        planes = div.get("state_planes") or []
+        if planes:
+            lines.append("  differing state planes: " + "; ".join(planes))
+        out.append("\n".join(lines))
+    return "\n".join(out)
+
+
 def contain_local_storage(extended: Sequence[str]) -> bool:
     return "open-local" in extended
 

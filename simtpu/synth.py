@@ -86,14 +86,17 @@ def make_deployment(
     gpu_index: str = None,  # preset gpu-index annotation, e.g. "0-1"
     lvm_gib=0,  # int (one claim) or tuple of ints (multi-claim)
     device_gib: int = 0,  # exclusive-SSD claim size
+    host_port: int = 0,  # hostPort on the container (NodePorts conflicts)
+    priority: int = None,  # spec.priority (preemption-relevant mixes)
 ) -> dict:
     labels = {"app": name}
     requests = {"cpu": f"{cpu_milli}m", "memory": f"{mem_mib}Mi"}
-    spec = {
-        "containers": [
-            {"name": "c", "image": "app", "resources": {"requests": requests}}
-        ]
-    }
+    container = {"name": "c", "image": "app", "resources": {"requests": requests}}
+    if host_port:
+        container["ports"] = [{"containerPort": host_port, "hostPort": host_port}]
+    spec = {"containers": [container]}
+    if priority is not None:
+        spec["priority"] = int(priority)
     if node_selector:
         spec["nodeSelector"] = dict(node_selector)
     if tolerations:

@@ -22,6 +22,7 @@ from __future__ import annotations
 import contextlib
 import json
 import random
+import re
 from typing import List, Optional
 
 from .. import constants as C
@@ -358,13 +359,52 @@ def make_valid_node_by_node(node: dict, node_name: str) -> dict:
     return node
 
 
+def check_duplicate_workloads(resources: ResourceTypes) -> None:
+    """Reject duplicate workload names within one ingest at VALIDATE time.
+
+    Two Deployments both named `foo` (one ResourceTypes — e.g. two files
+    under the same app directory) would silently shadow each other during
+    tensorize: both expand, their pods land in one group vocabulary, and
+    nothing downstream can tell which manifest produced which pod.  A
+    `SpecError` naming BOTH source files is the actionable surface
+    (docs/robustness.md, structured ingest diagnostics)."""
+    seen: dict = {}
+    buckets = [
+        ("Pod", resources.pods),
+        (C.KIND_DEPLOYMENT, resources.deployments),
+        (C.KIND_RS, resources.replica_sets),
+        (C.KIND_RC, resources.replication_controllers),
+        (C.KIND_STS, resources.stateful_sets),
+        (C.KIND_DS, resources.daemon_sets),
+        (C.KIND_JOB, resources.jobs),
+        (C.KIND_CRON_JOB, resources.cron_jobs),
+    ]
+    for kind, items in buckets:
+        for item in items:
+            full = f"{namespace_of(item)}/{name_of(item)}"
+            src = item.get(SOURCE_KEY) or "<in-memory>"
+            prev = seen.get((kind, full))
+            if prev is not None:
+                raise SpecError(
+                    f"duplicate {kind} name within one ingest (also "
+                    f"defined in {prev}); later definitions would "
+                    "silently shadow during tensorize — rename one",
+                    source=src,
+                    kind=kind,
+                    name=full,
+                )
+            seen[(kind, full)] = src
+
+
 def get_valid_pods_exclude_daemonset(resources: ResourceTypes) -> List[dict]:
     """Expand every non-DaemonSet workload (`pkg/simulator/utils.go:111-135`).
 
     Order matters and matches the reference: bare pods, deployments, replica
     sets, replication controllers, stateful sets, jobs, cron jobs.
     """
+    check_duplicate_workloads(resources)
     pods: List[dict] = []
+    pod_src: dict = {}  # "ns/name" -> source file of the producing workload
     expanders = [
         (resources.pods, "Pod", lambda it: [make_valid_pod_by_pod(it)]),
         (resources.deployments, C.KIND_DEPLOYMENT, make_valid_pods_by_deployment),
@@ -381,5 +421,50 @@ def get_valid_pods_exclude_daemonset(resources: ResourceTypes) -> List[dict]:
     for items, kind, expander in expanders:
         for item in items:
             with spec_context(kind, item):
-                pods.extend(expander(item))
+                new = expander(item)
+            src = item.get(SOURCE_KEY) or "<in-memory>"
+            for pod in new:
+                full = f"{namespace_of(pod)}/{name_of(pod)}"
+                prev = pod_src.get(full)
+                if prev is not None:
+                    # only names that really came from the random-suffix
+                    # scheme (`<generateName>-<POD_HASH_DIGITS hex>`) may
+                    # re-draw: STS ordinal pods also CARRY generateName
+                    # but are named `{name}-{ordinal}` deterministically —
+                    # renaming one would break the ordinal identity its
+                    # volume claims were computed against
+                    gen = (pod.get("metadata") or {}).get("generateName")
+                    if gen and not re.fullmatch(
+                        re.escape(f"{gen}{C.SEPARATE_SYMBOL}")
+                        + f"[0-9a-f]{{{C.POD_HASH_DIGITS}}}",
+                        name_of(pod),
+                    ):
+                        gen = None
+                    if not gen:
+                        # explicitly-named pods (bare Pods, STS ordinals)
+                        # colliding is a spec bug — shadowing during
+                        # tensorize would silently drop one
+                        raise SpecError(
+                            "pod name collides within one ingest (a pod "
+                            f"of the same name comes from {prev}); "
+                            "rename one of the workloads",
+                            source=src,
+                            kind=kind,
+                            name=f"{namespace_of(item)}/{name_of(item)}",
+                            field=f"pod {full}",
+                        )
+                    # random-suffix collision on a GENERATED name — a
+                    # birthday certainty at million-pod scale (5 hex
+                    # digits per owner), not a user error: re-draw from
+                    # the same deterministic stream until unique, so
+                    # nothing downstream (preemption keys, audit logs,
+                    # checkpoints) ever sees two pods shadowing one name
+                    while full in pod_src:
+                        pod["metadata"]["name"] = (
+                            f"{gen}{C.SEPARATE_SYMBOL}"
+                            f"{_hash_suffix(C.POD_HASH_DIGITS)}"
+                        )
+                        full = f"{namespace_of(pod)}/{name_of(pod)}"
+                pod_src[full] = src
+            pods.extend(new)
     return pods

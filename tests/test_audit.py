@@ -1,0 +1,508 @@
+"""Trust-but-verify tests (simtpu/audit, ISSUE 7).
+
+The load-bearing pins:
+
+- mutation-kill: the independent auditor detects 100% of seeded
+  placement corruptions across every corruption class (invalid node,
+  overcommit, affinity/anti-affinity/spread breaks, port conflicts,
+  illegal evictions);
+- mode parity: the jitted bulk pass and the pure-numpy reference path
+  (SIMTPU_AUDIT_JIT=0 style) return identical verdicts AND identical
+  violation classes, clean and dirty;
+- audit-clean: every examples/ config and the fuzz seed corpus audit
+  clean across the engine-config matrix;
+- divergence-safe fallback: an injected engine divergence
+  (SIMTPU_AUDIT_INJECT=1) makes every planner re-place through the
+  serial exact scan, ship the CERTIFIED answer, and report the
+  divergence diagnostic; the CLI maps it to the documented exit code 4;
+- --no-audit / audit=False opts out ({} in PlanResult.audit);
+- all-or-nothing completeness: `require_all` flags stranded rows.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from simtpu import AppResource, ResourceTypes
+from simtpu.audit.checker import (
+    audit_placement,
+    divergence_diagnostic,
+    extras_from_log,
+    inject_divergence,
+)
+from simtpu.audit.fuzz import (
+    MUTATION_CLASSES,
+    _check_case,
+    _mutate_nodes,
+    _mutation_fixture,
+    _shrink,
+    engine_configs,
+    gen_case,
+    load_reproducer,
+    run_differential,
+    run_mutation_kill,
+    write_reproducer,
+)
+from simtpu.faults.drain import place_cluster
+from simtpu.plan.capacity import plan_capacity
+from simtpu.plan.incremental import plan_capacity_incremental
+from simtpu.plan.resilience import plan_resilience
+from simtpu.synth import synth_cluster
+
+from .fixtures import make_fake_deployment, make_fake_node
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _small_plan_problem():
+    cluster = ResourceTypes()
+    cluster.nodes = [make_fake_node("base-1", "4", "8Gi")]
+    apps = [
+        AppResource(
+            name="app",
+            resource=ResourceTypes(
+                deployments=[
+                    make_fake_deployment("web", "default", 7, "2", "4Gi")
+                ]
+            ),
+        )
+    ]
+    template = make_fake_node("template", "4", "8Gi")
+    return cluster, apps, template
+
+
+class TestModeParity:
+    """jit and numpy bulk passes are pinned to identical verdicts."""
+
+    @pytest.mark.parametrize("seed", [0, 1000, 2000])
+    def test_clean_and_mutated_verdicts_match(self, seed):
+        cluster, apps, _mix = gen_case(seed, n_nodes=10, n_pods=40)
+        pc = place_cluster(cluster, apps, bulk=False)
+        ext = extras_from_log(pc)
+
+        def both(nodes):
+            r_jit = audit_placement(pc.tensors, pc.batch, nodes, ext, jit=True)
+            r_np = audit_placement(pc.tensors, pc.batch, nodes, ext, jit=False)
+            assert r_jit.ok == r_np.ok
+            assert r_jit.by_class == r_np.by_class
+            assert r_jit.total == r_np.total
+            return r_jit
+
+        assert both(pc.nodes).ok, "fuzz case must start audit-clean"
+        # a corrupted placement must be dirty in BOTH modes, same classes
+        bad = inject_divergence(pc.tensors, pc.batch, pc.nodes)
+        rep = both(bad)
+        assert not rep.ok
+
+    def test_env_lever_selects_numpy(self, monkeypatch):
+        monkeypatch.setenv("SIMTPU_AUDIT_JIT", "0")
+        cluster, apps, _ = gen_case(0, n_nodes=8, n_pods=24)
+        pc = place_cluster(cluster, apps, bulk=False)
+        rep = audit_placement(
+            pc.tensors, pc.batch, pc.nodes, extras_from_log(pc)
+        )
+        assert rep.mode == "numpy"
+        assert rep.ok
+
+
+class TestMutationKill:
+    def test_100_percent_kill_across_classes(self):
+        counters = run_mutation_kill(seed=0, per_class=2, n_nodes=12)
+        assert counters["classes"] == len(MUTATION_CLASSES) == 7
+        assert counters["classes"] == counters["classes_total"]
+        assert counters["kill_rate"] == 1.0, counters["by_class"]
+        assert not counters["missed"]
+
+    def test_untried_class_lands_in_missed(self, monkeypatch):
+        """A corruption class whose mutator never finds a target is a
+        fixture hole, not a pass — it must surface in `missed` so the
+        100%-kill contract cannot silently shrink."""
+        from simtpu.audit import fuzz as F
+
+        real = F._mutate_nodes
+
+        def skip_ports(kind, *a, **kw):
+            if kind == "port-conflict":
+                return None
+            return real(kind, *a, **kw)
+
+        monkeypatch.setattr(F, "_mutate_nodes", skip_ports)
+        counters = run_mutation_kill(seed=0, per_class=1, n_nodes=12)
+        assert counters["classes"] == counters["classes_total"] - 1
+        assert "port-conflict#untried" in counters["missed"]
+
+    def test_each_class_reports_its_own_violation(self):
+        """Every engine-level mutation is not only caught but classified:
+        the report's by_class names a constraint family matching the
+        corruption (no 'caught for the wrong reason' false confidence)."""
+        expect = {
+            "invalid-node": {"invalid-node"},
+            "overcommit": {"overcommit"},
+            "affinity-break": {"affinity"},
+            "anti-affinity-break": {"anti-affinity"},
+            "spread-break": {"spread"},
+            # stacking two port-holders on one node may also trip
+            # overcommit; the port class must still be among the findings
+            "port-conflict": {"port-conflict"},
+        }
+        cluster, apps = _mutation_fixture(0, 12)
+        pc = place_cluster(cluster, apps, bulk=False)
+        ext = extras_from_log(pc)
+        rng = np.random.default_rng(0)
+        for kind, classes in expect.items():
+            mut = _mutate_nodes(kind, pc.tensors, pc.batch, pc.nodes, rng)
+            assert mut is not None, f"fixture lacks a {kind} target"
+            rep = audit_placement(pc.tensors, pc.batch, mut, ext)
+            assert not rep.ok
+            assert classes & set(rep.by_class), (kind, rep.by_class)
+
+    def test_violations_carry_witnesses(self):
+        cluster, apps = _mutation_fixture(0, 12)
+        pc = place_cluster(cluster, apps, bulk=False)
+        mut = _mutate_nodes(
+            "overcommit", pc.tensors, pc.batch, pc.nodes,
+            np.random.default_rng(0),
+        )
+        rep = audit_placement(
+            pc.tensors, pc.batch, mut, extras_from_log(pc)
+        )
+        over = [v for v in rep.violations if v.kind == "overcommit"]
+        assert over
+        v = over[0]
+        assert v.pod and v.node_name
+        assert v.witness["request"] > v.witness["free_at_step"]
+        doc = rep.counters()
+        assert doc["detail"][0]["class"]
+        assert doc["detail"][0]["witness"]
+
+
+class TestCompleteness:
+    def test_require_all_flags_stranded_rows(self):
+        # one tiny node, far more pods than fit: the engine strands some
+        cluster = synth_cluster(1, seed=0, zones=1)
+        apps = [
+            AppResource(
+                name="big",
+                resource=ResourceTypes(
+                    deployments=[
+                        make_fake_deployment("huge", "default", 40, "2", "4Gi")
+                    ]
+                ),
+            )
+        ]
+        pc = place_cluster(cluster, apps, bulk=False)
+        stranded = int((pc.nodes < 0).sum())
+        assert stranded > 0
+        rep = audit_placement(
+            pc.tensors, pc.batch, pc.nodes, extras_from_log(pc),
+            require_all=True,
+        )
+        assert not rep.ok
+        assert rep.by_class.get("unplaced") == stranded
+        # without the all-or-nothing claim the same placement is clean
+        rep2 = audit_placement(
+            pc.tensors, pc.batch, pc.nodes, extras_from_log(pc)
+        )
+        assert rep2.ok
+
+
+class TestPlannerFallback:
+    """SIMTPU_AUDIT_INJECT corrupts the audit's view of the primary
+    engine's answer: every planner must catch it, re-place through the
+    serial exact scan, ship the certified answer, and report the
+    divergence."""
+
+    def _assert_fallback_doc(self, doc):
+        assert doc["fallback"] is True
+        assert doc["violations"] >= 1
+        assert doc["fallback_audit"]["ok"] is True
+        assert doc["ok"] is True  # the SHIPPED answer is certified
+        div = doc["divergence"]
+        assert div["violations"]
+        # the injection corrupts only the audit's VIEW — the primary and
+        # fallback engines' real logs agree, so the state-plane witness
+        # is rightly empty here (TestDivergenceDiagnostic pins the
+        # non-empty case)
+        assert div.get("state_planes", []) == []
+
+    def test_serial_planner_ships_certified_fallback(self, monkeypatch):
+        monkeypatch.setenv("SIMTPU_AUDIT_INJECT", "1")
+        cluster, apps, template = _small_plan_problem()
+        plan = plan_capacity(cluster, apps, template, 8)
+        assert plan.success
+        assert not plan.result.unscheduled_pods
+        self._assert_fallback_doc(plan.audit)
+
+    def test_incremental_planner_ships_certified_fallback(self, monkeypatch):
+        monkeypatch.setenv("SIMTPU_AUDIT_INJECT", "1")
+        cluster, apps, template = _small_plan_problem()
+        plan = plan_capacity_incremental(cluster, apps, template, 8)
+        assert plan.success
+        self._assert_fallback_doc(plan.audit)
+        assert plan.audit["divergence"]["first_divergent_row"] >= 0
+
+    def test_incremental_matches_uninjected_plan(self, monkeypatch):
+        cluster, apps, template = _small_plan_problem()
+        clean = plan_capacity_incremental(cluster, apps, template, 8)
+        monkeypatch.setenv("SIMTPU_AUDIT_INJECT", "1")
+        fb = plan_capacity_incremental(cluster, apps, template, 8)
+        # the fallback's serial-exact answer IS the uninterrupted answer
+        assert fb.nodes_added == clean.nodes_added
+        assert clean.audit["ok"] and "fallback" not in clean.audit
+
+    def test_resilience_planner_ships_certified_fallback(self, monkeypatch):
+        monkeypatch.setenv("SIMTPU_AUDIT_INJECT", "1")
+        cluster, apps, template = _small_plan_problem()
+        plan = plan_resilience(
+            cluster, apps, template, k=1, max_new_nodes=10
+        )
+        assert plan.success
+        self._assert_fallback_doc(plan.audit)
+        # the survivability verdict describes the CERTIFIED placement:
+        # the winner's sweep re-ran over the fallback
+        assert plan.sweep is not None
+
+    def test_resumed_resilience_plan_still_audited(self, tmp_path):
+        """A checkpoint-resumed winner replays its sweep verdict from the
+        record, but the AUDIT must still run over a live placement — the
+        finish() re-probe refreshes the audit artifacts."""
+        from simtpu.durable import PlanCheckpoint, plan_fingerprint
+
+        cluster, apps, template = _small_plan_problem()
+        fp = plan_fingerprint(cluster, apps, template, extra={"k": 1})
+        ck = PlanCheckpoint(
+            str(tmp_path / "ck"), kind="resilience", fingerprint=fp
+        )
+        p1 = plan_resilience(
+            cluster, apps, template, k=1, max_new_nodes=10, checkpoint=ck
+        )
+        ck2 = PlanCheckpoint(
+            str(tmp_path / "ck"), kind="resilience", fingerprint=fp,
+            resume=True,
+        )
+        p2 = plan_resilience(
+            cluster, apps, template, k=1, max_new_nodes=10, checkpoint=ck2
+        )
+        assert (p2.success, p2.nodes_added) == (p1.success, p1.nodes_added)
+        assert p2.audit.get("ok") is True
+        assert p2.audit["checked"] > 0
+
+    def test_audit_false_opts_out(self):
+        cluster, apps, template = _small_plan_problem()
+        for plan in (
+            plan_capacity(cluster, apps, template, 8, audit=False),
+            plan_capacity_incremental(cluster, apps, template, 8, audit=False),
+            plan_resilience(
+                cluster, apps, template, k=1, max_new_nodes=10, audit=False
+            ),
+        ):
+            assert plan.success
+            assert plan.audit == {}
+
+    def test_clean_audit_doc_rides_every_planner(self):
+        cluster, apps, template = _small_plan_problem()
+        for plan in (
+            plan_capacity(cluster, apps, template, 8),
+            plan_capacity_incremental(cluster, apps, template, 8),
+            plan_resilience(cluster, apps, template, k=1, max_new_nodes=10),
+        ):
+            assert plan.success
+            assert plan.audit["ok"] is True
+            assert plan.audit["violations"] == 0
+            assert plan.audit["checked"] > 0
+
+
+class TestDivergenceDiagnostic:
+    def test_diff_state_planes_names_differing_planes(self):
+        from simtpu.engine.state import build_state, diff_state_planes
+
+        cluster, apps, _ = gen_case(0, n_nodes=8, n_pods=24)
+        pc = place_cluster(cluster, apps, bulk=False)
+        eng = pc.engine
+        r = pc.tensors.alloc.shape[1]
+        groups = np.asarray(eng.placed_group, np.int32)
+        nodes = np.asarray(eng.placed_node, np.int32)
+        req = eng.log_req_matrix(r)
+        a = build_state(pc.tensors, groups, nodes, req, eng.ext_log)
+        assert diff_state_planes(a, a) == []
+        moved = nodes.copy()
+        moved[0] = (moved[0] + 1) % pc.n_nodes
+        b = build_state(pc.tensors, groups, moved, req, eng.ext_log)
+        diff = diff_state_planes(a, b)
+        assert diff, "moving a pod must perturb at least one carried plane"
+        assert any(p.startswith("free") for p in diff), diff
+
+    def test_divergence_diagnostic_names_first_divergent_pod(self):
+        cluster, apps, _ = gen_case(0, n_nodes=8, n_pods=24)
+        pc = place_cluster(cluster, apps, bulk=False)
+        bad = inject_divergence(pc.tensors, pc.batch, pc.nodes)
+        rep = audit_placement(
+            pc.tensors, pc.batch, bad, extras_from_log(pc)
+        )
+        doc = divergence_diagnostic(
+            pc.tensors, pc.batch, bad, pc.nodes, rep, planes=["free"]
+        )
+        first = doc["first_divergent_row"]
+        assert first >= 0
+        assert doc["divergent_pods"] >= 1
+        assert doc["audited_node"] != doc["serial_node"]
+        assert doc["state_planes"] == ["free"]
+
+
+class TestCLI:
+    @pytest.fixture(autouse=True)
+    def _chdir_repo(self, monkeypatch):
+        monkeypatch.chdir(REPO)
+
+    def test_apply_json_audit_clean_exit_0(self, capsys):
+        from simtpu.cli import main
+
+        rc = main(["apply", "-f", "examples/simtpu-config.yaml", "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        audit = doc["engine"]["audit"]
+        assert audit["ok"] is True and audit["violations"] == 0
+
+    @pytest.mark.parametrize(
+        "config,extended",
+        [
+            ("examples/simtpu-gpushare-config.yaml", ["-e", "gpu"]),
+            ("examples/simtpu-storage-config.yaml", ["-e", "open-local"]),
+        ],
+    )
+    def test_every_example_audits_clean(self, config, extended, capsys):
+        from simtpu.cli import main
+
+        rc = main(["apply", "-f", config, "--json", *extended])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["engine"]["audit"]["ok"] is True
+
+    def test_no_audit_flag(self, capsys):
+        from simtpu.cli import main
+
+        rc = main([
+            "apply", "-f", "examples/simtpu-config.yaml", "--json",
+            "--no-audit",
+        ])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["engine"]["audit"] == {"enabled": False}
+
+    def test_injected_divergence_exit_4_with_diagnostic(
+        self, monkeypatch, capsys
+    ):
+        from simtpu.cli import EXIT_AUDIT, main
+
+        monkeypatch.setenv("SIMTPU_AUDIT_INJECT", "1")
+        rc = main(["apply", "-f", "examples/simtpu-config.yaml", "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == EXIT_AUDIT == 4
+        # the SHIPPED plan is the serial-exact fallback's certified one
+        assert doc["success"] is True
+        assert doc["unscheduled"] == 0
+        audit = doc["engine"]["audit"]
+        assert audit["fallback"] is True
+        assert audit["fallback_audit"]["ok"] is True
+        assert audit["divergence"]["violations"]
+        assert audit["detail"], "witnessed violations ride the doc"
+
+    def test_injected_divergence_table_mode(self, monkeypatch, capsys):
+        from simtpu.cli import EXIT_AUDIT, main
+
+        monkeypatch.setenv("SIMTPU_AUDIT_INJECT", "1")
+        rc = main(["apply", "-f", "examples/simtpu-config.yaml"])
+        out = capsys.readouterr().out
+        assert rc == EXIT_AUDIT
+        assert "PRIMARY ENGINE DIVERGED" in out
+        assert "serial-exact fallback certified" in out
+
+    def test_faults_sweep_hard_audit_failure_exit_4(
+        self, monkeypatch, capsys
+    ):
+        """When neither the --faults sweep's base placement nor the
+        serial-exact fallback certifies, the plan stays but the exit code
+        is EXIT_AUDIT and the audit doc rides resilience.audit — never a
+        silent exit 0 with the diagnostics lost."""
+        import simtpu.audit.checker as checker
+        from simtpu.cli import EXIT_AUDIT, main
+
+        doc_in = {"ok": False, "violations": 1, "by_class": {"overcommit": 1}}
+
+        def fake(pc, progress=None, inject=False):
+            return pc, doc_in, "audit failure: nothing certified"
+
+        monkeypatch.setattr(checker, "audit_placed_cluster", fake)
+        rc = main([
+            "apply", "-f", "examples/simtpu-config.yaml", "--json",
+            "--faults", "k=1",
+        ])
+        out = capsys.readouterr()
+        doc = json.loads(out.out)
+        assert rc == EXIT_AUDIT
+        assert doc["success"] is True  # the plan itself stands
+        assert "nothing certified" in doc["resilience"]["error"]
+        assert doc["resilience"]["audit"] == doc_in
+
+    def test_resilience_assessment_audit_rides_json(self, capsys):
+        from simtpu.cli import main
+
+        main(["resilience", "-f", "examples/simtpu-config.yaml", "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["audit"]["ok"] is True
+
+    def test_fuzz_mutation_kill_cli(self, capsys):
+        from simtpu.cli import main
+
+        rc = main(["fuzz", "--mutation-kill", "--per-class", "1", "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["ok"] is True and doc["kill_rate"] == 1.0
+
+
+class TestFuzzHarness:
+    def test_differential_clean_on_seed_corpus(self):
+        result = run_differential(
+            cases=1, seed=0, n_nodes=10, n_pods=32, include_shard=False
+        )
+        assert result.ok
+        assert result.audits_clean == result.configs_run
+
+    def test_engine_config_matrix_shape(self):
+        cells = engine_configs(include_shard=True)
+        names = {c["name"] for c in cells}
+        assert {
+            "wavefront", "compact", "wavefront+compact", "sharded",
+            "oom-backoff",
+        } <= names
+
+    def test_reproducer_roundtrip(self, tmp_path):
+        cluster, apps, _ = gen_case(0, n_nodes=8, n_pods=24)
+        path = write_reproducer(cluster, apps, str(tmp_path / "repro.yaml"))
+        r_cluster, r_apps = load_reproducer(path)
+        assert len(r_cluster.nodes) == len(cluster.nodes)
+        n_work = len(apps[0].resource.deployments)
+        assert len(r_apps[0].resource.deployments) == n_work
+        # the reloaded case places and audits exactly like the original
+        bad = _check_case(r_cluster, r_apps, [])
+        assert bad is None
+
+    def test_shrink_minimizes_while_failing(self):
+        cluster, apps, _ = gen_case(0, n_nodes=16, n_pods=64)
+        n_deps = len(apps[0].resource.deployments)
+
+        def always_fails(cl, ap, cells):
+            return True  # everything "reproduces": shrink to the floor
+
+        s_cluster, s_apps = _shrink(cluster, apps, [], always_fails)
+        assert len(s_apps[0].resource.deployments) < n_deps
+        assert len(s_cluster.nodes) <= max(2, len(cluster.nodes) // 2)
+        assert len(s_apps[0].resource.deployments) >= 1
+        assert all(
+            d["spec"]["replicas"] >= 1
+            for d in s_apps[0].resource.deployments
+        )

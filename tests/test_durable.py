@@ -515,6 +515,234 @@ class TestCLIDurable:
         assert "Traceback" not in err
 
 
+class TestCheckpointErrors:
+    """Unusable checkpoint paths/records fail UP FRONT as one actionable
+    `CheckpointError` line — never a mid-plan OSError/zipfile traceback
+    (ISSUE 7 satellite)."""
+
+    def test_checkpoint_path_is_a_file_refuses(self, tmp_path):
+        from simtpu.durable import CheckpointError
+
+        f = tmp_path / "not-a-dir"
+        f.write_text("x")
+        with pytest.raises(CheckpointError, match="not a directory"):
+            PlanCheckpoint(str(f), kind="binary", fingerprint="fp")
+
+    def test_checkpoint_path_uncreatable_refuses(self):
+        from simtpu.durable import CheckpointError
+
+        with pytest.raises(CheckpointError, match="cannot create"):
+            PlanCheckpoint(
+                "/dev/null/sub", kind="binary", fingerprint="fp"
+            )
+
+    def test_resume_empty_manifest_refuses(self, tmp_path):
+        from simtpu.durable import CheckpointError
+
+        ck = tmp_path / "ck"
+        ck.mkdir()
+        (ck / "manifest.json").write_text("")
+        with pytest.raises(CheckpointError, match="empty or corrupt"):
+            PlanCheckpoint(
+                str(ck), kind="binary", fingerprint="fp", resume=True
+            )
+        # the message is one line, actionable
+        try:
+            PlanCheckpoint(
+                str(ck), kind="binary", fingerprint="fp", resume=True
+            )
+        except CheckpointError as exc:
+            assert "\n" not in str(exc)
+            assert "re-run" in str(exc)
+
+    def test_resume_corrupt_record_refuses(self, tmp_path):
+        from simtpu.durable import CheckpointError
+
+        ck = tmp_path / "ck"
+        wr = PlanCheckpoint(str(ck), kind="binary", fingerprint="fp")
+        wr.put("cand", 0, verdict=np.asarray(1))
+        # truncate the record to garbage
+        rec = ck / "rec_cand_0.npz"
+        rec.write_bytes(b"not a zip")
+        rd = PlanCheckpoint(
+            str(ck), kind="binary", fingerprint="fp", resume=True
+        )
+        with pytest.raises(CheckpointError, match="empty or corrupt"):
+            rd.get("cand", 0)
+
+    def test_cli_checkpoint_file_path_one_line(self, tmp_path, capsys):
+        from simtpu.cli import main
+
+        f = tmp_path / "not-a-dir"
+        f.write_text("x")
+        rc = main([
+            "apply", "-f", "examples/simtpu-config.yaml",
+            "--checkpoint", str(f),
+        ])
+        err = capsys.readouterr().err
+        assert rc == 1
+        assert "not a directory" in err
+        assert "Traceback" not in err
+
+
+class TestFingerprintStrictness:
+    """`plan_resilience --resume` with a changed fault model must refuse:
+    the sweep verdict records are a function of --fault-seed /
+    --fault-samples, so replaying them under different sampling would
+    certify a DIFFERENT failure model (ISSUE 7 satellite).  The CLI pins
+    spec/samples/seed/quantile into the fingerprint `extra`; these tests
+    mirror that construction."""
+
+    def _fp(self, samples, seed, spec="k=1", quantile=1.0):
+        cluster, apps, template = _small_problem()
+        return plan_fingerprint(
+            cluster, apps, template,
+            extra={
+                "spec": spec,
+                "quantile": quantile,
+                "samples": samples,
+                "seed": seed,
+                "max_new_nodes": 8,
+                "extended_resources": [],
+                "sched_config": "",
+            },
+        )
+
+    def test_changed_fault_seed_refuses(self, tmp_path):
+        ck = tmp_path / "ck"
+        PlanCheckpoint(
+            str(ck), kind="resilience", fingerprint=self._fp(256, 0)
+        )
+        with pytest.raises(CheckpointMismatch, match="fingerprint"):
+            PlanCheckpoint(
+                str(ck), kind="resilience",
+                fingerprint=self._fp(256, 1), resume=True,
+            )
+
+    def test_changed_fault_samples_refuses(self, tmp_path):
+        ck = tmp_path / "ck"
+        PlanCheckpoint(
+            str(ck), kind="resilience", fingerprint=self._fp(256, 0)
+        )
+        with pytest.raises(CheckpointMismatch, match="fingerprint"):
+            PlanCheckpoint(
+                str(ck), kind="resilience",
+                fingerprint=self._fp(500, 0), resume=True,
+            )
+
+    def test_same_fault_model_resumes(self, tmp_path):
+        ck = tmp_path / "ck"
+        PlanCheckpoint(
+            str(ck), kind="resilience", fingerprint=self._fp(256, 0)
+        )
+        PlanCheckpoint(
+            str(ck), kind="resilience",
+            fingerprint=self._fp(256, 0), resume=True,
+        )
+
+    def test_cli_resilience_changed_seed_refuses(self, tmp_path, capsys):
+        """End-to-end: the resilience CLI's fingerprint really carries the
+        fault model — a --resume with a different --seed refuses."""
+        from simtpu.cli import main
+
+        ck = tmp_path / "ck"
+        args = [
+            "resilience", "-f", "examples/simtpu-config.yaml", "--plan",
+            "--max-new-nodes", "2", "--checkpoint", str(ck),
+        ]
+        main(args)  # survivable or not, records + manifest land
+        rc = main(args + ["--resume", "--seed", "7"])
+        err = capsys.readouterr().err
+        assert rc == 1
+        assert "refusing to resume" in err
+        assert "Traceback" not in err
+
+
+class TestDuplicateNames:
+    """Duplicate workload names within one ingest are a validate-time
+    `SpecError` naming BOTH source files; random-suffix collisions on
+    GENERATED pod names re-draw deterministically instead of rejecting
+    (a birthday certainty at million-pod scale, not a user error)
+    (ISSUE 7 satellite)."""
+
+    def test_duplicate_deployments_name_both_files(self):
+        from simtpu.workloads.expand import (
+            SOURCE_KEY,
+            get_valid_pods_exclude_daemonset,
+        )
+        from simtpu.workloads.validate import SpecError
+
+        res = ResourceTypes()
+        d1 = make_fake_deployment("foo", "default", 2, "1", "1Gi")
+        d1[SOURCE_KEY] = "apps/a.yaml"
+        d2 = make_fake_deployment("foo", "default", 3, "1", "1Gi")
+        d2[SOURCE_KEY] = "apps/b.yaml"
+        res.deployments = [d1, d2]
+        with pytest.raises(SpecError) as ei:
+            get_valid_pods_exclude_daemonset(res)
+        msg = str(ei.value)
+        assert "apps/a.yaml" in msg and "apps/b.yaml" in msg
+        assert "duplicate Deployment" in msg
+        assert "\n" not in msg
+
+    def test_duplicate_bare_pods_refused(self):
+        from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
+        from simtpu.workloads.validate import SpecError
+
+        from .fixtures import make_fake_pod
+
+        res = ResourceTypes()
+        res.pods = [
+            make_fake_pod("p", "default", "1", "1Gi"),
+            make_fake_pod("p", "default", "1", "1Gi"),
+        ]
+        with pytest.raises(SpecError, match="duplicate Pod"):
+            get_valid_pods_exclude_daemonset(res)
+
+    def test_sts_ordinal_collision_refused_not_redrawn(self):
+        """STS ordinal pods CARRY metadata.generateName but are named
+        `{name}-{ordinal}` deterministically — a collision with one is a
+        spec bug to refuse, never a silent re-draw (renaming would break
+        the ordinal identity its volume claims were computed against)."""
+        from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
+        from simtpu.workloads.validate import SpecError
+
+        from .fixtures import make_fake_pod, make_fake_stateful_set
+
+        res = ResourceTypes()
+        res.pods = [make_fake_pod("web-0", "default", "1", "1Gi")]
+        res.stateful_sets = [
+            make_fake_stateful_set("web", "default", 1, "1", "1Gi")
+        ]
+        with pytest.raises(SpecError, match="pod name collides"):
+            get_valid_pods_exclude_daemonset(res)
+
+    def test_generated_collision_redraws_unique(self, monkeypatch):
+        from simtpu.workloads import expand
+
+        # force the pod-suffix stream to collide: the first two 5-digit
+        # draws are identical, then unique — the expander must re-draw
+        # the second pod's name rather than raise or shadow
+        draws = iter(["aaaaa", "aaaaa", "bbbbb", "ccccc", "ddddd"])
+
+        def fake_suffix(digits):
+            if digits == expand.C.POD_HASH_DIGITS:
+                return next(draws)
+            return "f" * digits  # workload suffix: one per deployment
+
+        monkeypatch.setattr(expand, "_hash_suffix", fake_suffix)
+        res = ResourceTypes()
+        res.deployments = [
+            make_fake_deployment("web", "default", 3, "1", "1Gi")
+        ]
+        pods = expand.get_valid_pods_exclude_daemonset(res)
+        names = [p["metadata"]["name"] for p in pods]
+        assert len(names) == len(set(names)) == 3
+        assert sorted(n.rsplit("-", 1)[1] for n in names) == [
+            "aaaaa", "bbbbb", "ccccc"
+        ]
+
+
 class TestSpecDiagnostics:
     def test_bad_quantity_reports_field_path(self):
         from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
