@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all install lint test test-all test-perf bench bench-cold bench-faults bench-layout bench-durable bench-audit fuzz-smoke clean
+.PHONY: all install lint test test-all test-perf bench bench-cold bench-faults bench-layout bench-durable bench-audit bench-obs fuzz-smoke clean
 
 all: test
 
@@ -102,6 +102,22 @@ bench-audit:
 	SIMTPU_BENCH_SMALL=0 SIMTPU_BENCH_HARD=0 SIMTPU_BENCH_MATRIX=0 \
 	SIMTPU_BENCH_PLAN=0 SIMTPU_BENCH_BIG=0 SIMTPU_BENCH_FAULTS=0 \
 	SIMTPU_BENCH_LAYOUT=0 SIMTPU_BENCH_DURABLE=0 $(PY) bench.py
+
+# observability overhead gate (mirrors bench-audit): the same warm bulk
+# placement with the span tracer off vs on, ASSERTING < 3% tracing-on
+# overhead, zero-overhead no-op spans when disabled, bit-identical
+# placements, and a Perfetto-valid exported trace file —
+# obs_overhead_pct / obs_spans / obs_trace_valid land in the JSON line
+# (CI runs this alongside the fast tier)
+bench-obs:
+	SIMTPU_BENCH_OBS=1 SIMTPU_BENCH_OBS_ASSERT=1 \
+	SIMTPU_BENCH_OBS_NODES=2000 SIMTPU_BENCH_OBS_PODS=20000 \
+	SIMTPU_BENCH_NODES=500 SIMTPU_BENCH_PODS=2000 \
+	SIMTPU_BENCH_SCAN_PODS=200 SIMTPU_BENCH_BASELINE_PODS=50 \
+	SIMTPU_BENCH_SMALL=0 SIMTPU_BENCH_HARD=0 SIMTPU_BENCH_MATRIX=0 \
+	SIMTPU_BENCH_PLAN=0 SIMTPU_BENCH_BIG=0 SIMTPU_BENCH_FAULTS=0 \
+	SIMTPU_BENCH_LAYOUT=0 SIMTPU_BENCH_DURABLE=0 SIMTPU_BENCH_AUDIT=0 \
+	$(PY) bench.py
 
 # differential fuzz over the fixed seed corpus at small shapes, across
 # the FULL engine-config matrix — 8 forced host devices arm the

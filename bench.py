@@ -265,7 +265,7 @@ def time_bulk(tensors, batch, precompile: bool = False):
     the parallel-compile overlap) and the final run's blocking-fetch count
     (`fetches`, one per device→host round-trip)."""
     from simtpu.engine.rounds import RoundsEngine
-    from simtpu.engine.scan import fetch_counts
+    from simtpu.obs.metrics import REGISTRY
 
     class _TZ:
         def freeze(self):
@@ -288,12 +288,12 @@ def time_bulk(tensors, batch, precompile: bool = False):
             # path's own cache, so a pipeline-less rerun would recompile
             eng.pipeline = pipe
         t_dispatch = time.perf_counter()
-        f0 = fetch_counts()
+        f0 = REGISTRY.snapshot("fetch.")
         nodes, reasons, _ = eng.place(batch)
         run_s = time.perf_counter() - t0
-        f1 = fetch_counts()
-        extra["fetches"] = f1["get"] - f0["get"]
-        extra["fetch_bytes"] = f1["bytes"] - f0["bytes"]
+        f1 = REGISTRY.snapshot("fetch.")
+        extra["fetches"] = f1["fetch.get"] - f0["fetch.get"]
+        extra["fetch_bytes"] = f1["fetch.bytes"] - f0["fetch.bytes"]
         note(f"bulk run {i}: {run_s:.1f}s")
         if cold is None:
             cold = run_s
@@ -357,8 +357,12 @@ def big_point() -> dict:
 def device_peak_bytes():
     """Accelerator peak-memory high-water (jax memory_stats), None on
     backends that publish none (CPU) — the on-device half of the byte
-    telemetry next to `state_bytes` and `fetch_bytes`."""
+    telemetry next to `state_bytes` and `fetch_bytes`.  Sampled onto the
+    metrics registry (`device.peak_bytes` gauge, ISSUE 8) so the
+    registry snapshot every BENCH point records carries it too."""
     import jax
+
+    from simtpu.obs.metrics import REGISTRY
 
     try:
         stats = jax.devices()[0].memory_stats()
@@ -366,7 +370,10 @@ def device_peak_bytes():
         return None
     if not stats:
         return None
-    return stats.get("peak_bytes_in_use")
+    peak = stats.get("peak_bytes_in_use")
+    if peak is not None:
+        REGISTRY.gauge("device.peak_bytes").set(int(peak))
+    return peak
 
 
 def layout_point() -> dict:
@@ -384,7 +391,7 @@ def layout_point() -> dict:
     run unless the carry shrank >= 2x."""
     from simtpu.core.tensorize import Tensorizer
     from simtpu.engine.rounds import RoundsEngine
-    from simtpu.engine.state import state_gauge
+    from simtpu.obs.metrics import REGISTRY
     from simtpu.synth import synth_apps, synth_cluster
     from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
 
@@ -421,7 +428,11 @@ def layout_point() -> dict:
             t0 = time.perf_counter()
             nodes, _, _ = eng.place(batch)
             best = min(best, time.perf_counter() - t0)
-            gauge = state_gauge()
+            # registry-backed carried-state gauge (obs/metrics.py)
+            gauge = {
+                k.split(".", 1)[1]: v
+                for k, v in REGISTRY.snapshot("state.").items()
+            }
         return best, nodes, gauge
 
     compact_s, compact_nodes, g = run(True)
@@ -453,6 +464,118 @@ def layout_point() -> dict:
         "state_compact_ratio": round(ratio, 2),
         "layout_compact_s": round(compact_s, 2),
         "layout_dense_s": round(dense_s, 2),
+    }
+
+
+def obs_point() -> dict:
+    """Observability overhead gate (ISSUE 8, docs/observability.md): the
+    same warm bulk placement timed three ways — tracer disabled (the
+    no-op baseline), tracer armed (ring-buffer spans recording), and a
+    no-op sanity check that disabled spans record nothing and allocate
+    no span objects.  The tracing-on wall must stay within 3% of the
+    baseline (`SIMTPU_BENCH_OBS_ASSERT=1`, the `make bench-obs` smoke,
+    fails the run otherwise), and the exported Chrome trace must be
+    Perfetto-valid JSON (traceEvents with name/ph/ts/pid/tid on every
+    entry).  Env: SIMTPU_BENCH_OBS_NODES / SIMTPU_BENCH_OBS_PODS
+    (default 2000 x 20000 — big enough that per-dispatch work dominates
+    the span bookkeeping, the regime the <3% bound is about)."""
+    import tempfile
+
+    from simtpu.core.tensorize import Tensorizer
+    from simtpu.engine.rounds import RoundsEngine
+    from simtpu.obs import trace as obs_trace
+    from simtpu.synth import synth_apps, synth_cluster
+    from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
+
+    n_nodes = int(os.environ.get("SIMTPU_BENCH_OBS_NODES", 2_000))
+    n_pods = int(os.environ.get("SIMTPU_BENCH_OBS_PODS", 20_000))
+    note(f"obs point: {n_nodes} nodes x {n_pods} pods, tracing on/off A/B")
+    cluster = synth_cluster(n_nodes, seed=31, zones=8, taint_frac=0.1)
+    apps = synth_apps(
+        n_pods, seed=32, zones=8, pods_per_deployment=200,
+        selector_frac=0.2, anti_affinity_frac=0.1, spread_frac=0.3,
+    )
+    pods = []
+    for app in apps:
+        pods.extend(get_valid_pods_exclude_daemonset(app.resource))
+
+    was_enabled = obs_trace.enabled()
+
+    def run(tracing: bool):
+        """Best-of-3 warm walls under the given tracer state (fresh
+        engine per run, the steady-state protocol every smoke uses)."""
+        if tracing:
+            obs_trace.enable()
+        else:
+            obs_trace.disable()
+        best, nodes = float("inf"), None
+        for _ in range(3):
+            tz = Tensorizer(
+                cluster.nodes, storage_classes=cluster.storage_classes
+            )
+            eng = RoundsEngine(tz)
+            batch = tz.add_pods(pods)
+            t0 = time.perf_counter()
+            nodes, _, _ = eng.place(batch)
+            best = min(best, time.perf_counter() - t0)
+        return best, nodes
+
+    # no-op contract first: with the tracer off, span() returns ONE
+    # shared singleton (no per-span object) and records nothing
+    obs_trace.disable()
+    assert obs_trace.span("a") is obs_trace.span("b"), (
+        "disabled span() must return the shared no-op singleton"
+    )
+    with obs_trace.span("noop", pods=1):
+        pass
+    assert obs_trace.events() == [], "disabled tracer recorded an event"
+
+    # one untimed warmup first: the A/B must compare steady-state walls,
+    # not charge the off-series with the first-run XLA compiles
+    run(False)
+    off_s, off_nodes = run(False)
+    on_s, on_nodes = run(True)
+    span_count = len(obs_trace.events())
+    overhead = on_s / max(off_s, 1e-9) - 1.0
+    note(
+        f"obs: warm wall {off_s:.2f}s off vs {on_s:.2f}s on "
+        f"({overhead * 100:+.2f}%), {span_count} spans buffered"
+    )
+
+    # trace-file validation: exported JSON must be loadable and carry the
+    # Chrome trace-event required keys on every entry
+    with tempfile.TemporaryDirectory() as td:
+        path = obs_trace.export_trace(os.path.join(td, "bench-obs.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        events = doc["traceEvents"]
+        assert events, "exported trace has no events"
+        for ev in events:
+            for key in ("name", "ph", "pid", "tid"):
+                assert key in ev, f"trace event missing {key!r}: {ev}"
+            if ev["ph"] == "X":
+                assert "ts" in ev and "dur" in ev, ev
+        trace_valid = True
+    identical = bool(np.array_equal(np.asarray(off_nodes), np.asarray(on_nodes)))
+    if not identical:
+        note("WARNING: placements diverged under tracing (must be impossible)")
+    if not was_enabled:
+        obs_trace.disable()
+    if os.environ.get("SIMTPU_BENCH_OBS_ASSERT", "0") == "1":
+        assert identical, "tracing changed placements"
+        assert span_count > 0, "tracing-on run recorded no spans"
+        assert overhead < 0.03, (
+            f"span tracing added {overhead * 100:.2f}% to the warm wall "
+            "(>= 3% bound, docs/observability.md)"
+        )
+    return {
+        "obs_nodes": n_nodes,
+        "obs_off_s": round(off_s, 3),
+        "obs_on_s": round(on_s, 3),
+        "obs_overhead_pct": round(overhead * 100, 2),
+        "obs_spans": span_count,
+        "obs_trace_valid": trace_valid,
+        "obs_identical": identical,
     }
 
 
@@ -945,8 +1068,15 @@ def main() -> int:
         tensorize_s,
     ) = build_problem(n_nodes, n_pods)
 
-    from simtpu.engine.scan import flags_from, wave_counts
-    from simtpu.engine.state import state_gauge as _state_gauge
+    from simtpu.engine.scan import flags_from
+    from simtpu.obs.metrics import REGISTRY
+
+    def wave_counts():
+        # registry-backed speculation counters (obs/metrics.py)
+        return {
+            k.split(".", 1)[1]: v
+            for k, v in REGISTRY.snapshot("wavefront.").items()
+        }
 
     precompile = _bench_precompile()
     note("problem built; timing scan slice (pod-at-a-time floor)")
@@ -1039,8 +1169,8 @@ def main() -> int:
         # and its per-plane gauge, and the accelerator's peak residency
         # (None on CPU backends, which publish no memory_stats)
         "fetch_bytes": cold_extra.get("fetch_bytes"),
-        "compact": _state_gauge()["compact"],
-        "engine_state_bytes": _state_gauge()["carried_bytes"],
+        "compact": REGISTRY.value("state.compact", default=False),
+        "engine_state_bytes": REGISTRY.value("state.carried_bytes"),
         "device_peak_bytes": device_peak_bytes(),
         "compilation_cache": bool(cache_dir),
         # exact-scan throughput: the pod-at-a-time floor vs the speculative
@@ -1118,14 +1248,25 @@ def main() -> int:
         except Exception as exc:  # noqa: BLE001 - report, keep the line
             note(f"audit point failed: {type(exc).__name__}: {exc}")
             record["audit_error"] = f"{type(exc).__name__}: {exc}"
+    # observability overhead gate (ISSUE 8): on by default at north-star
+    # runs, SIMTPU_BENCH_OBS=1 forces it at any configuration (`make
+    # bench-obs` = the small-shape asserting smoke), =0 skips
+    obs_env = os.environ.get("SIMTPU_BENCH_OBS", "")
+    if obs_env != "0" and (north_star or obs_env == "1"):
+        try:
+            record.update(obs_point())
+        except Exception as exc:  # noqa: BLE001 - report, keep the line
+            note(f"obs point failed: {type(exc).__name__}: {exc}")
+            record["obs_error"] = f"{type(exc).__name__}: {exc}"
     # OOM-backoff telemetry (durable/backoff.py): process-lifetime
     # counters — nonzero only when a dispatch really hit
     # RESOURCE_EXHAUSTED (or the durable point injected one)
-    from simtpu.durable import backoff_counts as _backoff_counts
-
-    bc = _backoff_counts()
-    record["backoff_events"] = bc["events"]
-    record["backoff_chunk_min"] = bc["chunk_min"]
+    record["backoff_events"] = REGISTRY.value("backoff.events")
+    record["backoff_chunk_min"] = REGISTRY.value("backoff.chunk_min")
+    # the full registry snapshot rides every point (ISSUE 8): the perf
+    # trajectory's BENCH_*.json lines carry the unified metrics alongside
+    # the derived headline numbers above
+    record["metrics"] = REGISTRY.snapshot()
     print(json.dumps(record))
     # a failed plan/big/fault/layout/durable phase keeps the placement
     # record but signals the failure through the exit status (drivers
@@ -1134,7 +1275,7 @@ def main() -> int:
         key in record
         for key in (
             "plan_error", "big_point_error", "fault_error", "layout_error",
-            "durable_error", "audit_error",
+            "durable_error", "audit_error", "obs_error",
         )
     ) else 0
 

@@ -15,6 +15,11 @@ from .core.objects import (
     SimulateResult,
     UnscheduledPod,
 )
+from .obs.trace import init_from_env as _obs_init_from_env
+
+# arm the span tracer when SIMTPU_TRACE asks for it (obs/trace.py; one
+# env read when tracing is off — spans stay shared no-ops)
+_obs_init_from_env()
 
 __version__ = "0.1.0"
 

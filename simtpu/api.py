@@ -48,6 +48,7 @@ from .engine.scan import (
     REASON_TEXT,
     Engine,
 )
+from .obs.trace import span
 
 # Failure classes where evicting lower-priority pods can help — the analog of
 # DefaultPreemption's PostFilter eligibility (static/affinity failures are
@@ -189,17 +190,19 @@ class Simulator:
         # them, `pkg/simulator/simulator.go:253-258`; app PDBs are never
         # created — GenerateValidPodsFromAppResources generates pods only)
         self._pdbs = [deep_copy(p) for p in cluster.pod_disruption_budgets]
-        self._tensorizer = Tensorizer(
-            self._nodes,
-            self._extra_resources,
-            storage_classes=self._storage_classes,
-            services=list(cluster.services),
-            pvcs=list(cluster.persistent_volume_claims),
-            pvs=list(cluster.persistent_volumes),
-        )
+        with span("tensorize", nodes=len(self._nodes)):
+            self._tensorizer = Tensorizer(
+                self._nodes,
+                self._extra_resources,
+                storage_classes=self._storage_classes,
+                services=list(cluster.services),
+                pvcs=list(cluster.persistent_volume_claims),
+                pvs=list(cluster.persistent_volumes),
+            )
         self._engine = self._engine_factory(self._tensorizer)
         self._engine.sched_config = self._sched_config
-        self._schedule_pods(cluster.pods)
+        with span("schedule.cluster", pods=len(cluster.pods)):
+            self._schedule_pods(cluster.pods)
         return self._result()
 
     def schedule_app(self, app: AppResource) -> SimulateResult:
@@ -211,13 +214,15 @@ class Simulator:
         (`GenerateValidPodsFromAppResources` generates pods only), so
         SelectorSpread intentionally counts against cluster services alone.
         """
-        pods = get_valid_pods_exclude_daemonset(app.resource)
-        for ds in app.resource.daemon_sets:
-            pods.extend(make_valid_pods_by_daemonset(ds, self._nodes))
-        for pod in pods:
-            set_label(pod, C.LABEL_APP_NAME, app.name)
-        pods = _sort_app_pods(pods, self._nodes, self._use_greed)
-        self._schedule_pods(pods)
+        with span("expand", app=app.name):
+            pods = get_valid_pods_exclude_daemonset(app.resource)
+            for ds in app.resource.daemon_sets:
+                pods.extend(make_valid_pods_by_daemonset(ds, self._nodes))
+            for pod in pods:
+                set_label(pod, C.LABEL_APP_NAME, app.name)
+            pods = _sort_app_pods(pods, self._nodes, self._use_greed)
+        with span("schedule.app", app=app.name, pods=len(pods)):
+            self._schedule_pods(pods)
         return self._result()
 
     def close(self) -> None:
@@ -1091,6 +1096,8 @@ def simulate(
     sched_config=None,
     precompile: bool = False,
     audit: bool = False,
+    trace: Optional[str] = None,
+    profile: Optional[str] = None,
     _audit_inject: bool = False,
 ) -> SimulateResult:
     """One-shot simulation (`pkg/simulator/core.go:64-103`): expand cluster
@@ -1117,13 +1124,27 @@ def simulate(
     legality — and attaches its `AuditReport` as `result.audit` before
     the simulator closes.  `_audit_inject` is the SIMTPU_AUDIT_INJECT
     test lever: it corrupts the audit's VIEW (never the result) so the
-    planners' divergence-fallback path can be driven end-to-end."""
+    planners' divergence-fallback path can be driven end-to-end.
+
+    Observability (ISSUE 8, docs/observability.md): `trace="t.json"`
+    arms the span tracer for this call and exports the Perfetto-loadable
+    Chrome trace to that path before returning (a tracer armed by the
+    caller — SIMTPU_TRACE, an enclosing Applier --trace — keeps its
+    buffer and export schedule; this kwarg only adds its own export);
+    `profile=DIR` wraps the whole simulation in a jax.profiler capture
+    with span-named TraceAnnotations."""
     if bulk:
         if engine_factory is not None:
             raise ValueError("bulk=True and engine_factory are mutually exclusive")
         from .engine.rounds import RoundsEngine
 
         engine_factory = RoundsEngine
+    from .obs import trace as obs_trace
+    from .obs.profile import profile_capture
+
+    own_trace = bool(trace) and not obs_trace.enabled()
+    if own_trace:
+        obs_trace.enable()
     sim = Simulator(
         extra_resources=extended_resources,
         engine_factory=engine_factory,
@@ -1132,18 +1153,30 @@ def simulate(
         precompile=precompile,
     )
     cluster = ResourceTypes(**{k: list(v) for k, v in vars(cluster).items()})
-    cluster_pods = get_valid_pods_exclude_daemonset(cluster)
-    for ds in cluster.daemon_sets:
-        cluster_pods.extend(make_valid_pods_by_daemonset(ds, cluster.nodes))
-    cluster.pods = cluster_pods
     try:
-        result = sim.run_cluster(cluster)
-        for app in apps:
-            result = sim.schedule_app(app)
-        if audit:
-            from .audit.checker import audit_simulation
+        with profile_capture(profile or ""):
+            with span("expand") as sp:
+                cluster_pods = get_valid_pods_exclude_daemonset(cluster)
+                for ds in cluster.daemon_sets:
+                    cluster_pods.extend(
+                        make_valid_pods_by_daemonset(ds, cluster.nodes)
+                    )
+                cluster.pods = cluster_pods
+                sp.set(pods=len(cluster_pods))
+            result = sim.run_cluster(cluster)
+            for app in apps:
+                result = sim.schedule_app(app)
+            if audit:
+                from .audit.checker import audit_simulation
 
-            result.audit = audit_simulation(sim, inject=_audit_inject)
+                result.audit = audit_simulation(sim, inject=_audit_inject)
         return result
     finally:
+        # export in the finally: an aborted simulation must still leave
+        # its timeline behind (the same contract as the CLI's --trace),
+        # and the export must land BEFORE disable() drops the buffer
+        if trace:
+            obs_trace.export_trace(trace)
+        if own_trace:
+            obs_trace.disable()
         sim.close()

@@ -60,6 +60,9 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..obs.metrics import REGISTRY
+from ..obs.trace import span
+
 # Conservation slack: the engines compare float32 `free + 1e-5·max(|free|,1)
 # >= req` (kernels/filters.py _RES_EPS) and accumulate usage in f32; the
 # audit accumulates in f64, so allow the engine's slack twice over plus an
@@ -793,15 +796,28 @@ def audit_placement(
                 )
             )
 
-    flags = (
-        _bulk_flags_jax(tensors, e, nv)
-        if use_jit
-        else _bulk_flags_numpy(tensors, e, nv)
-    )
-    if flags.any():
-        _decode_bulk(tensors, e, nv, flags, report)
-    _interpod_spread_checks(tensors, e, nv, report)
+    with span("audit.pass", pods=int(len(e.n)), mode=report.mode):
+        flags = (
+            _bulk_flags_jax(tensors, e, nv)
+            if use_jit
+            else _bulk_flags_numpy(tensors, e, nv)
+        )
+        if flags.any():
+            _decode_bulk(tensors, e, nv, flags, report)
+        _interpod_spread_checks(tensors, e, nv, report)
     report.wall_s = time.perf_counter() - t0
+    # registry mirror (obs/metrics.py): process-monotone audit telemetry
+    # next to the other counter families, under `audit.total_*` — the
+    # per-plan `audit.ok/checked/violations/wall_s/mode` names in the
+    # --json metrics block are reserved for the SHIPPED candidate's
+    # verdict (overlaid from PlanResult.audit in Applier.run), so the
+    # aggregate counters must not collide with them: a collision would
+    # leak one plan's verdict into the next plan's block and flip the
+    # field's type between a scalar and a histogram dict under one
+    # schema_version
+    REGISTRY.counter("audit.total_passes").inc()
+    REGISTRY.counter("audit.total_checked").inc(report.checked)
+    REGISTRY.counter("audit.total_violations").inc(report.total)
     return report
 
 

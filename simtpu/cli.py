@@ -55,10 +55,19 @@ def _plan_json(plan, resilience: dict = None) -> str:
     the engine record (search/bulk/shards + auto flags), so the
     non-reference-exact fast path is detectable from the OUTPUT, not just
     a stderr notice that pipelines routinely drop.  `resilience` attaches
-    the post-plan fault-sweep counters (`--faults`)."""
+    the post-plan fault-sweep counters (`--faults`).
+
+    `schema_version` stamps the document layout (obs.metrics
+    SCHEMA_VERSION — also reported by `simtpu version --json`), and
+    `metrics` is the unified observability block (ISSUE 8): one flat
+    name → value dict whose values the legacy engine-block families
+    (engine.fetch / engine.backoff / engine.wavefront /
+    engine.state_bytes / engine.audit) alias bit-equally for one
+    release."""
     import json
 
     doc = {
+        "schema_version": plan.schema_version,
         "success": plan.success,
         "nodes_added": plan.nodes_added,
         "message": plan.message,
@@ -67,6 +76,7 @@ def _plan_json(plan, resilience: dict = None) -> str:
         # only the best candidate verified so far (-1 = none)
         "partial": plan.partial,
         "engine": plan.engine,
+        "metrics": plan.metrics,
         "probes": {str(k): v for k, v in sorted(plan.probes.items())},
         "timings": {k: round(v, 3) for k, v in plan.timings.items()},
         "compiles": plan.compiles,
@@ -77,6 +87,51 @@ def _plan_json(plan, resilience: dict = None) -> str:
     if resilience is not None:
         doc["resilience"] = resilience
     return json.dumps(doc)
+
+
+def _with_obs(args, fn):
+    """Run one CLI command body under the --trace/--profile flags
+    (ISSUE 8, docs/observability.md): arm the span tracer for --trace,
+    wrap the body in a jax.profiler capture for --profile, and export
+    the Perfetto trace file on the way out — success or failure, so an
+    aborted run still leaves its timeline behind."""
+    import contextlib
+
+    from .obs import trace as obs_trace
+    from .obs.profile import profile_capture
+
+    trace_path = getattr(args, "trace", "") or ""
+    if trace_path and not obs_trace.enabled():
+        obs_trace.enable()
+    prof = getattr(args, "profile", "") or ""
+    try:
+        with profile_capture(prof) if prof else contextlib.nullcontext():
+            return fn()
+    finally:
+        if trace_path:
+            path = obs_trace.export_trace(trace_path)
+            print(
+                f"simtpu: span trace written to {path} "
+                "(load at https://ui.perfetto.dev)",
+                file=sys.stderr,
+            )
+
+
+def _flight_exit(code: int, reason: str, args, plan=None) -> int:
+    """Dump a flight-recorder bundle (obs/flight.py) for a structured
+    failure exit — partial (3), audit (4), OOM exhaustion — and return
+    `code`.  The bundle lands next to the --checkpoint dir when one was
+    given, else the working directory (SIMTPU_FLIGHT_DIR overrides,
+    SIMTPU_FLIGHT=0 disables)."""
+    from .obs.flight import dump_flight
+
+    dump_flight(
+        reason,
+        code,
+        checkpoint=getattr(args, "checkpoint", None) or "",
+        engine=plan.engine if plan is not None else None,
+    )
+    return code
 
 
 class _SweepAuditFailure(Exception):
@@ -166,6 +221,10 @@ def _sweep_json_doc(sweep, spec: str, samples: int, seed: int) -> dict:
 
 
 def cmd_apply(args: argparse.Namespace) -> int:
+    return _with_obs(args, lambda: _cmd_apply(args))
+
+
+def _cmd_apply(args: argparse.Namespace) -> int:
     opts = ApplierOptions(
         simon_config=args.simon_config,
         default_scheduler_config=args.default_scheduler_config or "",
@@ -233,6 +292,16 @@ def cmd_apply(args: argparse.Namespace) -> int:
         plan = applier.run(select_apps=select, progress=progress)
     except (ValueError, FileNotFoundError) as exc:
         return fail_early(exc)
+    except Exception as exc:
+        # an OOM backoff that exhausted its halving budget (single-pod /
+        # single-scenario chunk still RESOURCE_EXHAUSTED) escapes here —
+        # leave a flight-recorder bundle behind before the traceback
+        # (docs/observability.md)
+        from .durable.backoff import is_resource_exhausted
+
+        if is_resource_exhausted(exc):
+            _flight_exit(1, f"OOM-backoff exhaustion: {exc}", args)
+        raise
     fault_sweep, fault_base_unplaced, fault_error = None, 0, None
     fault_audit = None
     if args.faults and plan.success:
@@ -268,9 +337,14 @@ def cmd_apply(args: argparse.Namespace) -> int:
                 resilience["audit"] = fault_audit
         print(_plan_json(plan, resilience=resilience))
         if plan.partial:
-            return EXIT_PARTIAL
+            return _flight_exit(
+                EXIT_PARTIAL, "partial result (deadline/SIGINT)", args, plan
+            )
         if _audit_failed(plan.audit) or _audit_failed(fault_audit):
-            return EXIT_AUDIT
+            return _flight_exit(
+                EXIT_AUDIT, "audit divergence on the primary engine", args,
+                plan,
+            )
         return 0 if plan.success else 1
     if plan.success:
         print(f"{C.COLOR_GREEN}Success!{C.COLOR_RESET}")
@@ -303,7 +377,10 @@ def cmd_apply(args: argparse.Namespace) -> int:
             eng = " ".join(f"{k}={v}" for k, v in plan.engine.items())
             print(f"engine selection: {eng}")
         if _audit_failed(plan.audit) or _audit_failed(fault_audit):
-            return EXIT_AUDIT
+            return _flight_exit(
+                EXIT_AUDIT, "audit divergence on the primary engine", args,
+                plan,
+            )
         return 0
     print(f"{C.COLOR_RED}{plan.message}{C.COLOR_RESET}")
     if _audit_failed(plan.audit):
@@ -315,11 +392,21 @@ def cmd_apply(args: argparse.Namespace) -> int:
         print(report(plan.result.node_status, opts.extended_resources))
         print(C.COLOR_RESET, end="")
     if plan.partial:
-        return EXIT_PARTIAL
-    return EXIT_AUDIT if _audit_failed(plan.audit) else 1
+        return _flight_exit(
+            EXIT_PARTIAL, "partial result (deadline/SIGINT)", args, plan
+        )
+    if _audit_failed(plan.audit):
+        return _flight_exit(
+            EXIT_AUDIT, "audit divergence on the primary engine", args, plan
+        )
+    return 1
 
 
 def cmd_resilience(args: argparse.Namespace) -> int:
+    return _with_obs(args, lambda: _cmd_resilience(args))
+
+
+def _cmd_resilience(args: argparse.Namespace) -> int:
     """Survivability assessment / N+k planning over the configured cluster
     (simtpu/faults, plan/resilience.py).  Default mode drains + requeues
     every generated failure scenario against the as-is cluster; `--plan`
@@ -444,9 +531,15 @@ def cmd_resilience(args: argparse.Namespace) -> int:
 
                     print(resilience_report(plan.sweep))
             if plan.partial:
-                return EXIT_PARTIAL
+                return _flight_exit(
+                    EXIT_PARTIAL, "partial resilience plan (deadline/SIGINT)",
+                    args,
+                )
             if _audit_failed(plan.audit):
-                return EXIT_AUDIT
+                return _flight_exit(
+                    EXIT_AUDIT, "audit divergence on the resilience base "
+                    "placement", args,
+                )
             return 0 if plan.success else 1
 
         from .faults import generate_scenarios, place_cluster, sweep_scenarios
@@ -480,7 +573,10 @@ def cmd_resilience(args: argparse.Namespace) -> int:
                         "audit": audit_doc,
                     }))
                 print(hard_fail, file=sys.stderr)
-                return EXIT_AUDIT
+                return _flight_exit(
+                    EXIT_AUDIT, "audit: nothing certified (assessment base "
+                    "placement)", args,
+                )
         base_unplaced = int((pc.nodes < 0).sum())
         if base_unplaced:
             progress(
@@ -502,7 +598,10 @@ def cmd_resilience(args: argparse.Namespace) -> int:
             doc["audit"] = audit_doc
         print(json.dumps(doc))
         if _audit_failed(audit_doc):
-            return EXIT_AUDIT
+            return _flight_exit(
+                EXIT_AUDIT, "audit divergence on the assessment base "
+                "placement", args,
+            )
         return 0 if survived_all else 1
     from .report import resilience_report
 
@@ -520,11 +619,18 @@ def cmd_resilience(args: argparse.Namespace) -> int:
         f"({rate:.0f} scenarios/s)"
     )
     if _audit_failed(audit_doc):
-        return EXIT_AUDIT
+        return _flight_exit(
+            EXIT_AUDIT, "audit divergence on the assessment base placement",
+            args,
+        )
     return 0 if survived_all else 1
 
 
 def cmd_fuzz(args: argparse.Namespace) -> int:
+    return _with_obs(args, lambda: _cmd_fuzz(args))
+
+
+def _cmd_fuzz(args: argparse.Namespace) -> int:
     """Differential fuzz / mutation-kill driver (simtpu/audit/fuzz.py).
 
     Exit codes: 0 = every case bit-identical and audit-clean (or 100%
@@ -613,7 +719,18 @@ def cmd_fuzz(args: argparse.Namespace) -> int:
     return 0 if result.ok else EXIT_AUDIT
 
 
-def cmd_version(_args: argparse.Namespace) -> int:
+def cmd_version(args: argparse.Namespace) -> int:
+    if getattr(args, "json", False):
+        # downstream consumers of the --json metrics block detect layout
+        # changes on schema_version (obs/metrics.py), not key probing
+        import json
+
+        from .obs.metrics import SCHEMA_VERSION
+
+        print(json.dumps(
+            {"version": __version__, "schema_version": SCHEMA_VERSION}
+        ))
+        return 0
     print(f"simtpu version {__version__}")
     return 0
 
@@ -691,6 +808,28 @@ def _add_durable_flags(p: argparse.ArgumentParser) -> None:
         f"{EXIT_PARTIAL} and a structured partial result (best candidate "
         "verified so far, partial=true under --json) instead of a "
         "traceback",
+    )
+
+
+def _add_obs_flags(p: argparse.ArgumentParser) -> None:
+    """Observability flags shared by apply/resilience/fuzz (ISSUE 8,
+    docs/observability.md)."""
+    p.add_argument(
+        "--trace",
+        metavar="FILE",
+        default="",
+        help="record the run's spans (obs/trace.py) and write a "
+        "Perfetto-loadable Chrome trace-event JSON to FILE on exit — "
+        "success or failure (SIMTPU_TRACE=1 arms the tracer without a "
+        "file; SIMTPU_TRACE=FILE is the env equivalent of this flag)",
+    )
+    p.add_argument(
+        "--profile",
+        metavar="DIR",
+        default="",
+        help="capture a jax.profiler (TensorBoard-loadable) device trace "
+        "under DIR, with TraceAnnotation names matching the span "
+        "vocabulary (SIMTPU_PROFILE=DIR is the env equivalent)",
     )
 
 
@@ -829,6 +968,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _add_audit_flags(apply_p)
     _add_durable_flags(apply_p)
+    _add_obs_flags(apply_p)
     apply_p.set_defaults(func=cmd_apply)
 
     res_p = sub.add_parser(
@@ -910,6 +1050,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _add_audit_flags(res_p)
     _add_durable_flags(res_p)
+    _add_obs_flags(res_p)
     res_p.set_defaults(func=cmd_resilience)
 
     fuzz_p = sub.add_parser(
@@ -975,9 +1116,16 @@ def build_parser() -> argparse.ArgumentParser:
         "--json", action="store_true",
         help="print machine-readable counters instead of progress text",
     )
+    _add_obs_flags(fuzz_p)
     fuzz_p.set_defaults(func=cmd_fuzz)
 
     ver_p = sub.add_parser("version", help="print version")
+    ver_p.add_argument(
+        "--json", action="store_true",
+        help="print {version, schema_version} — schema_version stamps the "
+        "--json document layout (incl. the metrics block); consumers pin "
+        "on it instead of probing keys",
+    )
     ver_p.set_defaults(func=cmd_version)
 
     doc_p = sub.add_parser("gen-doc", help="generate CLI markdown docs")

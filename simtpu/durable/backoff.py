@@ -34,12 +34,23 @@ from __future__ import annotations
 
 import threading
 
+from ..obs.metrics import REGISTRY
+from ..obs.trace import instant
+
 #: Monotone process-wide counters, fetch_counts()-style (engine/scan.py):
 #: "events" RESOURCE_EXHAUSTED catches, "splits" sub-dispatches created by
 #: the halving replays, "chunk_min" the smallest chunk/block size any
-#: backoff re-dispatched at (0 = no backoff yet).
-BACKOFF_COUNTS = {"events": 0, "splits": 0, "chunk_min": 0}
-_LOCK = threading.Lock()
+#: backoff re-dispatched at (0 = no backoff yet).  Backing store since
+#: ISSUE 8: registry counters `backoff.events`/`backoff.splits` plus the
+#: `backoff.chunk_min` gauge (a process-lifetime floor, not a flow);
+#: `backoff_counts()` stays as the legacy alias view.
+_EVENTS = REGISTRY.counter("backoff.events")
+_SPLITS = REGISTRY.counter("backoff.splits")
+_CHUNK_MIN = REGISTRY.gauge("backoff.chunk_min")
+# the chunk_min floor is a read-modify-write over a gauge — concurrent
+# OOMs (scan loop + fault sweep on different threads) need the whole RMW
+# atomic, not just each instrument op
+_MIN_LOCK = threading.Lock()
 
 #: substrings that identify an allocator failure across jaxlib versions
 #: (XlaRuntimeError renders the status code name; older paths render the
@@ -59,16 +70,21 @@ def is_resource_exhausted(exc: BaseException) -> bool:
 def record_backoff(size_from: int, size_to: int) -> None:
     """Count one caught RESOURCE_EXHAUSTED that split a chunk of
     `size_from` into replays of `size_to`."""
-    with _LOCK:
-        BACKOFF_COUNTS["events"] += 1
-        BACKOFF_COUNTS["splits"] += 2
-        lo = BACKOFF_COUNTS["chunk_min"]
-        BACKOFF_COUNTS["chunk_min"] = (
-            int(size_to) if lo == 0 else min(lo, int(size_to))
-        )
+    _EVENTS.inc()
+    _SPLITS.inc(2)
+    with _MIN_LOCK:
+        lo = _CHUNK_MIN.value
+        _CHUNK_MIN.set(int(size_to) if lo == 0 else min(lo, int(size_to)))
+    # point event on the span timeline: OOM backoffs are exactly the
+    # anomalies a post-mortem trace read hunts for
+    instant("backoff.oom", size_from=int(size_from), size_to=int(size_to))
 
 
 def backoff_counts() -> dict:
-    """Snapshot of the backoff counters (monotone over a process)."""
-    with _LOCK:
-        return dict(BACKOFF_COUNTS)
+    """Snapshot of the backoff counters (monotone over a process; alias
+    view of the registry's `backoff.*` instruments)."""
+    return {
+        "events": _EVENTS.value,
+        "splits": _SPLITS.value,
+        "chunk_min": _CHUNK_MIN.value,
+    }

@@ -33,6 +33,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..obs.trace import span
+
 #: bump when the record layout changes; older checkpoints refuse to resume
 CHECKPOINT_VERSION = 1
 
@@ -224,8 +226,9 @@ class PlanCheckpoint:
             return None
         path = os.path.join(self.directory, fname)
         try:
-            with np.load(path, allow_pickle=False) as z:
-                return {k: z[k] for k in z.files}
+            with span("checkpoint.get", phase=phase, cand=int(cand)):
+                with np.load(path, allow_pickle=False) as z:
+                    return {k: z[k] for k in z.files}
         except (OSError, ValueError, KeyError, EOFError) as exc:
             # a truncated/empty/garbage record (a kill mid-rename window,
             # disk-full, manual edits) must read as ONE actionable line,
@@ -243,13 +246,15 @@ class PlanCheckpoint:
         fname = f"rec_{phase}_{int(cand)}.npz"
         path = os.path.join(self.directory, fname)
         tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            np.savez_compressed(
-                f, **{k: np.asarray(v) for k, v in entries.items()}
-            )
-        os.replace(tmp, path)
-        self._records[key] = fname
-        self._write_manifest()
+        with span("checkpoint.put", phase=phase, cand=int(cand)) as sp:
+            with open(tmp, "wb") as f:
+                np.savez_compressed(
+                    f, **{k: np.asarray(v) for k, v in entries.items()}
+                )
+            sp.set(bytes=os.path.getsize(tmp))
+            os.replace(tmp, path)
+            self._records[key] = fname
+            self._write_manifest()
 
     def __len__(self) -> int:
         return len(self._records)

@@ -57,6 +57,8 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs.trace import span
+
 log = logging.getLogger("simtpu.precompile")
 
 
@@ -179,7 +181,11 @@ class AotPipeline:
 
     def _compile(self, job, name, fn, args_sds, static_tail):
         t0 = time.perf_counter()
-        compiled = fn.lower(*args_sds, *static_tail).compile()
+        # per-signature compile span ON the pool thread: the Perfetto view
+        # shows the compile lanes overlapping the dispatch lane — the
+        # pipelining win (and any straggler signature) made visible
+        with span("aot.compile", sig=str(name)):
+            compiled = fn.lower(*args_sds, *static_tail).compile()
         job.seconds = time.perf_counter() - t0
         with self._lock:
             self._done += 1

@@ -88,6 +88,7 @@ import numpy as np
 from ..core.tensorize import DOM_SMALL
 from ..durable.backoff import is_resource_exhausted, record_backoff
 from ..kernels.filters import _RES_EPS, interpod_filter, topology_spread_filter
+from ..obs.trace import span
 from .scan import (
     Engine,
     SchedState,
@@ -1316,19 +1317,25 @@ class RoundsEngine(Engine):
         return work
 
     def _dispatch_bulk_chunk(self, statics, state, work, tensors, flags):
-        """Dispatch one prepared bulk chunk through _bulk_call(_sliced)."""
-        if work.get("g_terms_c") is None:
-            return self._bulk_call(
-                statics, state, work["seg_pods"], work["ks"],
-                tensors.n_domains, work["k_cap"], flags, work["quota"],
-                work["self_aff"], work["ext_mats"],
+        """Dispatch one prepared bulk chunk through _bulk_call(_sliced) —
+        the single funnel every bulk dispatch (including the OOM-backoff
+        replays) passes through, so one span here covers them all."""
+        with span(
+            "rounds.chunk",
+            runs=len(work["chunk"]), pods=int(work["ks"].sum()),
+        ):
+            if work.get("g_terms_c") is None:
+                return self._bulk_call(
+                    statics, state, work["seg_pods"], work["ks"],
+                    tensors.n_domains, work["k_cap"], flags, work["quota"],
+                    work["self_aff"], work["ext_mats"],
+                )
+            return self._bulk_call_sliced(
+                statics, state, work["rows"], work["g_terms_c"],
+                work["term_topo_c"], work["ip_of_c"], work["seg_pods"],
+                work["ks"], tensors.n_domains, work["k_cap"], flags,
+                work["quota"], work["self_aff"], work["ext_mats"],
             )
-        return self._bulk_call_sliced(
-            statics, state, work["rows"], work["g_terms_c"],
-            work["term_topo_c"], work["ip_of_c"], work["seg_pods"],
-            work["ks"], tensors.n_domains, work["k_cap"], flags,
-            work["quota"], work["self_aff"], work["ext_mats"],
-        )
 
     def _bulk_backoff(self, statics, state, work, pods, tensors, flags):
         """Replay an OOM'd bulk chunk as two half-chunks, each re-chunked

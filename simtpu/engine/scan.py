@@ -16,7 +16,6 @@ deterministic, and placement-set-equivalent for conformance purposes
 
 from __future__ import annotations
 
-import threading
 from functools import partial
 from typing import List, NamedTuple, Tuple
 
@@ -26,6 +25,9 @@ import numpy as np
 
 from ..core.tensorize import ClusterTensors, PodBatch
 from ..durable.backoff import is_resource_exhausted, record_backoff
+from ..obs.metrics import REGISTRY
+from ..obs.metrics import family as metrics_family
+from ..obs.trace import instant, span
 from ..kernels.filters import (
     attach_limits_ok,
     interpod_filter,
@@ -91,20 +93,22 @@ FAIL_VOLUME_BIND = 11  # PVC missing / not bindable / PV zone mismatch
 # time only; steady-state dispatches never touch it.  (With the background
 # precompile pipeline, engine/precompile.py, AOT lowering on worker threads
 # bumps these too — the counts then attribute a trace to whatever phase is
-# active when the background lowering happens to run; the lock keeps
-# concurrent worker-thread traces from losing increments.)
-TRACE_COUNTS = {"scan": 0, "rounds": 0, "wave": 0}
-_TRACE_LOCK = threading.Lock()
+# active when the background lowering happens to run; the registry
+# counters' lock keeps concurrent worker-thread traces from losing
+# increments.)  Since ISSUE 8 the backing store is the obs metrics
+# registry under `compile.<kind>`; `trace_counts()` stays as the legacy
+# alias view (same keys, same values — it reads the registry).
+_COMPILE_COUNT_KINDS = ("scan", "rounds", "wave")
 
 
 def count_trace(kind: str) -> None:
-    with _TRACE_LOCK:
-        TRACE_COUNTS[kind] = TRACE_COUNTS.get(kind, 0) + 1
+    REGISTRY.counter(f"compile.{kind}").inc()
 
 
 def trace_counts() -> dict:
-    """Snapshot of the per-kind jit-trace counters."""
-    return dict(TRACE_COUNTS)
+    """Snapshot of the per-kind jit-trace counters (alias view of the
+    obs metrics registry's `compile.*` counters)."""
+    return metrics_family("compile", _COMPILE_COUNT_KINDS)
 
 
 # Blocking device→host fetch counters: every engine-path jax.device_get goes
@@ -113,27 +117,36 @@ def trace_counts() -> dict:
 # the matrix point's measured floor, docs/status.md) AND how many bytes they
 # moved ("bytes" — the payload-side of the transfer audit; with it, a
 # regression that grows the fetched tree shows up even when the round-trip
-# count stays flat).
-FETCH_COUNTS = {"get": 0, "bytes": 0}
+# count stays flat).  Backing store: registry counters `fetch.get` /
+# `fetch.bytes` (ISSUE 8); `fetch_counts()` is the legacy alias view.
+_FETCH_GET = REGISTRY.counter("fetch.get")
+_FETCH_BYTES = REGISTRY.counter("fetch.bytes")
 
 
 def fetch_outputs(tree):
     """jax.device_get with round-trip + byte accounting (one "get" bump per
-    blocking fetch; "bytes" sums the materialized host payload)."""
-    FETCH_COUNTS["get"] += 1
-    out = jax.device_get(tree)
-    FETCH_COUNTS["bytes"] += sum(
-        leaf.nbytes
-        for leaf in jax.tree_util.tree_leaves(out)
-        if hasattr(leaf, "nbytes")
-    )
+    blocking fetch; "bytes" sums the materialized host payload).  Under
+    tracing each fetch is a `fetch.get` span carrying its byte payload —
+    the blocking device→host syncs are exactly the events a Perfetto
+    timeline of a dispatch loop needs labeled."""
+    _FETCH_GET.inc()
+    with span("fetch.get") as sp:
+        out = jax.device_get(tree)
+        nbytes = sum(
+            leaf.nbytes
+            for leaf in jax.tree_util.tree_leaves(out)
+            if hasattr(leaf, "nbytes")
+        )
+        sp.set(bytes=nbytes)
+    _FETCH_BYTES.inc(nbytes)
     return out
 
 
 def fetch_counts() -> dict:
     """Snapshot of the blocking-fetch counters ("get" round-trips, "bytes"
-    of fetched payload — both monotone over a process)."""
-    return dict(FETCH_COUNTS)
+    of fetched payload — both monotone over a process).  Alias view of
+    the registry's `fetch.*` counters."""
+    return metrics_family("fetch", ("get", "bytes"))
 
 
 # Speculative-wavefront telemetry (docs/speculation.md): bumped host-side
@@ -144,19 +157,16 @@ def fetch_counts() -> dict:
 # "rollback_pods" counts the pods beyond the first divergence, whose
 # speculative placements were discarded and whose results come from the
 # verifier's pod-at-a-time serial replay; a "rollback" is a wavefront with
-# at least one divergence.
-WAVE_COUNTS = {
-    "wavefronts": 0,
-    "pods": 0,
-    "accepted": 0,
-    "rollbacks": 0,
-    "rollback_pods": 0,
-}
+# at least one divergence.  Backing store: registry counters
+# `wavefront.*` (ISSUE 8); `wave_counts()` is the legacy alias view.
+_WAVE_KEYS = ("wavefronts", "pods", "accepted", "rollbacks", "rollback_pods")
+_WAVE = {k: REGISTRY.counter(f"wavefront.{k}") for k in _WAVE_KEYS}
 
 
 def wave_counts() -> dict:
-    """Snapshot of the speculation counters."""
-    return dict(WAVE_COUNTS)
+    """Snapshot of the speculation counters (alias view of the registry's
+    `wavefront.*` counters)."""
+    return metrics_family("wavefront", _WAVE_KEYS)
 
 
 def wave_enabled() -> bool:
@@ -1187,7 +1197,7 @@ def run_scan_chunked(
     speculative wavefront executable, `_run_wavefront`'s calling
     convention), eligible same-group runs inside each chunk dispatch
     through it instead of the general scan — placements stay bit-identical
-    and the accept flags feed WAVE_COUNTS.  Returns (final_state, host
+    and the accept flags feed the wavefront.* counters.  Returns (final_state, host
     output tuple) — outputs are numpy, truncated to the real pod count."""
     call = scan_call or _run_scan
     n = groups.shape[0]
@@ -1261,7 +1271,8 @@ def run_scan_chunked(
         entries = []
         for x, y in ((a, mid), (mid, b)):
             try:
-                state, outs = call(eff, state, prep_range(i, x, y), flags)
+                with span("scan.chunk", pods=int(y - x), backoff=True):
+                    state, outs = call(eff, state, prep_range(i, x, y), flags)
                 entries.append((outs, y - x, None))
             except Exception as exc:
                 if not is_resource_exhausted(exc) or y - x <= 1:
@@ -1322,12 +1333,14 @@ def run_scan_chunked(
         seg = next_seg
         try:
             if kind == "wave":
-                state, outs, accepts = wave_call(
-                    eff_statics, state, seg, flags,
-                    wave_static_spec(tensors, w_mode[0], w_mode[1]),
-                )
+                with span("scan.wave", pods=int(b - a)):
+                    state, outs, accepts = wave_call(
+                        eff_statics, state, seg, flags,
+                        wave_static_spec(tensors, w_mode[0], w_mode[1]),
+                    )
             else:
-                state, outs = call(eff_statics, state, seg, flags)
+                with span("scan.chunk", pods=int(b - a)):
+                    state, outs = call(eff_statics, state, seg, flags)
                 accepts = None
             entries = [(outs, b - a, accepts)]
         except Exception as exc:
@@ -1356,12 +1369,16 @@ def run_scan_chunked(
         if accepts_h is not None:
             acc = np.asarray(accepts_h)[:real]
             prefix = int(real) if acc.all() else int(acc.argmin())
-            WAVE_COUNTS["wavefronts"] += 1
-            WAVE_COUNTS["pods"] += int(real)
-            WAVE_COUNTS["accepted"] += prefix
+            _WAVE["wavefronts"].inc()
+            _WAVE["pods"].inc(int(real))
+            _WAVE["accepted"].inc(prefix)
             if prefix < real:
-                WAVE_COUNTS["rollbacks"] += 1
-                WAVE_COUNTS["rollback_pods"] += int(real) - prefix
+                _WAVE["rollbacks"].inc()
+                _WAVE["rollback_pods"].inc(int(real) - prefix)
+                instant(
+                    "wave.rollback",
+                    pods=int(real) - prefix, accepted=prefix,
+                )
     if len(outs_host) == 1:
         return state, outs_host[0]
     merged = tuple(
@@ -1399,7 +1416,7 @@ def run_scan_chunked(
 #    speculative placement (the accept flags prove it); every pod beyond it
 #    is rolled back and takes the verifier's replayed serial answer.  The
 #    committed state is always the verifier's — placements are bit-identical
-#    to the pod-at-a-time scan by construction, and `WAVE_COUNTS` reports
+#    to the pod-at-a-time scan by construction, and `wave_counts()` reports
 #    the acceptance rate and rollback volume.
 #
 # Bit-exactness rests on three pinned facts: (a) the verifier computes the

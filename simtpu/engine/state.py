@@ -759,21 +759,30 @@ def state_nbytes(state) -> dict:
 # Carried-state byte gauge: refreshed by Engine.place each time it stores a
 # carry, read by bench.py (`state_bytes`) and the CLI's --json engine block.
 # `dense_bytes` is what the SAME carry costs in the dense layout (the A/B
-# denominator); `compact` records which form is stored.
-STATE_GAUGE = {"carried_bytes": 0, "dense_bytes": 0, "compact": False,
-               "planes": {}}
+# denominator); `compact` records which form is stored.  Backing store
+# since ISSUE 8: obs metrics registry gauges `state.carried_bytes` /
+# `state.dense_bytes` / `state.compact` / `state.planes`; `state_gauge()`
+# stays as the legacy alias view (same keys, same values).
 
 
 def update_state_gauge(stored, dense_bytes: int) -> None:
+    from ..obs.metrics import REGISTRY
+
     planes = state_nbytes(stored)
-    STATE_GAUGE["carried_bytes"] = sum(planes.values())
-    STATE_GAUGE["dense_bytes"] = int(dense_bytes)
-    STATE_GAUGE["compact"] = isinstance(stored, CompactState)
-    STATE_GAUGE["planes"] = planes
+    REGISTRY.gauge("state.carried_bytes").set(sum(planes.values()))
+    REGISTRY.gauge("state.dense_bytes").set(int(dense_bytes))
+    REGISTRY.gauge("state.compact").set(isinstance(stored, CompactState))
+    REGISTRY.gauge("state.planes").set(planes)
 
 
 def state_gauge() -> dict:
-    """Snapshot of the carried-state byte gauge."""
-    out = dict(STATE_GAUGE)
-    out["planes"] = dict(STATE_GAUGE["planes"])
-    return out
+    """Snapshot of the carried-state byte gauge (alias view of the obs
+    registry's `state.*` gauges)."""
+    from ..obs.metrics import REGISTRY
+
+    return {
+        "carried_bytes": REGISTRY.value("state.carried_bytes"),
+        "dense_bytes": REGISTRY.value("state.dense_bytes"),
+        "compact": REGISTRY.value("state.compact", default=False),
+        "planes": dict(REGISTRY.value("state.planes", default={})),
+    }

@@ -52,6 +52,7 @@ from ..engine.scan import (
     statics_from,
 )
 from ..engine.state import build_state, placement_delta_step
+from ..obs.trace import span
 from .drain import PlacedCluster, drain_requeue
 from .scenarios import ScenarioSet
 
@@ -367,14 +368,16 @@ def sweep_scenarios(
         td = time.perf_counter()
         args = (statics, valid, state, entries, pods)
         try:
-            if pipeline is not None:
-                nodes_b, reasons_b = pipeline.call(
-                    "fault_sweep", (flags,), args, lambda: _fault_sweep(*args, flags)
-                )
-            else:
-                nodes_b, reasons_b = _fault_sweep(*args, flags)
-            nodes_b = np.asarray(nodes_b)[: s1 - s0]
-            reasons_b = np.asarray(reasons_b)[: s1 - s0]
+            with span("fault.block", scenarios=int(s1 - s0), pad=int(sb)):
+                if pipeline is not None:
+                    nodes_b, reasons_b = pipeline.call(
+                        "fault_sweep", (flags,), args,
+                        lambda: _fault_sweep(*args, flags),
+                    )
+                else:
+                    nodes_b, reasons_b = _fault_sweep(*args, flags)
+                nodes_b = np.asarray(nodes_b)[: s1 - s0]
+                reasons_b = np.asarray(reasons_b)[: s1 - s0]
         except Exception as exc:
             if not is_resource_exhausted(exc) or sb <= min_block:
                 raise

@@ -1,0 +1,48 @@
+"""simtpu.obs — the unified observability layer (ISSUE 8).
+
+One subsystem, four pieces, zero dependencies beyond the stdlib:
+
+- `obs.trace`   — ring-buffer span tracer, Perfetto (Chrome trace-event)
+  export; `span("name", **attrs)` is the one instrumentation primitive,
+  compiled to a shared no-op when tracing is off.
+- `obs.metrics` — the process-wide typed metrics registry every legacy
+  counter family (fetch / state gauge / backoff / wavefront / jit-trace /
+  audit) now lives in; legacy snapshot functions remain as alias views.
+- `obs.profile` — `--profile DIR` jax.profiler capture whose
+  TraceAnnotation names match the span vocabulary.
+- `obs.flight`  — failure flight recorder: last-N spans + metrics
+  snapshot + engine fingerprint dumped on exit 3/4/OOM-exhaustion.
+
+Import cost matters: `simtpu/__init__.py` arms the tracer from
+SIMTPU_TRACE at import, so this package must not import jax (obs.profile
+defers it)."""
+
+from .metrics import REGISTRY, SCHEMA_VERSION, MetricsRegistry
+from .trace import (
+    disable,
+    enable,
+    enabled,
+    events,
+    export_trace,
+    init_from_env,
+    instant,
+    span,
+    span_summary,
+    to_chrome_trace,
+)
+
+__all__ = [
+    "REGISTRY",
+    "SCHEMA_VERSION",
+    "MetricsRegistry",
+    "disable",
+    "enable",
+    "enabled",
+    "events",
+    "export_trace",
+    "init_from_env",
+    "instant",
+    "span",
+    "span_summary",
+    "to_chrome_trace",
+]

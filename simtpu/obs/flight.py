@@ -1,0 +1,120 @@
+"""Failure flight recorder (ISSUE 8): a post-mortem bundle on bad exits.
+
+When a plan ends on one of the structured failure exits — partial result
+(deadline/SIGINT, exit 3), audit divergence (exit 4), or an OOM-backoff
+that exhausted its halving budget and escaped — the CLI dumps ONE JSON
+bundle capturing what the process knew at that moment:
+
+- the last-N buffered spans (Chrome trace-event format, loadable in
+  Perfetto like a full --trace file) and the span summary digest,
+- a full metrics-registry snapshot (every counter family),
+- the engine-config fingerprint of the run (the PlanResult.engine block
+  when a plan exists, else the resolved CLI options),
+- version/schema stamps and the triggering reason.
+
+Location: "next to the checkpoint dir" — the parent directory of
+--checkpoint DIR when one was given (the operator already looks there
+for the durable-execution artifacts), else the working directory.
+SIMTPU_FLIGHT_DIR overrides; SIMTPU_FLIGHT=0 disables dumping entirely.
+Writes are atomic (tmp + rename, the durable/checkpoint.py discipline)
+and total failures are swallowed into one warning: the flight recorder
+must never turn a structured exit into a traceback.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Dict, Optional
+
+from .metrics import REGISTRY, SCHEMA_VERSION
+from .trace import dropped, span_summary, to_chrome_trace
+
+log = logging.getLogger("simtpu.obs")
+
+#: how many of the newest spans ride the bundle (the ring may hold 64k)
+FLIGHT_SPANS = 256
+
+FLIGHT_FORMAT = "simtpu-flight-v1"
+
+
+def flight_enabled() -> bool:
+    return os.environ.get("SIMTPU_FLIGHT", "1") != "0"
+
+
+def flight_dir(checkpoint: str = "") -> str:
+    """Where bundles land: SIMTPU_FLIGHT_DIR > the checkpoint dir's
+    parent > the working directory."""
+    env = os.environ.get("SIMTPU_FLIGHT_DIR", "")
+    if env:
+        return env
+    if checkpoint:
+        parent = os.path.dirname(os.path.abspath(checkpoint.rstrip(os.sep)))
+        return parent or "."
+    return "."
+
+
+def flight_bundle(
+    reason: str,
+    exit_code: int,
+    engine: Optional[Dict[str, object]] = None,
+    extra: Optional[Dict[str, object]] = None,
+) -> Dict[str, object]:
+    """Assemble the bundle document (pure; `dump_flight` writes it)."""
+    from .. import __version__
+
+    doc: Dict[str, object] = {
+        "format": FLIGHT_FORMAT,
+        "version": __version__,
+        "schema_version": SCHEMA_VERSION,
+        "time_unix": time.time(),
+        "pid": os.getpid(),
+        "reason": reason,
+        "exit_code": int(exit_code),
+        "metrics": REGISTRY.snapshot(),
+        "span_summary": span_summary(top=10),
+        "spans": to_chrome_trace(last=FLIGHT_SPANS),
+        "spans_dropped": dropped(),
+        "engine": engine or {},
+    }
+    if extra:
+        doc.update(extra)
+    return doc
+
+
+def dump_flight(
+    reason: str,
+    exit_code: int,
+    checkpoint: str = "",
+    engine: Optional[Dict[str, object]] = None,
+    extra: Optional[Dict[str, object]] = None,
+) -> Optional[str]:
+    """Write one flight bundle and return its path (None when disabled or
+    the write failed — the failure is a warning, never an exception)."""
+    if not flight_enabled():
+        return None
+    try:
+        out_dir = flight_dir(checkpoint)
+        os.makedirs(out_dir, exist_ok=True)
+        stamp = time.strftime("%Y%m%dT%H%M%S")
+        path = os.path.join(
+            out_dir, f"simtpu-flight-{stamp}-{os.getpid()}.json"
+        )
+        doc = flight_bundle(reason, exit_code, engine=engine, extra=extra)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        log.warning(
+            "flight recorder: %s (exit %d) — bundle at %s",
+            reason, exit_code, path,
+        )
+        return path
+    except Exception as exc:  # noqa: BLE001 - never worsen a failing exit
+        log.warning(
+            "flight recorder failed (%s: %s); no bundle written",
+            type(exc).__name__, exc,
+        )
+        return None

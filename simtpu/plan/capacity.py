@@ -53,6 +53,8 @@ from ..io.cluster import (
     match_and_set_local_storage_annotation_on_node,
 )
 from ..io.yaml_loader import get_objects_from_yaml_content, get_yaml_content_from_directory
+from ..obs.metrics import REGISTRY, SCHEMA_VERSION
+from ..obs.trace import span
 from ..workloads.expand import make_valid_node_by_node, new_daemon_pod
 
 
@@ -94,6 +96,16 @@ class PlanResult:
     # planes).  {} = audit not run (--no-audit / SIMTPU_AUDIT=0);
     # rides --json under engine.audit and decides the audit exit code
     audit: Dict[str, object] = field(default_factory=dict)
+    # the unified metrics block (ISSUE 8, obs/metrics.py): one flat
+    # name → value dict of every counter family's delta over this plan
+    # (gauges report their end-of-plan level).  The legacy engine-block
+    # fields above are aliases built FROM these values — bit-equal by
+    # construction, kept for one release; rides --json as "metrics"
+    metrics: Dict[str, object] = field(default_factory=dict)
+    # layout stamp for --json consumers (obs.metrics.SCHEMA_VERSION):
+    # bumped whenever the metrics block or any stable field changes
+    # shape — pin on this, not on key probing
+    schema_version: int = SCHEMA_VERSION
 
 
 def new_fake_nodes(template: dict, count: int) -> List[dict]:
@@ -267,6 +279,10 @@ def plan_capacity(
 
     def run(i: int, serial_exact: bool = False) -> SimulateResult:
         say(f"add {i} node(s)")
+        with span("plan.candidate", count=int(i), serial_exact=serial_exact):
+            return _run_candidate(i, serial_exact)
+
+    def _run_candidate(i: int, serial_exact: bool) -> SimulateResult:
         trial = ResourceTypes(**{k: list(v) for k, v in vars(cluster).items()})
         trial.nodes = list(cluster.nodes) + new_fake_nodes(new_node, i)
         if serial_exact:
@@ -608,6 +624,14 @@ class ApplierOptions:
     # placement auditor over the accepted candidate and fall back to the
     # serial exact engines on failure; False = --no-audit
     audit: Optional[bool] = None
+    # observability (ISSUE 8, docs/observability.md): `trace` = output
+    # path for a Perfetto-loadable Chrome trace of the run's spans
+    # ("" = no trace file; arming leaves the process tracer on so a
+    # later flight-recorder dump still sees the spans); `profile` = log
+    # dir for a jax.profiler capture of the plan phase with span-named
+    # TraceAnnotations ("" = SIMTPU_PROFILE env, else off)
+    trace: str = ""
+    profile: str = ""
 
 
 # Auto-engine thresholds: below both, the serial scan keeps its per-pod
@@ -758,34 +782,60 @@ class Applier:
         import os
         import time as _time
 
+        from ..obs import trace as obs_trace
+        from ..obs.profile import profile_capture
+
+        # --trace FILE arms the span tracer for this run (a tracer armed
+        # earlier — SIMTPU_TRACE — keeps its buffer; the export below
+        # only adds this run's output file).  Deliberately NOT disabled
+        # afterwards: a failing exit's flight recorder (obs/flight.py)
+        # reads the same buffer after run() returns.
+        if self.opts.trace and not obs_trace.enabled():
+            obs_trace.enable()
+
         timings: Dict[str, float] = {}
         t0 = _time.perf_counter()
-        apps = self.load_apps()
-        if select_apps is not None:
-            # human think-time must not count toward the ingest phase
-            timings["ingest"] = _time.perf_counter() - t0
-            chosen = set(select_apps([a.name for a in apps]))
-            apps = [a for a in apps if a.name in chosen]
-            t0 = _time.perf_counter()
-        cluster = self.load_cluster()
-        new_node = self.load_new_node()
-        timings["ingest"] = timings.get("ingest", 0.0) + _time.perf_counter() - t0
+        # the ingest span brackets exactly the wall the "ingest" timing
+        # reports (spans and --json phase timings must reconcile); the
+        # interactive selection's human think-time sits between two spans
+        # just as it sits outside both timed regions
+        sp_ingest = span("ingest")
+        sp_ingest.__enter__()
+        try:
+            apps = self.load_apps()
+            if select_apps is not None:
+                # human think-time must not count toward the ingest phase
+                timings["ingest"] = _time.perf_counter() - t0
+                sp_ingest.__exit__(None, None, None)
+                chosen = set(select_apps([a.name for a in apps]))
+                apps = [a for a in apps if a.name in chosen]
+                t0 = _time.perf_counter()
+                sp_ingest = span("ingest")
+                sp_ingest.__enter__()
+            cluster = self.load_cluster()
+            new_node = self.load_new_node()
+            timings["ingest"] = (
+                timings.get("ingest", 0.0) + _time.perf_counter() - t0
+            )
+        finally:
+            # a load failure must still close the span: a leaked span is
+            # never recorded AND corrupts the thread's nesting depth for
+            # every later span — exactly on the failing runs a trace or
+            # flight bundle is read to explain
+            sp_ingest.__exit__(None, None, None)
 
         import jax
 
-        # SIMTPU_TRACE=<dir> captures a jax.profiler trace of the plan phase
-        trace_dir = os.environ.get("SIMTPU_TRACE", "")
-        ctx = contextlib.nullcontext()
-        if trace_dir:
-            ctx = jax.profiler.trace(trace_dir)
-        from ..durable.backoff import backoff_counts
-        from ..engine.scan import fetch_counts, wave_counts, wave_enabled
-        from ..engine.state import state_gauge
+        # --profile DIR (or SIMTPU_PROFILE=DIR) captures a jax.profiler
+        # trace of the plan phase, with TraceAnnotations named after the
+        # spans (obs/profile.py).  Note: before ISSUE 8 the profiler dir
+        # rode SIMTPU_TRACE — that name now arms the span tracer instead.
+        profile_dir = self.opts.profile or os.environ.get("SIMTPU_PROFILE", "")
+        ctx = profile_capture(profile_dir) if profile_dir else contextlib.nullcontext()
+        from ..engine.scan import wave_enabled
 
         search, bulk, mesh = _resolve_engines(self.opts, cluster, apps)
-        waves_before = wave_counts()
-        fetch_before = fetch_counts()
-        backoff_before = backoff_counts()
+        metrics_before = REGISTRY.snapshot()
 
         # durable execution (docs/robustness.md): per-candidate checkpoint
         # records under --checkpoint DIR, fingerprint-guarded resume, and
@@ -843,7 +893,7 @@ class Applier:
             if control is not None and self.opts.install_sigint
             else contextlib.nullcontext()
         )
-        with ctx, sig_ctx:
+        with ctx, sig_ctx, span("plan", search=search):
             if search == "incremental":
                 from .incremental import plan_capacity_incremental
 
@@ -884,7 +934,21 @@ class Applier:
         # "search"/"bulk" distinguish the non-reference-exact fast path)
         from ..parallel.mesh import NODE_AXIS
 
-        gauge = state_gauge()
+        # the unified metrics block (ISSUE 8): one registry delta over
+        # the plan — counters subtract, gauges report their end-of-plan
+        # level — plus the shipped candidate's audit verdict under the
+        # audit.* names (the registry's audit counters aggregate EVERY
+        # candidate's pass; the block reports the one that shipped, the
+        # same record engine.audit carries)
+        metrics = REGISTRY.delta_since(metrics_before)
+        if plan.audit:
+            for k in ("ok", "checked", "violations", "wall_s", "mode"):
+                if k in plan.audit:
+                    metrics[f"audit.{k}"] = plan.audit[k]
+        plan.metrics = metrics
+        # the legacy engine-block families below are ALIAS VIEWS of the
+        # metrics block — same numbers re-grouped, bit-equal by
+        # construction; kept for one release (pin on schema_version)
         plan.engine = {
             "search": search,
             "bulk": bool(bulk) if search != "incremental" else True,
@@ -899,7 +963,11 @@ class Applier:
             # pure observability — acceptance rate and rollback volume
             "speculate": wave_enabled(),
             "wavefront": {
-                k: wave_counts()[k] - waves_before[k] for k in waves_before
+                k: metrics.get(f"wavefront.{k}", 0)
+                for k in (
+                    "wavefronts", "pods", "accepted", "rollbacks",
+                    "rollback_pods",
+                )
             },
             # transfer + carried-state byte telemetry (ISSUE 5): blocking
             # device→host round-trips and bytes this plan paid, plus the
@@ -907,7 +975,8 @@ class Applier:
             # active layout (compact = the domain-tabular carry,
             # SIMTPU_COMPACT A/B — placements are identical either way)
             "fetch": {
-                k: fetch_counts()[k] - fetch_before[k] for k in fetch_before
+                "get": metrics.get("fetch.get", 0),
+                "bytes": metrics.get("fetch.bytes", 0),
             },
             # OOM-backoff telemetry (docs/robustness.md): caught
             # RESOURCE_EXHAUSTED events, the sub-dispatches their halving
@@ -915,24 +984,31 @@ class Applier:
             # re-dispatched at ("chunk_min" is a process-lifetime floor,
             # not a delta — 0 = no backoff this process)
             "backoff": {
-                k: (
-                    backoff_counts()[k] - backoff_before[k]
-                    if k != "chunk_min"
-                    else backoff_counts()[k]
-                )
-                for k in backoff_before
+                "events": metrics.get("backoff.events", 0),
+                "splits": metrics.get("backoff.splits", 0),
+                "chunk_min": metrics.get("backoff.chunk_min", 0),
             },
             # `compact` is the gauge's own record of what the final carry
             # actually was — NOT the SIMTPU_COMPACT default, which an
             # engine attribute or a spec with no tabular keys can override
-            # (popped so the byte breakdown under `state_bytes` holds only
+            # (kept out of `state_bytes` so the byte breakdown holds only
             # the carried/dense/per-plane numbers, not a duplicate flag)
-            "compact": gauge.pop("compact"),
-            "state_bytes": gauge,
+            "compact": metrics.get("state.compact", False),
+            "state_bytes": {
+                "carried_bytes": metrics.get("state.carried_bytes", 0),
+                "dense_bytes": metrics.get("state.dense_bytes", 0),
+                "planes": metrics.get("state.planes", {}),
+            },
             # the independent placement audit of the shipped candidate
             # (simtpu/audit): counters, plus fallback/divergence records
             # when the primary engine's answer failed certification.
             # {"enabled": False} = --no-audit / SIMTPU_AUDIT=0
             "audit": plan.audit if plan.audit else {"enabled": False},
         }
+        if self.opts.trace:
+            from ..obs.trace import export_trace
+
+            path = export_trace(self.opts.trace)
+            if progress is not None:
+                progress(f"span trace written to {path} (load in Perfetto)")
         return plan

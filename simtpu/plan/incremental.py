@@ -89,6 +89,7 @@ from ..durable.deadline import PlanInterrupted
 from ..engine.rounds import RoundsEngine
 from ..engine.scan import REASON_TEXT
 from ..engine.state import CompactState
+from ..obs.trace import span
 from .capacity import PlanResult, _env_cap, meet_resource_requests
 
 
@@ -356,15 +357,16 @@ def _plan_capacity_incremental(
         from ..workloads.expand import seed_name_hashes
 
         seed_name_hashes(name_seed(checkpoint.fingerprint))
-    tz, all_nodes, n_base, ordered = assemble_planning_problem(
-        cluster, apps, new_node, max_new, extended_resources
-    )
-    batch = tz.add_pods(ordered)
-    tensors = tz.freeze()
-    statics_from(tensors, sched_config)  # transfer device statics once
-    vocab = _vocab_of(tensors)
-    pin = np.asarray(batch.pin)
-    clone_of = pin - n_base  # >= 0 for clone-pinned (DaemonSet) pods
+    with span("plan.tensorize"):
+        tz, all_nodes, n_base, ordered = assemble_planning_problem(
+            cluster, apps, new_node, max_new, extended_resources
+        )
+        batch = tz.add_pods(ordered)
+        tensors = tz.freeze()
+        statics_from(tensors, sched_config)  # transfer device statics once
+        vocab = _vocab_of(tensors)
+        pin = np.asarray(batch.pin)
+        clone_of = pin - n_base  # >= 0 for clone-pinned (DaemonSet) pods
     timings["tensorize"] = time.perf_counter() - t0
 
     # one shape-bucket registry for every engine of this plan: probes snap
@@ -498,8 +500,9 @@ def _plan_capacity_incremental(
             }
         check()
         c0 = trace_counts()
-        eng = make_engine(valid_mask(i), plan_batch=batch)
-        nodes, reasons, extras = eng.place(batch)
+        with span("plan.candidate", count=int(i), phase=phase):
+            eng = make_engine(valid_mask(i), plan_batch=batch)
+            nodes, reasons, extras = eng.place(batch)
         failed = (nodes < 0) & ~phantom
         probes[i] = int(failed.sum())
         mark_compiles(phase, c0)
@@ -514,9 +517,10 @@ def _plan_capacity_incremental(
     # -- base candidate: i = 0 -------------------------------------------
     t0 = time.perf_counter()
     say("add 0 node(s)")
-    base_eng, base_nodes_arr, base_reasons, base_failed, base_extras = (
-        fresh_run(0, phase="base")
-    )
+    with span("plan.base"):
+        base_eng, base_nodes_arr, base_reasons, base_failed, base_extras = (
+            fresh_run(0, phase="base")
+        )
     timings["base"] = time.perf_counter() - t0
 
     def finish(i, eng, nodes_arr, reasons, extras):
@@ -687,12 +691,13 @@ def _plan_capacity_incremental(
         check()
         say(f"add {i} node(s)")
         c0 = trace_counts()
-        probe_batch = slice_batch(batch, idx)
-        eng = make_engine(valid_mask(i), plan_batch=probe_batch)
-        eng.last_state = copy_snapshot()
-        eng._last_vocab = vocab
-        eng._state_dirty = False
-        nodes, reasons, extras = eng.place(probe_batch)
+        with span("plan.candidate", count=int(i), phase="probes"):
+            probe_batch = slice_batch(batch, idx)
+            eng = make_engine(valid_mask(i), plan_batch=probe_batch)
+            eng.last_state = copy_snapshot()
+            eng._last_vocab = vocab
+            eng._state_dirty = False
+            nodes, reasons, extras = eng.place(probe_batch)
         failed = nodes < 0
         probes[i] = int(failed.sum())
         mark_compiles("probes", c0)

@@ -50,6 +50,7 @@ from ..durable.deadline import PlanInterrupted
 from ..faults.drain import PlacedCluster
 from ..faults.scenarios import generate_scenarios
 from ..faults.sweep import SweepResult, sweep_scenarios
+from ..obs.trace import span
 from .incremental import MaskedRoundsEngine
 
 
@@ -283,7 +284,8 @@ def plan_resilience(
         eng.sched_config = sched_config
         eng.bulk_shapes = shape_registry
         eng.snap_shapes = True
-        nodes, reasons, extras = eng.place(batch)
+        with span("plan.candidate", count=int(i), phase="resilience"):
+            nodes, reasons, extras = eng.place(batch)
         nodes = np.asarray(nodes)
         phantom = clone_of >= i
         base_unplaced = int(((nodes < 0) & ~phantom).sum())

@@ -16,6 +16,16 @@ os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell presets axon/tpu
 # (and anything else that wants the dispatcher) sets Engine.speculate
 # explicitly, which overrides this default.
 os.environ.setdefault("SIMTPU_WAVEFRONT", "0")
+# Flight-recorder bundles (obs/flight.py) default to the CWD when no
+# checkpoint dir is involved — under pytest that is the repo root, which
+# the exit-3/exit-4 CLI tests would litter with simtpu-flight-*.json.
+# Point the default at a per-session temp dir; tests that assert on
+# bundles override SIMTPU_FLIGHT_DIR themselves (monkeypatch wins).
+import tempfile  # noqa: E402
+
+os.environ.setdefault(
+    "SIMTPU_FLIGHT_DIR", tempfile.mkdtemp(prefix="simtpu-flight-tests-")
+)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

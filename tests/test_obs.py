@@ -1,0 +1,476 @@
+"""The unified observability layer (ISSUE 8, docs/observability.md):
+
+- span tracer: nesting, thread-safety under the AOT pool, ring-buffer
+  wraparound, Perfetto (Chrome trace-event) export validity, and the
+  zero-overhead no-op contract when disabled;
+- metrics registry: typed instruments, snapshot/delta protocol, and the
+  legacy alias views (`fetch_counts` / `trace_counts` / `wave_counts` /
+  `backoff_counts` / `state_gauge`) staying bit-equal to the registry
+  across the wavefront/compact engine A/Bs;
+- flight recorder: a bundle lands on the injected exit-3 (deadline) and
+  exit-4 (audit divergence) CLI paths, and SIMTPU_FLIGHT=0 disables it;
+- CLI surface: `apply --trace` writes a valid trace whose span sums
+  reconcile with the --json phase timings, the --json document carries
+  `schema_version` + the `metrics` block with the legacy engine-block
+  families as bit-equal aliases, and `simtpu version --json` reports the
+  schema stamp.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from simtpu.obs import trace as obs_trace
+from simtpu.obs.metrics import REGISTRY, SCHEMA_VERSION, MetricsRegistry
+
+
+@pytest.fixture
+def tracer():
+    """Fresh tracer for a test; restores the prior (disabled) state."""
+    was = obs_trace.enabled()
+    obs_trace.enable()
+    yield obs_trace
+    if not was:
+        obs_trace.disable()
+
+
+class TestSpanTracer:
+    def test_nesting_depth_and_containment(self, tracer):
+        with obs_trace.span("outer", phase="x"):
+            with obs_trace.span("inner"):
+                pass
+        evs = {e[0]: e for e in obs_trace.events()}
+        assert set(evs) == {"outer", "inner"}
+        name, ts_o, dur_o, _, depth_o, attrs = evs["outer"]
+        _, ts_i, dur_i, _, depth_i, _ = evs["inner"]
+        assert depth_o == 0 and depth_i == 1
+        assert attrs == {"phase": "x"}
+        # the inner interval is contained in the outer one
+        assert ts_o <= ts_i and ts_i + dur_i <= ts_o + dur_o
+
+    def test_mid_span_attributes(self, tracer):
+        with obs_trace.span("s", a=1) as sp:
+            sp.set(b=2)
+        ((_, _, _, _, _, attrs),) = obs_trace.events()
+        assert attrs == {"a": 1, "b": 2}
+
+    def test_thread_safety_many_threads(self, tracer):
+        """Concurrent spans from worker threads lose no events and keep
+        per-thread nesting depths (the AOT pool regime)."""
+        n_threads, per_thread = 8, 50
+
+        def work():
+            for _ in range(per_thread):
+                with obs_trace.span("t.outer"):
+                    with obs_trace.span("t.inner"):
+                        pass
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        evs = obs_trace.events()
+        assert len(evs) == n_threads * per_thread * 2
+        for name, _, _, _, depth, _ in evs:
+            assert depth == (1 if name == "t.inner" else 0)
+        # at least two distinct recording threads (idents are REUSED when
+        # a thread exits before a later one starts, so == n_threads would
+        # be flaky by scheduler luck)
+        assert len({tid for _, _, _, tid, _, _ in evs}) >= 2
+
+    def test_aot_pool_compile_spans(self, tracer):
+        """The precompile pipeline's per-signature compile spans are
+        recorded FROM the pool threads (engine/precompile.py)."""
+        import jax
+        import jax.numpy as jnp
+
+        from simtpu.engine.precompile import AotPipeline, _sds
+
+        pipe = AotPipeline(workers=2)
+        try:
+            fn = jax.jit(lambda x: x * 2)
+            assert pipe.submit("obs_test", (), fn, (_sds((4,), jnp.int32),))
+            pipe.wait_all(timeout=60)
+        finally:
+            pipe.shutdown()
+        spans = [e for e in obs_trace.events() if e[0] == "aot.compile"]
+        assert len(spans) == 1
+        assert spans[0][5]["sig"] == "obs_test"
+        assert spans[0][3] != threading.get_ident(), "span must be on a pool thread"
+
+    def test_ring_wraparound_keeps_newest(self):
+        obs_trace.enable(capacity=8)
+        try:
+            for i in range(20):
+                with obs_trace.span(f"s{i}"):
+                    pass
+            evs = obs_trace.events()
+            assert [e[0] for e in evs] == [f"s{i}" for i in range(12, 20)]
+            assert obs_trace.dropped() == 12
+            # timestamps stay chronological across the wrap
+            ts = [e[1] for e in evs]
+            assert ts == sorted(ts)
+        finally:
+            obs_trace.disable()
+
+    def test_perfetto_export_valid(self, tracer, tmp_path):
+        with obs_trace.span("a", pods=3):
+            obs_trace.instant("mark", n=1)
+        path = obs_trace.export_trace(str(tmp_path / "t.json"))
+        with open(path) as f:
+            doc = json.loads(f.read())
+        events = doc["traceEvents"]
+        assert isinstance(events, list) and events
+        for ev in events:
+            for key in ("name", "ph", "pid", "tid"):
+                assert key in ev
+        complete = [e for e in events if e["ph"] == "X"]
+        assert len(complete) == 1 and complete[0]["name"] == "a"
+        assert complete[0]["args"]["pods"] == 3
+        assert isinstance(complete[0]["ts"], int)
+        assert complete[0]["dur"] >= 1
+        instants = [e for e in events if e["ph"] == "i"]
+        assert len(instants) == 1 and instants[0]["name"] == "mark"
+        # thread-name metadata rides along for the Perfetto lane labels
+        assert any(e["ph"] == "M" and e["name"] == "thread_name" for e in events)
+
+    def test_noop_mode_no_allocation_no_events(self):
+        obs_trace.disable()
+        # one shared singleton — no per-span object when disabled
+        assert obs_trace.span("a") is obs_trace.span("b", x=1)
+        with obs_trace.span("c") as sp:
+            sp.set(y=2)  # signature parity: attribute sets are no-ops too
+        obs_trace.instant("d")
+        assert obs_trace.events() == []
+        assert not obs_trace.enabled()
+
+    def test_span_summary_orders_by_total(self, tracer):
+        import time
+
+        for _ in range(3):
+            with obs_trace.span("fast"):
+                pass
+        with obs_trace.span("slow"):
+            time.sleep(0.02)
+        rows = obs_trace.span_summary(top=10)
+        assert rows[0]["name"] == "slow"
+        fast = next(r for r in rows if r["name"] == "fast")
+        assert fast["count"] == 3
+
+
+class TestMetricsRegistry:
+    def test_instrument_semantics_and_delta(self):
+        reg = MetricsRegistry()
+        reg.counter("c").inc()
+        reg.counter("c").inc(4)
+        reg.gauge("g").set({"x": 1})
+        reg.histogram("h").observe(2.0)
+        reg.histogram("h").observe(6.0)
+        snap = reg.snapshot()
+        assert snap["c"] == 5
+        assert snap["g"] == {"x": 1}
+        assert snap["h"] == {"count": 2, "total": 8.0, "min": 2.0, "max": 6.0}
+        before = snap
+        reg.counter("c").inc(2)
+        reg.gauge("g").set(7)
+        reg.histogram("h").observe(1.0)
+        delta = reg.delta_since(before)
+        assert delta["c"] == 2  # counters are flows
+        assert delta["g"] == 7  # gauges are levels
+        assert delta["h"]["count"] == 1 and delta["h"]["total"] == 1.0
+
+    def test_type_conflict_refuses(self):
+        reg = MetricsRegistry()
+        reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x")
+
+    def test_snapshot_never_aliases_live_dicts(self):
+        reg = MetricsRegistry()
+        reg.gauge("g").set({"a": 1})
+        snap = reg.snapshot()
+        snap["g"]["a"] = 99
+        assert reg.snapshot()["g"] == {"a": 1}
+
+
+@pytest.fixture(scope="module")
+def problem():
+    from simtpu.synth import synth_apps, synth_cluster
+    from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
+
+    cluster = synth_cluster(16, seed=71, zones=4, taint_frac=0.1)
+    apps = synth_apps(
+        48, seed=72, zones=4, pods_per_deployment=12,
+        anti_affinity_frac=0.2, spread_frac=0.3,
+    )
+    pods = []
+    for app in apps:
+        pods.extend(get_valid_pods_exclude_daemonset(app.resource))
+    return cluster, pods
+
+
+class TestRegistryAliases:
+    """The five legacy counter families are ALIAS VIEWS of the registry:
+    same keys, values bit-equal — across the wavefront and compact-carry
+    engine A/Bs (the GSPMD shard A/B rides the same counters through
+    tests/test_telemetry.py's sharded-plan cases)."""
+
+    @pytest.mark.parametrize("speculate", [False, True])
+    @pytest.mark.parametrize("compact", [False, True])
+    def test_aliases_bit_equal_after_placement(
+        self, problem, speculate, compact
+    ):
+        from simtpu.core.tensorize import Tensorizer
+        from simtpu.durable.backoff import backoff_counts
+        from simtpu.engine.scan import (
+            Engine,
+            fetch_counts,
+            trace_counts,
+            wave_counts,
+        )
+        from simtpu.engine.state import state_gauge
+
+        cluster, pods = problem
+        before = REGISTRY.snapshot()
+        tz = Tensorizer(cluster.nodes, storage_classes=cluster.storage_classes)
+        eng = Engine(tz)
+        eng.speculate = speculate
+        eng.compact = compact
+        nodes, _, _ = eng.place(tz.add_pods(pods))
+
+        fetch = fetch_counts()
+        assert fetch == {
+            "get": REGISTRY.value("fetch.get"),
+            "bytes": REGISTRY.value("fetch.bytes"),
+        }
+        assert fetch["get"] > before.get("fetch.get", 0)
+        assert fetch["bytes"] - before.get("fetch.bytes", 0) >= nodes.size * 4
+
+        waves = wave_counts()
+        assert waves == {
+            k: REGISTRY.value(f"wavefront.{k}")
+            for k in (
+                "wavefronts", "pods", "accepted", "rollbacks",
+                "rollback_pods",
+            )
+        }
+        if speculate:
+            assert waves["pods"] > before.get("wavefront.pods", 0)
+        # accept/rollback accounting is complete: every drafted pod is
+        # either accepted or rolled back
+        assert waves["accepted"] + waves["rollback_pods"] == waves["pods"]
+
+        traces = trace_counts()
+        assert traces == {
+            k: REGISTRY.value(f"compile.{k}")
+            for k in ("scan", "rounds", "wave")
+        }
+
+        gauge = state_gauge()
+        assert gauge["carried_bytes"] == REGISTRY.value("state.carried_bytes")
+        assert gauge["compact"] == REGISTRY.value("state.compact")
+        assert gauge["carried_bytes"] == sum(gauge["planes"].values())
+
+        back = backoff_counts()
+        assert back == {
+            "events": REGISTRY.value("backoff.events"),
+            "splits": REGISTRY.value("backoff.splits"),
+            "chunk_min": REGISTRY.value("backoff.chunk_min"),
+        }
+
+    def test_compact_ab_same_placements_different_gauge(self, problem):
+        from simtpu.core.tensorize import Tensorizer
+        from simtpu.engine.rounds import RoundsEngine
+        from simtpu.engine.state import state_gauge
+
+        cluster, pods = problem
+        results = {}
+        for compact in (True, False):
+            tz = Tensorizer(
+                cluster.nodes, storage_classes=cluster.storage_classes
+            )
+            eng = RoundsEngine(tz)
+            eng.compact = compact
+            nodes, _, _ = eng.place(tz.add_pods(pods))
+            results[compact] = (np.asarray(nodes), state_gauge())
+        assert np.array_equal(results[True][0], results[False][0])
+        assert results[True][1]["compact"] is True
+        assert results[False][1]["compact"] is False
+
+
+class TestFlightRecorder:
+    def test_bundle_document_shape(self, tmp_path, monkeypatch, tracer):
+        monkeypatch.setenv("SIMTPU_FLIGHT_DIR", str(tmp_path))
+        from simtpu.obs.flight import dump_flight
+
+        with obs_trace.span("pre-crash"):
+            pass
+        path = dump_flight("test reason", 3, engine={"search": "binary"})
+        assert path and os.path.isfile(path)
+        doc = json.load(open(path))
+        assert doc["format"] == "simtpu-flight-v1"
+        assert doc["reason"] == "test reason"
+        assert doc["exit_code"] == 3
+        assert doc["schema_version"] == SCHEMA_VERSION
+        assert doc["engine"] == {"search": "binary"}
+        assert isinstance(doc["metrics"], dict)
+        names = [
+            e["name"] for e in doc["spans"]["traceEvents"] if e["ph"] == "X"
+        ]
+        assert "pre-crash" in names
+
+    def test_flight_disabled_by_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SIMTPU_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("SIMTPU_FLIGHT", "0")
+        from simtpu.obs.flight import dump_flight
+
+        assert dump_flight("r", 4) is None
+        assert not glob.glob(str(tmp_path / "simtpu-flight-*.json"))
+
+    def test_flight_lands_next_to_checkpoint_dir(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.delenv("SIMTPU_FLIGHT_DIR", raising=False)
+        from simtpu.obs.flight import dump_flight
+
+        ck = tmp_path / "nested" / "ck"
+        ck.mkdir(parents=True)
+        path = dump_flight("r", 3, checkpoint=str(ck))
+        assert os.path.dirname(path) == str(tmp_path / "nested")
+
+    def test_cli_exit_3_dumps_bundle(self, tmp_path, monkeypatch, capsys):
+        """--deadline 0 = injected partial exit (3): the flight bundle
+        lands next to the checkpoint dir with the partial reason."""
+        from simtpu.cli import EXIT_PARTIAL, main
+
+        monkeypatch.setenv("SIMTPU_FLIGHT_DIR", str(tmp_path / "fl"))
+        rc = main([
+            "apply", "-f", "examples/simtpu-config.yaml", "--json",
+            "--deadline", "0", "--checkpoint", str(tmp_path / "ck"),
+        ])
+        capsys.readouterr()
+        assert rc == EXIT_PARTIAL
+        (path,) = glob.glob(str(tmp_path / "fl" / "simtpu-flight-*.json"))
+        doc = json.load(open(path))
+        assert doc["exit_code"] == EXIT_PARTIAL
+        assert "partial" in doc["reason"]
+        assert isinstance(doc["metrics"], dict)
+
+    @pytest.mark.slow
+    def test_cli_exit_4_dumps_bundle(self, tmp_path, monkeypatch, capsys):
+        """SIMTPU_AUDIT_INJECT=1 = injected audit divergence (exit 4):
+        the bundle carries the engine block and the buffered spans."""
+        from simtpu.cli import EXIT_AUDIT, main
+
+        monkeypatch.setenv("SIMTPU_FLIGHT_DIR", str(tmp_path / "fl"))
+        monkeypatch.setenv("SIMTPU_AUDIT_INJECT", "1")
+        obs_trace.enable()
+        try:
+            rc = main([
+                "apply", "-f", "examples/simtpu-config.yaml", "--json",
+            ])
+        finally:
+            obs_trace.disable()
+        capsys.readouterr()
+        assert rc == EXIT_AUDIT
+        (path,) = glob.glob(str(tmp_path / "fl" / "simtpu-flight-*.json"))
+        doc = json.load(open(path))
+        assert doc["exit_code"] == EXIT_AUDIT
+        assert "audit" in doc["reason"]
+        assert doc["engine"]["audit"]["fallback"] is True
+        assert [
+            e for e in doc["spans"]["traceEvents"] if e["ph"] == "X"
+        ], "armed tracer's spans must ride the bundle"
+
+
+class TestCLIObs:
+    def test_version_json_schema_stamp(self, capsys):
+        from simtpu import __version__
+        from simtpu.cli import main
+
+        assert main(["version", "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc == {
+            "version": __version__, "schema_version": SCHEMA_VERSION,
+        }
+
+    def test_apply_trace_json_reconciles(self, tmp_path, capsys):
+        """The ISSUE-8 acceptance run: one `apply --trace t.json --json`
+        on the examples yields (a) a Perfetto-valid trace whose
+        ingest/plan span wall-clock reconciles with the --json phase
+        timings within 5%, and (b) a metrics block whose values the
+        legacy engine-block families alias bit-equally."""
+        from simtpu.cli import main
+
+        tpath = str(tmp_path / "t.json")
+        rc = main([
+            "apply", "-f", "examples/simtpu-config.yaml", "--json",
+            "--trace", tpath,
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0
+        doc = json.loads(out)
+        assert doc["schema_version"] == SCHEMA_VERSION
+        m, e = doc["metrics"], doc["engine"]
+
+        # (b) every legacy counter family under the unified schema,
+        # values bit-equal to the legacy engine-block fields
+        assert e["fetch"] == {"get": m["fetch.get"], "bytes": m["fetch.bytes"]}
+        assert e["backoff"] == {
+            "events": m["backoff.events"],
+            "splits": m["backoff.splits"],
+            "chunk_min": m["backoff.chunk_min"],
+        }
+        assert e["wavefront"] == {
+            k: m[f"wavefront.{k}"] for k in e["wavefront"]
+        }
+        assert e["compact"] == m["state.compact"]
+        assert e["state_bytes"] == {
+            "carried_bytes": m["state.carried_bytes"],
+            "dense_bytes": m["state.dense_bytes"],
+            "planes": m["state.planes"],
+        }
+        for k in ("ok", "checked", "violations", "wall_s", "mode"):
+            assert m[f"audit.{k}"] == e["audit"][k]
+        assert any(k.startswith("compile.") for k in m)
+
+        # (a) Perfetto-valid trace whose phase spans reconcile with the
+        # --json timings within 5%
+        trace = json.load(open(tpath))
+        complete = [x for x in trace["traceEvents"] if x["ph"] == "X"]
+        assert complete
+        sums = {}
+        for x in complete:
+            sums[x["name"]] = sums.get(x["name"], 0.0) + x["dur"] / 1e6
+        for phase in ("ingest", "plan"):
+            span_s, json_s = sums[phase], doc["timings"][phase]
+            assert span_s == pytest.approx(json_s, rel=0.05), phase
+        # the engine layers all reported in: dispatch chunks, audit
+        names = set(sums)
+        assert {"tensorize", "expand", "audit.pass"} <= names
+        assert "scan.chunk" in names or "rounds.chunk" in names
+
+    def test_simulate_trace_kwarg_exports(self, tmp_path, problem):
+        from simtpu.api import simulate
+        from simtpu.core.objects import ResourceTypes
+
+        cluster, pods = problem
+        trial = ResourceTypes(**{k: list(v) for k, v in vars(cluster).items()})
+        trial.pods = list(pods[:24])
+        tpath = str(tmp_path / "sim.json")
+        # an earlier CLI --trace run leaves the process tracer armed (by
+        # design — flight-recorder visibility); this test is about the
+        # own-tracer path, so start from the disabled state
+        obs_trace.disable()
+        simulate(trial, trace=tpath)
+        assert not obs_trace.enabled(), "simulate() must disarm its own tracer"
+        doc = json.load(open(tpath))
+        names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+        assert {"tensorize", "expand", "schedule.cluster"} <= names

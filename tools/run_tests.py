@@ -23,19 +23,48 @@ Hang safety (ISSUE 6): any test running longer than --timeout seconds
 every thread's stack to the module's captured output, and a module still
 alive 25% past the budget is killed with whatever it printed — a hung
 tier-1 run produces STACKS, never a silent kill.
+
+Span observability (ISSUE 8): with SIMTPU_TRACE=1 each module
+subprocess arms the simtpu span tracer and exports its Chrome trace to a
+temp file at exit (obs/trace.py init_from_env); the runner aggregates
+every module's spans and prints the top-10 slowest span names — where
+the suite's wall-clock goes INSIDE the engine, not just per module.
 """
 
 from __future__ import annotations
 
 import argparse
 import glob
+import json
 import os
 import re
 import subprocess
 import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _aggregate_spans(trace_paths):
+    """name -> [count, total_s, max_s] over every module's exported
+    Chrome trace (missing/corrupt files are skipped — a module that
+    crashed before its atexit export must not hide the others)."""
+    agg = {}
+    for path in trace_paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        for ev in doc.get("traceEvents", ()):
+            if ev.get("ph") != "X":
+                continue
+            row = agg.setdefault(ev["name"], [0, 0.0, 0.0])
+            row[0] += 1
+            row[1] += ev.get("dur", 0) / 1e6
+            row[2] = max(row[2], ev.get("dur", 0) / 1e6)
+    return agg
 
 
 def main() -> int:
@@ -69,12 +98,26 @@ def main() -> int:
         # below is the backstop
         extra += ["-o", f"faulthandler_timeout={args.timeout:g}"]
 
+    # SIMTPU_TRACE=1: every module subprocess exports its span trace to a
+    # temp file (obs/trace.py: SIMTPU_TRACE=<path> arms + atexit-exports)
+    # for the slowest-spans summary after the run
+    span_tracing = os.environ.get("SIMTPU_TRACE", "") == "1"
+    trace_dir = tempfile.mkdtemp(prefix="simtpu-trace-") if span_tracing else None
+    trace_paths = []
+
     totals = {"passed": 0, "failed": 0, "errors": 0, "skipped": 0, "deselected": 0}
     failures = []
     timings = []  # (seconds, module) for the slowest-modules summary
     t_all = time.perf_counter()
     for mod in modules:
         rel = os.path.relpath(mod, REPO)
+        env = None
+        if span_tracing:
+            tpath = os.path.join(
+                trace_dir, os.path.basename(rel) + ".trace.json"
+            )
+            trace_paths.append(tpath)
+            env = dict(os.environ, SIMTPU_TRACE=tpath)
         t0 = time.perf_counter()
         timed_out = False
         try:
@@ -84,6 +127,7 @@ def main() -> int:
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
                 text=True,
+                env=env,
                 timeout=args.timeout * 1.25 if args.timeout > 0 else None,
             )
             out, rc = proc.stdout, proc.returncode
@@ -128,6 +172,19 @@ def main() -> int:
         print("slowest modules:")
         for dt, rel in slowest:
             print(f"  {dt:7.1f}s  {rel}  ({dt / max(wall, 1e-9) * 100:.0f}%)")
+    if span_tracing:
+        # ... and where it goes INSIDE the engine: the top-10 slowest
+        # span names aggregated over every module's exported trace
+        # (obs/trace.py; ISSUE 8)
+        agg = _aggregate_spans(trace_paths)
+        rows = sorted(agg.items(), key=lambda kv: -kv[1][1])[:10]
+        if rows:
+            print("slowest spans (SIMTPU_TRACE=1, all modules):")
+            for name, (count, total_s, max_s) in rows:
+                print(
+                    f"  {total_s:8.2f}s  {name:24s} x{count}  "
+                    f"(max {max_s:.3f}s)"
+                )
     if failures:
         print("failing modules: " + ", ".join(failures))
         return 1
