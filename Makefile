@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all install lint test test-all test-perf bench bench-cold bench-faults bench-layout bench-durable bench-audit bench-obs fuzz-smoke clean
+.PHONY: all install lint test test-all test-perf bench bench-cold bench-faults bench-layout bench-durable bench-audit bench-obs bench-explain fuzz-smoke clean
 
 all: test
 
@@ -118,6 +118,22 @@ bench-obs:
 	SIMTPU_BENCH_PLAN=0 SIMTPU_BENCH_BIG=0 SIMTPU_BENCH_FAULTS=0 \
 	SIMTPU_BENCH_LAYOUT=0 SIMTPU_BENCH_DURABLE=0 SIMTPU_BENCH_AUDIT=0 \
 	$(PY) bench.py
+
+# decision-observability smoke (mirrors bench-obs): one fuzz-generated
+# gnarly case placed with and without the explain pipeline, ASSERTING
+# bit-identical placements, per-stage elimination counts that sum to N
+# and match the pure-numpy twin, a named binding resource, consistent
+# score attribution, and the explain-pass overhead bound —
+# explain_s / explain_pods / explain_groups land in the JSON line
+# (CI runs this alongside the fast tier)
+bench-explain:
+	SIMTPU_BENCH_EXPLAIN=1 SIMTPU_BENCH_EXPLAIN_ASSERT=1 \
+	SIMTPU_BENCH_NODES=500 SIMTPU_BENCH_PODS=2000 \
+	SIMTPU_BENCH_SCAN_PODS=200 SIMTPU_BENCH_BASELINE_PODS=50 \
+	SIMTPU_BENCH_SMALL=0 SIMTPU_BENCH_HARD=0 SIMTPU_BENCH_MATRIX=0 \
+	SIMTPU_BENCH_PLAN=0 SIMTPU_BENCH_BIG=0 SIMTPU_BENCH_FAULTS=0 \
+	SIMTPU_BENCH_LAYOUT=0 SIMTPU_BENCH_DURABLE=0 SIMTPU_BENCH_AUDIT=0 \
+	SIMTPU_BENCH_OBS=0 $(PY) bench.py
 
 # differential fuzz over the fixed seed corpus at small shapes, across
 # the FULL engine-config matrix — 8 forced host devices arm the

@@ -72,7 +72,7 @@ ride every run's JSON line).
 Byte telemetry rides every run: `fetch_bytes` (device→host payload of one
 warm placement, next to the `fetches` round-trip count),
 `engine_state_bytes` (the carried scheduling state under the active
-layout, per-plane gauge via engine/state.py `state_gauge`), and
+layout, per-plane gauge via the registry's `state.*` gauges), and
 `device_peak_bytes` (accelerator memory_stats high-water; None on CPU).
 """
 
@@ -579,6 +579,142 @@ def obs_point() -> dict:
     }
 
 
+def explain_point() -> dict:
+    """Decision-observability smoke (ISSUE 13, simtpu/explain): one
+    fuzz-generated gnarly case (the audit fuzzer's generator) made
+    partially infeasible, placed twice — plain, and with the full explain
+    pipeline (failure breakdown + bottleneck + capped score attribution)
+    after it.  Asserts (`SIMTPU_BENCH_EXPLAIN_ASSERT=1`, the `make
+    bench-explain` smoke): placements bit-identical with explain on/off
+    (explanation never perturbs the engine), every unplaced pod's
+    per-stage elimination counts (+ feasible survivors) sum to N and
+    match the pure-numpy twin, and the explain wall stays bounded
+    relative to the placement wall (the off path is separately pinned to
+    ZERO extra dispatches by tests/test_explain.py).  JSON keys:
+    explain_s / explain_pods / explain_groups."""
+    from simtpu.audit.fuzz import gen_case
+    from simtpu.core.tensorize import Tensorizer
+    from simtpu.engine.scan import Engine
+    from simtpu.explain import (
+        attribute_scores,
+        bottleneck_analysis,
+        explain_failures,
+        extras_from_log,
+    )
+    from simtpu.synth import make_deployment
+    from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
+
+    n_nodes = int(os.environ.get("SIMTPU_BENCH_EXPLAIN_NODES", 200))
+    n_pods = int(os.environ.get("SIMTPU_BENCH_EXPLAIN_PODS", 1_200))
+    note(f"explain point: gnarly {n_nodes} nodes x {n_pods} pods")
+    cluster, apps, _mix = gen_case(seed=13, n_nodes=n_nodes, n_pods=n_pods)
+    # strand pods on two axes: a deployment no node can hold (resources)
+    # rides on top of whatever hard anti-affinity/spread pressure the
+    # drawn mix already creates
+    apps[0].resource.deployments.append(
+        make_deployment("bench-fat", 4, 50_000_000, 16)
+    )
+    pods = []
+    for app in apps:
+        pods.extend(get_valid_pods_exclude_daemonset(app.resource))
+
+    def place():
+        # the SERIAL-equivalent engine: score attribution's prefix-state
+        # exactness (argmax == recorded node) is a serial-scan contract —
+        # the bulk rounds engine deliberately tie-breaks differently
+        tz = Tensorizer(cluster.nodes, storage_classes=cluster.storage_classes)
+        eng = Engine(tz)
+        batch = tz.add_pods(pods)
+        t0 = time.perf_counter()
+        nodes, reasons, extras = eng.place(batch)
+        nodes = np.asarray(nodes)
+        return tz, eng, batch, nodes, np.asarray(reasons), extras, (
+            time.perf_counter() - t0
+        )
+
+    place()  # untimed warmup (compiles)
+    _, _, _, nodes_a, _, _, _ = place()
+    tz, eng, batch, nodes_b, reasons, extras, place_s = place()
+    identical = bool(np.array_equal(nodes_a, nodes_b))
+    tensors = tz.freeze()
+    unplaced = np.flatnonzero(nodes_b < 0)
+    state = eng.carried_state()
+
+    def run_explain():
+        t0 = time.perf_counter()
+        bd = explain_failures(tensors, batch, unplaced, state, reasons=reasons)
+        bn = bottleneck_analysis(
+            tensors, batch, nodes_b, reasons, rows=unplaced,
+            free=np.asarray(state.free),
+        )
+        scores = attribute_scores(
+            tensors, batch, nodes_b,
+            extras_from_log(tensors, nodes_b, eng.ext_log), max_pods=4,
+        )
+        return bd, bn, scores, time.perf_counter() - t0
+
+    # cold first (traces the pow2-chunk + per-pod executables), then the
+    # warm steady-state wall the overhead bound is about
+    _, _, _, explain_cold_s = run_explain()
+    bd, bn, scores, explain_s = run_explain()
+    # the on/off identity must compare a placement AFTER the explain
+    # pipeline ran against one before it — comparing two pre-explain
+    # placements would pass even if explaining polluted shared state
+    _, _, _, nodes_c, _, _, _ = place()
+    identical = identical and bool(np.array_equal(nodes_b, nodes_c))
+
+    n_valid = bd.n_nodes
+    sums = bd.counts.sum(axis=1) + bd.feasible
+    sum_ok = bool(np.all(sums == n_valid))
+    prev = os.environ.get("SIMTPU_EXPLAIN_JIT")
+    os.environ["SIMTPU_EXPLAIN_JIT"] = "0"
+    try:
+        twin = explain_failures(tensors, batch, unplaced, state, reasons=reasons)
+    finally:
+        if prev is None:
+            os.environ.pop("SIMTPU_EXPLAIN_JIT", None)
+        else:
+            os.environ["SIMTPU_EXPLAIN_JIT"] = prev
+    twin_ok = bool(
+        np.array_equal(bd.counts, twin.counts)
+        and np.array_equal(bd.feasible, twin.feasible)
+        and np.array_equal(bd.fail_code, twin.fail_code)
+    )
+    groups = bd.to_doc().get("groups", [])
+    note(
+        f"explain: {len(unplaced)} unplaced pods in {explain_s:.3f}s warm "
+        f"({explain_cold_s:.2f}s cold, placement {place_s:.2f}s), "
+        f"{len(groups)} failure shape(s), sum-to-N={sum_ok} twin={twin_ok} "
+        f"identical={identical}, {len(scores)} pods score-attributed"
+    )
+    if os.environ.get("SIMTPU_BENCH_EXPLAIN_ASSERT", "0") == "1":
+        assert identical, "an explain run changed placements"
+        assert len(unplaced) > 0, "the gnarly case must strand pods"
+        assert sum_ok, f"per-stage counts do not sum to N: {sums[:8]} vs {n_valid}"
+        assert twin_ok, "jit pass diverged from the pure-numpy twin"
+        assert bn.get("binding"), "bottleneck found no binding resource"
+        assert all(s["consistent"] for s in scores), (
+            "score attribution argmax diverged from the recorded node"
+        )
+        # overhead bound: explaining every unplaced pod must stay well
+        # under the placement it explains (one vmapped pass per 64 pods)
+        assert explain_s < 0.5 * place_s + 1.0, (
+            f"explain pass took {explain_s:.2f}s against a {place_s:.2f}s "
+            "placement — over the overhead bound"
+        )
+    return {
+        "explain_nodes": n_nodes,
+        "explain_s": round(explain_s, 3),
+        "explain_cold_s": round(explain_cold_s, 3),
+        "explain_pods": int(len(unplaced)),
+        "explain_groups": len(groups),
+        "explain_sum_ok": sum_ok,
+        "explain_twin_ok": twin_ok,
+        "explain_identical": identical,
+        "explain_scored": len(scores),
+    }
+
+
 def audit_point() -> dict:
     """Trust-but-verify smoke (ISSUE 7, docs/robustness.md): (1)
     mutation-kill — corrupt accepted placements across every corruption
@@ -673,9 +809,15 @@ def durable_point() -> dict:
         PlanCheckpoint,
         PlanInterrupted,
         RunControl,
-        backoff_counts,
         plan_fingerprint,
     )
+    from simtpu.obs.metrics import family as metrics_family
+
+    from simtpu.durable.backoff import BACKOFF_KEYS
+
+    def backoff_counts():
+        # registry-backed backoff counters (obs/metrics.py)
+        return metrics_family("backoff", BACKOFF_KEYS)
     from simtpu.engine.rounds import RoundsEngine
     from simtpu.plan.incremental import plan_capacity_incremental
     from simtpu.synth import make_node, synth_apps
@@ -1068,15 +1210,13 @@ def main() -> int:
         tensorize_s,
     ) = build_problem(n_nodes, n_pods)
 
-    from simtpu.engine.scan import flags_from
+    from simtpu.engine.scan import WAVE_KEYS, flags_from
     from simtpu.obs.metrics import REGISTRY
+    from simtpu.obs.metrics import family as metrics_family
 
     def wave_counts():
         # registry-backed speculation counters (obs/metrics.py)
-        return {
-            k.split(".", 1)[1]: v
-            for k, v in REGISTRY.snapshot("wavefront.").items()
-        }
+        return metrics_family("wavefront", WAVE_KEYS)
 
     precompile = _bench_precompile()
     note("problem built; timing scan slice (pod-at-a-time floor)")
@@ -1258,6 +1398,16 @@ def main() -> int:
         except Exception as exc:  # noqa: BLE001 - report, keep the line
             note(f"obs point failed: {type(exc).__name__}: {exc}")
             record["obs_error"] = f"{type(exc).__name__}: {exc}"
+    # decision-observability smoke (ISSUE 13): on by default at north-star
+    # runs, SIMTPU_BENCH_EXPLAIN=1 forces it at any configuration (`make
+    # bench-explain` = the small-shape asserting smoke), =0 skips
+    explain_env = os.environ.get("SIMTPU_BENCH_EXPLAIN", "")
+    if explain_env != "0" and (north_star or explain_env == "1"):
+        try:
+            record.update(explain_point())
+        except Exception as exc:  # noqa: BLE001 - report, keep the line
+            note(f"explain point failed: {type(exc).__name__}: {exc}")
+            record["explain_error"] = f"{type(exc).__name__}: {exc}"
     # OOM-backoff telemetry (durable/backoff.py): process-lifetime
     # counters — nonzero only when a dispatch really hit
     # RESOURCE_EXHAUSTED (or the durable point injected one)
@@ -1275,7 +1425,7 @@ def main() -> int:
         key in record
         for key in (
             "plan_error", "big_point_error", "fault_error", "layout_error",
-            "durable_error", "audit_error", "obs_error",
+            "durable_error", "audit_error", "obs_error", "explain_error",
         )
     ) else 0
 

@@ -176,6 +176,10 @@ class Simulator:
         self._placed_forced: List[bool] = []
         self._preempted: List[PreemptedPod] = []
         self._unscheduled: List[UnscheduledPod] = []
+        # recorded failure codes, index-parallel to _unscheduled — the
+        # legacy headline reasons the explain pass keeps bit-equal
+        # (simtpu/explain)
+        self._unscheduled_codes: List[int] = []
         self._storage_classes: List[dict] = []
         self._pdbs: List[dict] = []
 
@@ -248,6 +252,9 @@ class Simulator:
         self._placed_forced.append(forced)
 
     def _record_failed(self, pod: dict, reason: int, note: str = "") -> None:
+        # the .get fallback is provably unreachable: every FAIL_* code has
+        # a REASON_TEXT entry (engine/scan._check_reason_text fails the
+        # import otherwise) and reasons here come from the engine's codes
         msg = REASON_TEXT.get(int(reason), "unschedulable")
         if note:
             msg = f"{msg} ({note})"
@@ -260,6 +267,7 @@ class Simulator:
                 ),
             )
         )
+        self._unscheduled_codes.append(int(reason))
 
     def _schedule_pods(self, pods: Sequence[dict]) -> None:
         # Only default-scheduler pods enter the *scheduling* path: the
@@ -1003,6 +1011,66 @@ class Simulator:
     def _write_extended_annotations(self, nodes: List[dict]) -> None:
         write_extended_annotations(self._tensorizer.ext, self._engine.ext_log, nodes)
 
+    # -- decision observability (simtpu/explain) ---------------------------
+
+    def explain_result(self, opts: Optional[dict] = None) -> dict:
+        """The versioned explain block for this simulation's unscheduled
+        pods: the per-stage failure breakdown (against the end-of-run
+        carried state) plus the binding-constraint bottleneck analysis.
+
+        `opts` keys (all optional): `top` — failure-shape groups kept
+        (default 10); `new_node`/`daemon_sets`/`corrected` — the capacity
+        planners' template context, folded into the bottleneck's
+        can-another-node-ever-help verdict.  Pure read: re-adding the
+        already-interned unscheduled pods grows no vocabulary and the
+        carried state is only peeked (`Engine.carried_state`)."""
+        import numpy as np
+
+        from .explain import build_explain_doc
+
+        opts = opts or {}
+        if not self._unscheduled or self._engine is None:
+            # nothing to explain: return a FALSY doc so callers' `if
+            # explain_block:` guards skip it — a successful plan must not
+            # print/emit a vestigial version-only stub
+            return {}
+        with span("explain", pods=len(self._unscheduled)):
+            pods = [u.pod for u in self._unscheduled]
+            codes = np.asarray(self._unscheduled_codes, np.int32)
+            batch = self._tensorizer.add_pods(pods)
+            tensors = self._tensorizer.freeze()
+            node_valid = (
+                np.asarray(self._engine.node_valid, bool)
+                if self._engine.node_valid is not None
+                else None
+            )
+            try:
+                state = self._engine.carried_state()
+            except ValueError:
+                # a preemption fallback left the carry dirty (rebuild-on-
+                # next-place) — the placement log is still authoritative
+                state = None
+            if state is None:
+                from .engine.state import build_state
+
+                r = tensors.alloc.shape[1]
+                state = build_state(
+                    tensors,
+                    np.asarray(self._engine.placed_group, np.int32),
+                    np.asarray(self._engine.placed_node, np.int32),
+                    self._engine.log_req_matrix(r),
+                    self._engine.ext_log,
+                )
+            return build_explain_doc(
+                tensors, batch, np.arange(len(pods)), state,
+                np.full(len(pods), -1, np.int64), codes,
+                node_valid=node_valid, sched_config=self._sched_config,
+                new_node=opts.get("new_node"),
+                daemon_sets=opts.get("daemon_sets") or (),
+                corrected_ds_overhead=bool(opts.get("corrected", False)),
+                top=int(opts.get("top", 10)),
+            )
+
 
 def record_placed_pod(pod: dict, node_name: str, gpu_shares) -> dict:
     """The placed copy of `pod`: nodeName bound, phase Running, and the
@@ -1096,6 +1164,7 @@ def simulate(
     sched_config=None,
     precompile: bool = False,
     audit: bool = False,
+    explain=False,
     trace: Optional[str] = None,
     profile: Optional[str] = None,
     _audit_inject: bool = False,
@@ -1125,6 +1194,13 @@ def simulate(
     the simulator closes.  `_audit_inject` is the SIMTPU_AUDIT_INJECT
     test lever: it corrupts the audit's VIEW (never the result) so the
     planners' divergence-fallback path can be driven end-to-end.
+
+    With `explain=True` (or an options dict — `{"top", "new_node",
+    "daemon_sets", "corrected"}`) the decision-observability block
+    (simtpu/explain: per-stage failure breakdowns against the end-of-run
+    state + the binding-constraint bottleneck analysis) is attached as
+    `result.explain` before the simulator closes.  Off (the default) is
+    zero-cost: no explain module import, no extra device dispatch.
 
     Observability (ISSUE 8, docs/observability.md): `trace="t.json"`
     arms the span tracer for this call and exports the Perfetto-loadable
@@ -1170,6 +1246,14 @@ def simulate(
                 from .audit.checker import audit_simulation
 
                 result.audit = audit_simulation(sim, inject=_audit_inject)
+            if explain:
+                # decision observability (simtpu/explain): the failure
+                # breakdown + bottleneck block, computed before the
+                # simulator closes.  `explain` may be True or an options
+                # dict ({"top", "new_node", "daemon_sets", "corrected"})
+                result.explain = sim.explain_result(
+                    explain if isinstance(explain, dict) else None
+                )
         return result
     finally:
         # export in the finally: an aborted simulation must still leave

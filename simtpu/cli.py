@@ -84,6 +84,10 @@ def _plan_json(plan, resilience: dict = None) -> str:
             len(plan.result.unscheduled_pods) if plan.result is not None else None
         ),
     }
+    if plan.explain:
+        # the versioned decision-observability block (simtpu/explain,
+        # --explain): failure breakdowns + bottleneck analysis
+        doc["explain"] = plan.explain
     if resilience is not None:
         doc["resilience"] = resilience
     return json.dumps(doc)
@@ -122,14 +126,22 @@ def _flight_exit(code: int, reason: str, args, plan=None) -> int:
     failure exit — partial (3), audit (4), OOM exhaustion — and return
     `code`.  The bundle lands next to the --checkpoint dir when one was
     given, else the working directory (SIMTPU_FLIGHT_DIR overrides,
-    SIMTPU_FLIGHT=0 disables)."""
+    SIMTPU_FLIGHT=0 disables).  When the plan carries a decision-
+    observability block (--explain), its top-K failure breakdown rides
+    the bundle — the post-mortem then says WHY the pods didn't place,
+    not just that they didn't."""
     from .obs.flight import dump_flight
 
+    extra = None
+    explain_doc = getattr(plan, "explain", None) if plan is not None else None
+    if explain_doc:
+        extra = {"explain": explain_doc}
     dump_flight(
         reason,
         code,
         checkpoint=getattr(args, "checkpoint", None) or "",
         engine=plan.engine if plan is not None else None,
+        extra=extra,
     )
     return code
 
@@ -243,6 +255,7 @@ def _cmd_apply(args: argparse.Namespace) -> int:
         # ^C = the default KeyboardInterrupt (durable/deadline.py)
         install_sigint=True,
         audit=args.audit,
+        explain=args.explain,
     )
     def fail_early(exc: Exception) -> int:
         # the --json contract holds on EVERY exit: config/load failures
@@ -360,6 +373,10 @@ def _cmd_apply(args: argparse.Namespace) -> int:
             from .report import audit_report
 
             print(f"{C.COLOR_RED}{audit_report(fault_audit)}{C.COLOR_RESET}")
+        if plan.explain:
+            from .report import explain_report
+
+            print(explain_report(plan.explain))
         if fault_sweep is not None:
             from .report import resilience_report
 
@@ -387,6 +404,10 @@ def _cmd_apply(args: argparse.Namespace) -> int:
         from .report import audit_report
 
         print(f"{C.COLOR_RED}{audit_report(plan.audit)}{C.COLOR_RESET}")
+    if plan.explain:
+        from .report import explain_report
+
+        print(explain_report(plan.explain))
     if plan.result is not None:
         print(C.COLOR_RED, end="")
         print(report(plan.result.node_status, opts.extended_resources))
@@ -500,6 +521,7 @@ def _cmd_resilience(args: argparse.Namespace) -> int:
                     checkpoint=checkpoint,
                     control=control,
                     audit=args.audit,
+                    explain=args.explain,
                 )
             if args.json:
                 doc = plan.counters()
@@ -526,6 +548,10 @@ def _cmd_resilience(args: argparse.Namespace) -> int:
                         C.COLOR_RED if _audit_failed(plan.audit) else C.COLOR_GREEN
                     )
                     print(f"{a_color}{audit_report(plan.audit)}{C.COLOR_RESET}")
+                if plan.explain:
+                    from .report import explain_report
+
+                    print(explain_report(plan.explain))
                 if plan.sweep is not None:
                     from .report import resilience_report
 
@@ -719,6 +745,128 @@ def _cmd_fuzz(args: argparse.Namespace) -> int:
     return 0 if result.ok else EXIT_AUDIT
 
 
+def cmd_explain(args: argparse.Namespace) -> int:
+    return _with_obs(args, lambda: _cmd_explain(args))
+
+
+def _cmd_explain(args: argparse.Namespace) -> int:
+    """One engine-level placement of the configured problem, explained
+    (simtpu/explain).  Engine-level deliberately: score attribution's
+    log-prefix exactness and the breakdown's end-state semantics both
+    rest on the un-surgered placement log (no preemption), the same
+    contract the planners' probes run under."""
+    import json
+
+    import numpy as np
+
+    opts = ApplierOptions(
+        simon_config=args.simon_config,
+        default_scheduler_config=args.default_scheduler_config or "",
+        extended_resources=args.extended_resources or [],
+    )
+
+    def fail_early(exc: Exception) -> int:
+        if args.json:
+            print(json.dumps({"success": False, "message": str(exc)}))
+        print(exc, file=sys.stderr)
+        return 1
+
+    try:
+        applier = Applier(opts)
+    except (ValueError, FileNotFoundError) as exc:
+        return fail_early(exc)
+    progress_stream = sys.stderr if args.json else sys.stdout
+
+    def progress(msg: str) -> None:
+        print(f"{C.COLOR_YELLOW}{msg}{C.COLOR_RESET}", file=progress_stream)
+
+    try:
+        cluster = applier.load_cluster()
+        apps = applier.load_apps()
+        sched_config = applier._sched_config()
+        new_node = None
+        try:
+            new_node = applier.load_new_node()
+        except (ValueError, FileNotFoundError, OSError):
+            # the template is optional here: without it the bottleneck
+            # block simply omits the can-another-node-help verdict
+            pass
+        from .explain import (
+            EXPLAIN_VERSION,
+            attribute_scores,
+            build_explain_doc,
+            extras_from_log,
+        )
+        from .faults import place_cluster
+
+        # score attribution's prefix-state exactness (recomputed argmax
+        # == recorded node) is a SERIAL-scan contract — the bulk rounds
+        # engine deliberately tie-breaks differently.  --scores therefore
+        # forces the serial-equivalent engine for the whole placement
+        # (the wavefront dispatcher keeps it fast and bit-identical).
+        use_bulk = not args.no_bulk and args.scores <= 0
+        if args.scores > 0 and not args.no_bulk:
+            progress(
+                "--scores: placing with the serial-equivalent engine "
+                "(score attribution's exactness contract)"
+            )
+        progress(
+            f"placing workloads ({len(cluster.nodes)} nodes), then "
+            "explaining the outcome"
+        )
+        pc = place_cluster(
+            cluster,
+            apps,
+            extended_resources=opts.extended_resources,
+            bulk=use_bulk,
+            sched_config=sched_config,
+        )
+        nodes = np.asarray(pc.nodes)
+        reasons = np.asarray(pc.reasons)
+        unplaced = np.flatnonzero(nodes < 0)
+        state = pc.engine.carried_state()
+        all_ds = list(cluster.daemon_sets)
+        for app in apps:
+            all_ds += app.resource.daemon_sets
+        doc = {
+            "version": EXPLAIN_VERSION,
+            "pods": int(len(nodes)),
+            "placed": int((nodes >= 0).sum()),
+            "unplaced": int(len(unplaced)),
+        }
+        doc.update(
+            build_explain_doc(
+                pc.tensors, pc.batch, unplaced, state, nodes, reasons,
+                sched_config=sched_config, new_node=new_node,
+                daemon_sets=all_ds, top=args.top,
+            )
+        )
+        if args.scores > 0:
+            extras = extras_from_log(pc.tensors, nodes, pc.engine.ext_log)
+            doc["scores"] = attribute_scores(
+                pc.tensors, pc.batch, nodes, extras,
+                max_pods=args.scores, sched_config=sched_config,
+            )
+    except (ValueError, FileNotFoundError) as exc:
+        return fail_early(exc)
+    if args.json:
+        print(json.dumps(doc))
+        return 0
+    from .report import explain_report
+
+    print(
+        f"{C.COLOR_GREEN}{doc['placed']}/{doc['pods']} pods placed"
+        f"{C.COLOR_RESET}"
+        + (
+            f" {C.COLOR_RED}({doc['unplaced']} unplaced){C.COLOR_RESET}"
+            if doc["unplaced"]
+            else ""
+        )
+    )
+    print(explain_report(doc))
+    return 0
+
+
 def cmd_version(args: argparse.Namespace) -> int:
     if getattr(args, "json", False):
         # downstream consumers of the --json metrics block detect layout
@@ -777,6 +925,22 @@ def _add_audit_flags(p: argparse.ArgumentParser) -> None:
         action="store_false",
         help="skip the independent placement audit (the plan ships "
         "uncertified)",
+    )
+
+
+def _add_explain_flag(p: argparse.ArgumentParser) -> None:
+    """Decision-observability opt-in shared by the planning commands
+    (simtpu/explain, docs/observability.md)."""
+    p.add_argument(
+        "--explain",
+        action="store_true",
+        help="attach the decision-observability block to the result: "
+        "kube-scheduler-style per-stage failure breakdowns for every "
+        "unplaced pod ('0/N nodes are available: 3 insufficient ..., 5 "
+        "node(s) didn't match ...') and a binding-constraint bottleneck "
+        "analysis (what to buy) for infeasible plans; rides --json under "
+        "'explain' and the report as extra tables (off = zero cost: no "
+        "extra device dispatches)",
     )
 
 
@@ -969,6 +1133,7 @@ def build_parser() -> argparse.ArgumentParser:
     _add_audit_flags(apply_p)
     _add_durable_flags(apply_p)
     _add_obs_flags(apply_p)
+    _add_explain_flag(apply_p)
     apply_p.set_defaults(func=cmd_apply)
 
     res_p = sub.add_parser(
@@ -1051,7 +1216,73 @@ def build_parser() -> argparse.ArgumentParser:
     _add_audit_flags(res_p)
     _add_durable_flags(res_p)
     _add_obs_flags(res_p)
+    _add_explain_flag(res_p)
     res_p.set_defaults(func=cmd_resilience)
+
+    exp_p = sub.add_parser(
+        "explain",
+        help="explain one placement: per-stage failure breakdowns, "
+        "per-plugin score attribution, bottleneck analysis",
+        description="Decision observability (simtpu/explain, "
+        "docs/observability.md): place the configured cluster + apps "
+        "through ONE engine (no capacity search, no preemption — the "
+        "planners' engine-level contract) and explain the outcome.  "
+        "Every unplaced pod gets the kube-scheduler-style status string "
+        "with per-stage node-elimination counts and witness nodes; "
+        "--scores N additionally decomposes the first N placed pods' "
+        "winning scores into per-plugin terms with the runner-up node "
+        "and margin (the weight-sensitivity surface); the bottleneck "
+        "section names the binding resource and whether another "
+        "template node can ever help.",
+    )
+    exp_p.add_argument(
+        "-f", "--simon-config", required=True, help="path of simon config (required)"
+    )
+    exp_p.add_argument(
+        "-d",
+        "--default-scheduler-config",
+        help="path of scheduler-config overrides",
+    )
+    exp_p.add_argument(
+        "-e",
+        "--extended-resources",
+        nargs="*",
+        choices=["open-local", "gpu"],
+        help="extended resources to model (open-local, gpu)",
+    )
+    exp_p.add_argument(
+        "--scores",
+        type=int,
+        default=0,
+        metavar="N",
+        help="attribute the first N placed pods' scores (per-plugin "
+        "decomposition, runner-up, margin; default 0 = off — each pod "
+        "costs one log-prefix state rebuild; forces the serial-"
+        "equivalent engine: attribution exactness is a serial-scan "
+        "contract)",
+    )
+    exp_p.add_argument(
+        "--top",
+        type=int,
+        default=10,
+        metavar="K",
+        help="distinct failure shapes kept in the breakdown (default 10; "
+        "truncation is reported, never silent)",
+    )
+    exp_p.add_argument(
+        "--no-bulk",
+        action="store_true",
+        help="place with the serial scan engine instead of the bulk "
+        "rounds engine",
+    )
+    exp_p.add_argument(
+        "--json",
+        action="store_true",
+        help="print the versioned explain document (the same block "
+        "apply --explain --json embeds) instead of the report tables",
+    )
+    _add_obs_flags(exp_p)
+    exp_p.set_defaults(func=cmd_explain)
 
     fuzz_p = sub.add_parser(
         "fuzz",

@@ -558,3 +558,7 @@ class SimulateResult:
     # independent placement audit (simtpu/audit AuditReport) when the
     # caller asked `simulate(audit=True)`; None = not audited
     audit: object = None
+    # decision-observability record (simtpu/explain: failure breakdowns +
+    # bottleneck analysis) when the caller asked `simulate(explain=...)`;
+    # None = not explained (the zero-cost default)
+    explain: object = None

@@ -16,15 +16,15 @@ registry.  Three independent levers (docs/robustness.md):
   faults/sweep.py) catch XLA RESOURCE_EXHAUSTED, halve the chunk /
   scenario-block size, and replay the failed chunk; placements are
   chunk-size-invariant by construction, so results stay bit-identical.
-  `backoff_counts()` is the fetch_counts()-style telemetry the bench and
-  `--json` report.
+  The `backoff.*` registry instruments (obs/metrics.py) are the
+  telemetry the bench and `--json` report.
 - `deadline`    — `RunControl` turns `--deadline SECONDS` and SIGINT into
   a `PlanInterrupted` raised between candidates; the planners flush a
   final checkpoint and return a structured partial result
   (`PlanResult.partial`) instead of a traceback.
 """
 
-from .backoff import backoff_counts, is_resource_exhausted, record_backoff
+from .backoff import is_resource_exhausted, record_backoff
 from .checkpoint import (
     CHECKPOINT_VERSION,
     CheckpointError,
@@ -42,7 +42,6 @@ __all__ = [
     "PlanCheckpoint",
     "PlanInterrupted",
     "RunControl",
-    "backoff_counts",
     "is_resource_exhausted",
     "name_seed",
     "plan_fingerprint",

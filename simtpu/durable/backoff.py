@@ -37,13 +37,15 @@ import threading
 from ..obs.metrics import REGISTRY
 from ..obs.trace import instant
 
-#: Monotone process-wide counters, fetch_counts()-style (engine/scan.py):
-#: "events" RESOURCE_EXHAUSTED catches, "splits" sub-dispatches created by
-#: the halving replays, "chunk_min" the smallest chunk/block size any
-#: backoff re-dispatched at (0 = no backoff yet).  Backing store since
-#: ISSUE 8: registry counters `backoff.events`/`backoff.splits` plus the
-#: `backoff.chunk_min` gauge (a process-lifetime floor, not a flow);
-#: `backoff_counts()` stays as the legacy alias view.
+#: Monotone process-wide counters: "events" RESOURCE_EXHAUSTED catches,
+#: "splits" sub-dispatches created by the halving replays, "chunk_min"
+#: the smallest chunk/block size any backoff re-dispatched at (0 = no
+#: backoff yet).  Backing store since ISSUE 8: registry counters
+#: `backoff.events`/`backoff.splits` plus the `backoff.chunk_min` gauge
+#: (a process-lifetime floor, not a flow) — read them via
+#: `obs.metrics.family("backoff", BACKOFF_KEYS)` (the legacy
+#: `backoff_counts()` alias view is gone).
+BACKOFF_KEYS = ("events", "splits", "chunk_min")
 _EVENTS = REGISTRY.counter("backoff.events")
 _SPLITS = REGISTRY.counter("backoff.splits")
 _CHUNK_MIN = REGISTRY.gauge("backoff.chunk_min")
@@ -78,13 +80,3 @@ def record_backoff(size_from: int, size_to: int) -> None:
     # point event on the span timeline: OOM backoffs are exactly the
     # anomalies a post-mortem trace read hunts for
     instant("backoff.oom", size_from=int(size_from), size_to=int(size_to))
-
-
-def backoff_counts() -> dict:
-    """Snapshot of the backoff counters (monotone over a process; alias
-    view of the registry's `backoff.*` instruments)."""
-    return {
-        "events": _EVENTS.value,
-        "splits": _SPLITS.value,
-        "chunk_min": _CHUNK_MIN.value,
-    }

@@ -26,7 +26,6 @@ import numpy as np
 from ..core.tensorize import ClusterTensors, PodBatch
 from ..durable.backoff import is_resource_exhausted, record_backoff
 from ..obs.metrics import REGISTRY
-from ..obs.metrics import family as metrics_family
 from ..obs.trace import instant, span
 from ..kernels.filters import (
     attach_limits_ok,
@@ -95,20 +94,14 @@ FAIL_VOLUME_BIND = 11  # PVC missing / not bindable / PV zone mismatch
 # bumps these too — the counts then attribute a trace to whatever phase is
 # active when the background lowering happens to run; the registry
 # counters' lock keeps concurrent worker-thread traces from losing
-# increments.)  Since ISSUE 8 the backing store is the obs metrics
-# registry under `compile.<kind>`; `trace_counts()` stays as the legacy
-# alias view (same keys, same values — it reads the registry).
-_COMPILE_COUNT_KINDS = ("scan", "rounds", "wave")
+# increments.)  The backing store is the obs metrics registry under
+# `compile.<kind>` (read via `obs.metrics.family("compile",
+# COMPILE_COUNT_KINDS)` — the ISSUE-8 alias views are gone).
+COMPILE_COUNT_KINDS = ("scan", "rounds", "wave", "explain")
 
 
 def count_trace(kind: str) -> None:
     REGISTRY.counter(f"compile.{kind}").inc()
-
-
-def trace_counts() -> dict:
-    """Snapshot of the per-kind jit-trace counters (alias view of the
-    obs metrics registry's `compile.*` counters)."""
-    return metrics_family("compile", _COMPILE_COUNT_KINDS)
 
 
 # Blocking device→host fetch counters: every engine-path jax.device_get goes
@@ -118,7 +111,9 @@ def trace_counts() -> dict:
 # moved ("bytes" — the payload-side of the transfer audit; with it, a
 # regression that grows the fetched tree shows up even when the round-trip
 # count stays flat).  Backing store: registry counters `fetch.get` /
-# `fetch.bytes` (ISSUE 8); `fetch_counts()` is the legacy alias view.
+# `fetch.bytes` (ISSUE 8; read via `obs.metrics.family("fetch",
+# FETCH_KEYS)`).
+FETCH_KEYS = ("get", "bytes")
 _FETCH_GET = REGISTRY.counter("fetch.get")
 _FETCH_BYTES = REGISTRY.counter("fetch.bytes")
 
@@ -142,13 +137,6 @@ def fetch_outputs(tree):
     return out
 
 
-def fetch_counts() -> dict:
-    """Snapshot of the blocking-fetch counters ("get" round-trips, "bytes"
-    of fetched payload — both monotone over a process).  Alias view of
-    the registry's `fetch.*` counters."""
-    return metrics_family("fetch", ("get", "bytes"))
-
-
 # Speculative-wavefront telemetry (docs/speculation.md): bumped host-side
 # from the accept flags each wavefront dispatch returns (they ride the
 # chunk loop's one batched device→host fetch — no extra round-trips).
@@ -158,15 +146,10 @@ def fetch_counts() -> dict:
 # speculative placements were discarded and whose results come from the
 # verifier's pod-at-a-time serial replay; a "rollback" is a wavefront with
 # at least one divergence.  Backing store: registry counters
-# `wavefront.*` (ISSUE 8); `wave_counts()` is the legacy alias view.
-_WAVE_KEYS = ("wavefronts", "pods", "accepted", "rollbacks", "rollback_pods")
-_WAVE = {k: REGISTRY.counter(f"wavefront.{k}") for k in _WAVE_KEYS}
-
-
-def wave_counts() -> dict:
-    """Snapshot of the speculation counters (alias view of the registry's
-    `wavefront.*` counters)."""
-    return metrics_family("wavefront", _WAVE_KEYS)
+# `wavefront.*` (ISSUE 8; read via `obs.metrics.family("wavefront",
+# WAVE_KEYS)` — the legacy `wave_counts()` alias view is gone).
+WAVE_KEYS = ("wavefronts", "pods", "accepted", "rollbacks", "rollback_pods")
+_WAVE = {k: REGISTRY.counter(f"wavefront.{k}") for k in WAVE_KEYS}
 
 
 def wave_enabled() -> bool:
@@ -194,6 +177,49 @@ REASON_TEXT = {
         "unreachable from the node's zone"
     ),
 }
+
+
+def _check_reason_text() -> None:
+    """Exhaustiveness guard: every FAIL_* code must carry a REASON_TEXT
+    entry, so `Simulator._record_failed`'s "unschedulable" fallback (and
+    the incremental planner's copy of it) is provably unreachable — a new
+    failure code without a message fails at import, not as a silent
+    generic reason in a report."""
+    codes = {
+        v for k, v in globals().items()
+        if k.startswith("FAIL_") and isinstance(v, int)
+    }
+    missing = codes - set(REASON_TEXT)
+    if missing:
+        raise AssertionError(
+            f"FAIL_* codes without a REASON_TEXT entry: {sorted(missing)} — "
+            "every failure code must render a real reason"
+        )
+
+
+_check_reason_text()
+
+
+#: The filter cascade in registry evaluation order: (StepEval mask field,
+#: failure code when that stage is the first to empty the candidate set).
+#: SINGLE source of truth for `StepEval.fail_code`, the explain pass's
+#: per-stage breakdown (simtpu/explain/breakdown.py), and the wavefront
+#: verifier's substituted `fail_from` — the headline reason and the
+#: explanation's first-failing stage can never drift (pinned by
+#: tests/test_explain.py).  The final (m_all, FAIL_INTERPOD) entry is the
+#: cascade default: a pod emptied only at the inter-pod stage.
+FILTER_CASCADE = (
+    ("m_static", FAIL_STATIC),
+    ("m_ports", FAIL_PORTS),
+    ("m_res", FAIL_RESOURCES),
+    ("m_vol", FAIL_VOLUME),
+    ("m_att", FAIL_ATTACH),
+    ("m_bind", FAIL_VOLUME_BIND),
+    ("m_storage", FAIL_STORAGE),
+    ("m_gpu", FAIL_GPU),
+    ("m_spread", FAIL_SPREAD),
+    ("m_all", FAIL_INTERPOD),
+)
 
 
 class StaticArrays(NamedTuple):
@@ -512,21 +538,13 @@ class StepEval(NamedTuple):
 
     def fail_code(self) -> jnp.ndarray:
         """First mask stage that emptied the candidate set (the scheduler's
-        '0/N nodes are available: <first failing filter>' status)."""
-        cascade = (
-            (self.m_static, FAIL_STATIC),
-            (self.m_ports, FAIL_PORTS),
-            (self.m_res, FAIL_RESOURCES),
-            (self.m_vol, FAIL_VOLUME),
-            (self.m_att, FAIL_ATTACH),
-            (self.m_bind, FAIL_VOLUME_BIND),
-            (self.m_storage, FAIL_STORAGE),
-            (self.m_gpu, FAIL_GPU),
-            (self.m_spread, FAIL_SPREAD),
-        )
-        fail = jnp.int32(FAIL_INTERPOD)
-        for mask, code in reversed(cascade):
-            fail = jnp.where(jnp.any(mask), fail, code)
+        '0/N nodes are available: <first failing filter>' status).  Walks
+        FILTER_CASCADE — the module-level stage order the explain pass
+        (simtpu/explain) shares, so the headline reason and the per-stage
+        breakdown agree by construction."""
+        fail = jnp.int32(FILTER_CASCADE[-1][1])
+        for field, code in reversed(FILTER_CASCADE[:-1]):
+            fail = jnp.where(jnp.any(getattr(self, field)), fail, code)
         return fail
 
 
@@ -1416,8 +1434,8 @@ def run_scan_chunked(
 #    speculative placement (the accept flags prove it); every pod beyond it
 #    is rolled back and takes the verifier's replayed serial answer.  The
 #    committed state is always the verifier's — placements are bit-identical
-#    to the pod-at-a-time scan by construction, and `wave_counts()` reports
-#    the acceptance rate and rollback volume.
+#    to the pod-at-a-time scan by construction, and the `wavefront.*`
+#    registry counters report the acceptance rate and rollback volume.
 #
 # Bit-exactness rests on three pinned facts: (a) the verifier computes the
 # same kernel calls in the same order as `filter_and_score`/`score_pod` on

@@ -761,8 +761,10 @@ def state_nbytes(state) -> dict:
 # `dense_bytes` is what the SAME carry costs in the dense layout (the A/B
 # denominator); `compact` records which form is stored.  Backing store
 # since ISSUE 8: obs metrics registry gauges `state.carried_bytes` /
-# `state.dense_bytes` / `state.compact` / `state.planes`; `state_gauge()`
-# stays as the legacy alias view (same keys, same values).
+# `state.dense_bytes` / `state.compact` / `state.planes` — read them via
+# `obs.metrics.family("state", STATE_KEYS)` (the legacy `state_gauge()`
+# alias view is gone).
+STATE_KEYS = ("carried_bytes", "dense_bytes", "compact", "planes")
 
 
 def update_state_gauge(stored, dense_bytes: int) -> None:
@@ -773,16 +775,3 @@ def update_state_gauge(stored, dense_bytes: int) -> None:
     REGISTRY.gauge("state.dense_bytes").set(int(dense_bytes))
     REGISTRY.gauge("state.compact").set(isinstance(stored, CompactState))
     REGISTRY.gauge("state.planes").set(planes)
-
-
-def state_gauge() -> dict:
-    """Snapshot of the carried-state byte gauge (alias view of the obs
-    registry's `state.*` gauges)."""
-    from ..obs.metrics import REGISTRY
-
-    return {
-        "carried_bytes": REGISTRY.value("state.carried_bytes"),
-        "dense_bytes": REGISTRY.value("state.dense_bytes"),
-        "compact": REGISTRY.value("state.compact", default=False),
-        "planes": dict(REGISTRY.value("state.planes", default={})),
-    }

@@ -1,0 +1,55 @@
+"""Decision observability: *why* did the scheduler do what it did.
+
+The reference's whole value proposition is answering why — its capacity
+loop emits per-pod failure diagnostics (`apply.go:213-231` →
+`utils.NodeShouldRunPod`) and kube-scheduler renders per-node filter
+verdicts into the "0/N nodes are available: 3 Insufficient cpu, ..."
+status string.  The engine (simtpu/engine) compresses every failure into
+ONE coarse code (`StepEval.fail_code` — the first mask stage that emptied
+the candidate set); this package rebuilds the full per-node story on top
+of the PR-8 observability plumbing:
+
+- `breakdown` — one jitted, vmapped [P, N] explanation pass re-evaluates
+  every unplaced pod's full filter cascade (reusing `StepEval`'s stage
+  masks via `filter_and_score`) against a carried state, yielding per-pod
+  × per-stage node-elimination counts, capped per-node witnesses, and the
+  exact kube-scheduler-style status string.  A pure-numpy twin
+  (`SIMTPU_EXPLAIN_JIT=0`, the audit/checker.py pattern) pins the counts.
+- `scores` — per-plugin decomposition of a placed pod's winning score,
+  with the runner-up node and margin: the weight-sensitivity surface a
+  scoring-tuning harness optimizes over.
+- `bottleneck` — binding-constraint analysis over an unplaced set: which
+  resource (or constraint class) is binding, whether another template
+  node can ever help, and a what-to-buy hint for infeasible plans.
+
+Surfaces: `simtpu explain`, `--explain` on apply/resilience, the
+versioned `explain` block in `--json`, `report.explain_report` tables,
+`explain.*` metrics + `explain.pass` spans on the PR-8 registry, and the
+flight recorder's top-K failure bundle on exit 3/4.  The off path is
+zero-cost: nothing here imports or dispatches unless explanation was
+requested (pinned by tests/test_explain.py via `compile.*`/`fetch.*`
+registry deltas).
+"""
+
+from .breakdown import (
+    EXPLAIN_VERSION,
+    STAGES,
+    FailureBreakdown,
+    build_explain_doc,
+    explain_failures,
+    jit_enabled,
+)
+from .bottleneck import bottleneck_analysis
+from .scores import attribute_scores, extras_from_log
+
+__all__ = [
+    "EXPLAIN_VERSION",
+    "STAGES",
+    "FailureBreakdown",
+    "attribute_scores",
+    "bottleneck_analysis",
+    "build_explain_doc",
+    "explain_failures",
+    "extras_from_log",
+    "jit_enabled",
+]

@@ -1,0 +1,681 @@
+"""Per-pod failure breakdowns: the kube-scheduler status string, exactly.
+
+kube-scheduler's PodScheduled condition reads "0/N nodes are available:
+3 Insufficient cpu, 5 node(s) had untolerated taint." — every node
+accounted to the filter stage that eliminated it.  The engine's
+`StepEval.fail_code` reports only the FIRST stage that emptied the
+candidate set; this module re-evaluates each unplaced pod's full filter
+cascade (the same `filter_and_score` the scan steps run, so the stage
+masks are the engine's own) against a carried state and renders the full
+per-stage split.
+
+Semantics: the breakdown is evaluated against the state the caller hands
+in — for `simtpu explain` / `--explain` that is the END-OF-RUN carry, so
+the counts answer "why does this pod not fit the cluster as it now
+stands".  The recorded `fail_code` (evaluated at the pod's attempt) stays
+the headline reason, bit-equal to the legacy report; the breakdown's own
+first-failing stage (`fail_code` recomputed from the same masks) is
+reported alongside, and the two coincide whenever the carried state did
+not tighten past the pod's attempt.  A pod whose constraints were
+satisfied by LATER placements (required affinity on a pod placed after
+it) can show `feasible > 0` — an ordering artifact worth surfacing, not
+an error; the per-stage counts plus `feasible` always sum to the valid
+node count (pinned against the pure-numpy twin, `SIMTPU_EXPLAIN_JIT=0`).
+
+Cost model: one jitted, vmapped [chunk, N] pass per pow2 chunk of
+unplaced pods (shape-bounded executables, `compile.explain` trace
+counter), dispatched only when an explanation was requested — the off
+path adds zero device dispatches (pinned via `compile.*`/`fetch.*`
+registry deltas, tests/test_explain.py).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.scan import (
+    FAIL_NO_NODE,
+    FILTER_CASCADE,
+    OK,
+    REASON_TEXT,
+    StepFlags,
+    build_pod_arrays,
+    count_trace,
+    fetch_outputs,
+    filter_and_score,
+    flags_from,
+    pad_pods_pow2,
+    statics_from,
+)
+from ..obs.metrics import REGISTRY
+from ..obs.trace import span
+
+#: versions the `explain` block in `--json` / flight bundles — bump when
+#: the document layout (stage keys, group fields, message grammar) changes
+EXPLAIN_VERSION = 1
+
+#: (stage key, failure code) in cascade order — FILTER_CASCADE with the
+#: StepEval field names shortened to the stage vocabulary the JSON block
+#: and docs/observability.md use.  The final stage ("interpod", the
+#: cascade default) owns every node the full mask chain eliminated last.
+STAGES = tuple(
+    (fld[2:] if fld != "m_all" else "interpod", code)
+    for fld, code in FILTER_CASCADE
+)
+
+#: pods explained per jitted dispatch (pow2-padded tail) — bounds the
+#: [chunk, N] mask planes and the per-chunk executable set
+EXPLAIN_CHUNK = 64
+
+#: per-stage witness nodes recorded per pod (lowest-index eliminated)
+WITNESS_K = 4
+
+
+def jit_enabled() -> bool:
+    """SIMTPU_EXPLAIN_JIT=0 routes the breakdown through the pure-numpy
+    twin instead of the jitted pass (the audit/checker.py A/B pattern —
+    the twin is also what the tests pin the jit counts against)."""
+    return os.environ.get("SIMTPU_EXPLAIN_JIT", "1") != "0"
+
+
+def _witness_cap(n: int, k: int) -> int:
+    return max(1, min(int(k), int(n)))
+
+
+@partial(jax.jit, static_argnums=(3, 4))
+def _explain_call(statics, state, pods, flags: StepFlags, k: int):
+    """[P]-vmapped filter cascade + per-stage elimination accounting.
+
+    Returns (counts [P, S] i32, feasible [P] i32, witnesses [P, S, k] i32
+    node indices (-1 pad), fail_code [P] i32) — fail_code is
+    `StepEval.fail_code` on the same masks, so the first-failing stage
+    and the headline reason agree by construction."""
+    count_trace("explain")
+    n = statics.alloc.shape[0]
+    node_ids = jnp.arange(n)
+
+    def first_k(elim):
+        # lowest eliminated node indices via top_k on a distinct-value key
+        vals = jnp.where(elim, (n - node_ids).astype(jnp.float32), 0.0)
+        top, _ = jax.lax.top_k(vals, k)
+        return jnp.where(top > 0, (n - top).astype(jnp.int32), -1)
+
+    def one(pod):
+        ev = filter_and_score(statics, state, pod, flags)
+        alive = statics.node_valid
+        counts: List = []
+        wits: List = []
+        for fld, _code in FILTER_CASCADE:
+            m = getattr(ev, fld)
+            elim = alive & ~m
+            counts.append(jnp.sum(elim).astype(jnp.int32))
+            wits.append(first_k(elim))
+            alive = alive & m
+        return (
+            jnp.stack(counts),
+            jnp.sum(alive).astype(jnp.int32),
+            jnp.stack(wits),
+            ev.fail_code(),
+        )
+
+    return jax.vmap(one)(pods)
+
+
+# ---------------------------------------------------------------------------
+# Pure-numpy twin (SIMTPU_EXPLAIN_JIT=0; the count oracle the tests pin)
+# ---------------------------------------------------------------------------
+#
+# Mirrors `filter_and_score`'s stage semantics on the HOST tensors over the
+# full term axis — structurally different from the jit pass (no per-group
+# term compaction, no one-hot matmuls), which is what makes the twin worth
+# pinning against: a compaction or lowering bug shows up as a count
+# mismatch.  Formulas follow simtpu/kernels one-for-one, float32 like the
+# device pass so the epsilon comparisons agree bitwise.
+
+_RES_EPS = np.float32(1e-5)
+_BIG = np.float32(3.4e38)
+
+
+def _np_lvm_fits(vg_free, vg_name_id, sizes, vg_ids):
+    """numpy twin of kernels.storage.lvm_plan (fits mask only)."""
+    n, v = vg_free.shape
+    exists = vg_name_id >= 0
+    has_any = exists.any(axis=1)
+    fits = np.ones(n, bool)
+    free = vg_free.astype(np.float32).copy()
+    for i in range(sizes.shape[0]):
+        size, vid = np.float32(sizes[i]), int(vg_ids[i])
+        active = size > 0
+        named = vid >= 0
+        slot_named = exists & (vg_name_id == vid)
+        has_named = slot_named.any(axis=1)
+        eligible = exists & (free >= size)
+        key = np.where(eligible, free, _BIG)
+        slot_binpack = np.zeros((n, v), bool)
+        slot_binpack[np.arange(n), np.argmin(key, axis=1)] = eligible.any(axis=1)
+        slot = slot_named if named else slot_binpack
+        room = (slot & (free >= size)).any(axis=1)
+        ok = (has_named & room if named else eligible.any(axis=1))
+        ok = ok & (vid != -2) & has_any
+        take = slot & (free >= size)
+        upd = np.where(active & ok[:, None] & take, size, np.float32(0.0))
+        free = free - upd
+        if active:
+            fits = fits & ok
+    return fits
+
+
+def _np_device_fits(sdev_free, sdev_cap, sdev_media, sizes, medias):
+    """numpy twin of kernels.storage.device_plan (fits mask only)."""
+    n, sd = sdev_cap.shape
+    fits = np.ones(n, bool)
+    free = sdev_free.astype(bool).copy()
+    for i in range(sizes.shape[0]):
+        size, media = np.float32(sizes[i]), int(medias[i])
+        active = size > 0
+        eligible = free & (sdev_media == media) & (sdev_cap >= size)
+        key = np.where(eligible, sdev_cap.astype(np.float32), _BIG)
+        choice = np.argmin(key, axis=1)
+        found = eligible.any(axis=1)
+        sel = np.zeros((n, sd), bool)
+        sel[np.arange(n), choice] = found
+        sel = sel & active
+        free = free & ~sel
+        if active:
+            fits = fits & found
+    return fits
+
+
+def _np_gpu_fits(gpu_free, dev_exists, gpu_total, mem, count, preset):
+    """numpy twin of kernels.gpushare.gpu_plan (fits mask only)."""
+    n, gd = gpu_free.shape
+    mem = np.float32(mem)
+    count = np.float32(count)
+    is_gpu = mem > 0
+    valid_req = count > 0
+    free = np.where(dev_exists, gpu_free.astype(np.float32), np.float32(-1.0))
+    per_dev = np.where(
+        free >= mem,
+        np.floor(free / np.maximum(mem, np.float32(1e-30))),
+        np.float32(0.0),
+    )
+    cum = np.cumsum(per_dev, axis=1)
+    prev = cum - per_dev
+    greedy = np.clip(np.minimum(cum, count) - prev, 0.0, per_dev)
+    fit1 = free >= mem
+    key = np.where(fit1, free, _BIG)
+    tight = np.zeros((n, gd), np.float32)
+    tight[np.arange(n), np.argmin(key, axis=1)] = np.where(
+        fit1.any(axis=1), np.float32(1.0), np.float32(0.0)
+    )
+    shares = tight if count == 1 else greedy
+    enough = shares.sum(axis=1) >= count
+    node_total_ok = gpu_total >= mem
+    has_dev = dev_exists.any(axis=1)
+    fits = np.where(is_gpu, node_total_ok & has_dev & valid_req & enough, True)
+    if preset is not None and np.sum(preset) > 0:
+        fits = np.where(is_gpu, node_total_ok & has_dev & valid_req, True)
+    return fits.astype(bool)
+
+
+def _np_spread_filter(cnt_at, valid, max_skew, elig_nodes):
+    """numpy twin of kernels.filters.topology_spread_filter."""
+    t, n = cnt_at.shape
+    if t == 0:
+        return np.ones(n, bool)
+    active = max_skew > 0
+    elig = valid & elig_nodes[None, :]
+    inf = np.float32(3.4e38)
+    min_cnt = np.min(np.where(elig, cnt_at, inf), axis=1)
+    min_cnt = np.where(min_cnt >= inf, np.float32(0.0), min_cnt)
+    ok = (~active[:, None]) | (
+        valid & (cnt_at + np.float32(1.0) - min_cnt[:, None] <= max_skew[:, None])
+    )
+    return ok.all(axis=0)
+
+
+def _np_interpod_filter(cnt_at, own_anti_at, valid, cnt_total, s_match, a_aff, a_anti):
+    """numpy twin of kernels.filters.interpod_filter."""
+    t, n = cnt_at.shape
+    if t == 0:
+        return np.ones(n, bool)
+    anti_violated = (a_anti[:, None] & (cnt_at > 0)).any(axis=0)
+    sym_violated = (s_match[:, None] & (own_anti_at > 0)).any(axis=0)
+    aff_ok = ((~a_aff[:, None]) | (valid & (cnt_at > 0))).all(axis=0)
+    # first-pod-in-series escape: no matching pod anywhere for any
+    # required term AND the pod matches all its own terms AND the node
+    # carries every required topology key
+    total_match = np.sum(np.where(a_aff, cnt_total, np.float32(0.0)))
+    self_ok = (
+        (total_match == 0)
+        & np.all(np.where(a_aff, s_match, True))
+        & ((~a_aff[:, None]) | valid).all(axis=0)
+    )
+    aff_ok = aff_ok | (a_aff.any() & self_ok)
+    return aff_ok & ~anti_violated & ~sym_violated
+
+
+def numpy_breakdown(
+    tensors,
+    batch,
+    rows: np.ndarray,
+    state_host,
+    node_valid: np.ndarray,
+    flags: StepFlags,
+    k: int,
+):
+    """The twin pass: (counts [U, S], feasible [U], witnesses [U, S, k],
+    fail_code [U]) from host numpy alone.  `state_host` is a SchedState of
+    numpy arrays (a fetched carry)."""
+    n = tensors.alloc.shape[0]
+    t = tensors.n_terms
+    ext = tensors.ext
+    node_ids = np.arange(n)
+    free = np.asarray(state_host.free, np.float32)
+    from ..engine.state import interpod_term_index
+
+    ip_of = interpod_term_index(tensors)
+    own_anti_full = np.zeros((t, n), np.float32)
+    if t:
+        cnt_own_anti = np.asarray(state_host.cnt_own_anti, np.float32)
+        has_row = ip_of >= 0
+        own_anti_full[has_row] = cnt_own_anti[ip_of[has_row]]
+        cnt_match = np.asarray(state_host.cnt_match, np.float32)
+        cnt_total = np.asarray(state_host.cnt_total, np.float32)
+        dom_full = tensors.node_dom[tensors.term_topo_key]  # [T, N]
+        valid_full = dom_full >= 0
+    counts = np.zeros((len(rows), len(STAGES)), np.int32)
+    feas = np.zeros(len(rows), np.int32)
+    wits = np.full((len(rows), len(STAGES), k), -1, np.int32)
+    codes = np.zeros(len(rows), np.int32)
+    bext = batch.ext
+    for u, r in enumerate(np.asarray(rows)):
+        r = int(r)
+        g = int(batch.group[r])
+        req = np.asarray(batch.req[r], np.float32)
+        if req.shape[0] < tensors.alloc.shape[1]:
+            req = np.pad(req, (0, tensors.alloc.shape[1] - req.shape[0]))
+        pin = int(batch.pin[r])
+        pin_m = (node_ids == pin) if pin >= 0 else np.full(n, pin > -2)
+        m_static = tensors.static_mask[g] & pin_m & node_valid
+
+        m_ports = m_static
+        if flags.ports and tensors.n_ports:
+            want = tensors.ports[g]
+            used = np.asarray(state_host.ports_used, np.float32)
+            m_ports = m_static & ~((want[None, :] & (used > 0)).any(axis=1))
+
+        slack = _RES_EPS * np.maximum(np.abs(free), np.float32(1.0))
+        m_res = m_ports & np.all(free + slack >= req[None, :], axis=1)
+
+        m_vol = m_res
+        if flags.vols and tensors.n_vols:
+            vols_any = np.asarray(state_host.vols_any, np.float32)
+            vols_rw = np.asarray(state_host.vols_rw, np.float32)
+            rw_conf = (tensors.vol_rw[g][None, :] & (vols_any > 0)).any(axis=1)
+            ro_conf = (tensors.vol_ro[g][None, :] & (vols_rw > 0)).any(axis=1)
+            m_vol = m_res & ~(rw_conf | ro_conf)
+
+        m_att = m_vol
+        if flags.attach and tensors.n_vols:
+            vols_any = np.asarray(state_host.vols_any, np.float32)
+            present = (vols_any > 0).astype(np.float32)
+            cm = tensors.vol_class_mask.astype(np.float32)
+            used_c = present @ cm.T
+            new_c = (
+                (np.float32(1.0) - present)
+                * tensors.vol_att[g].astype(np.float32)[None, :]
+            ) @ cm.T
+            m_att = m_vol & np.all(
+                (new_c == 0) | (used_c + new_c <= tensors.attach_limits), axis=1
+            )
+
+        m_bind = m_att & tensors.vol_mask[g]
+
+        m_storage = m_bind
+        if flags.storage:
+            lvm_size = np.asarray(bext["lvm_size"][r], np.float32)
+            dev_size = np.asarray(bext["dev_size"][r], np.float32)
+            if (lvm_size > 0).any() or (dev_size > 0).any():
+                lvm_ok = _np_lvm_fits(
+                    np.asarray(state_host.vg_free, np.float32),
+                    ext.vg_name_id,
+                    lvm_size,
+                    np.asarray(bext["lvm_vg"][r]),
+                )
+                dev_ok = _np_device_fits(
+                    np.asarray(state_host.sdev_free),
+                    ext.sdev_cap.astype(np.float32),
+                    ext.sdev_media,
+                    dev_size,
+                    np.asarray(bext["dev_media"][r]),
+                )
+                m_storage = m_bind & ext.has_storage & lvm_ok & dev_ok
+
+        m_gpu = m_storage
+        if flags.gpu and float(bext["gpu_mem"][r]) > 0:
+            m_gpu = m_storage & _np_gpu_fits(
+                np.asarray(state_host.gpu_free, np.float32),
+                ext.gpu_dev_total > 0,
+                ext.gpu_total.astype(np.float32),
+                float(bext["gpu_mem"][r]),
+                float(bext["gpu_count"][r]),
+                np.asarray(bext["gpu_preset"][r]),
+            )
+
+        m_spread = m_gpu
+        if flags.spread_hard and t and (tensors.spread_hard[g] > 0).any():
+            m_spread = m_gpu & _np_spread_filter(
+                cnt_match, valid_full, tensors.spread_hard[g],
+                tensors.static_mask[g] & pin_m & node_valid,
+            )
+
+        m_all = m_spread
+        if flags.interpod_req and t:
+            m_all = m_spread & _np_interpod_filter(
+                cnt_match, own_anti_full, valid_full, cnt_total,
+                tensors.s_match[g], tensors.a_aff_req[g], tensors.a_anti_req[g],
+            )
+
+        alive = node_valid.copy()
+        cascade_masks = (
+            m_static, m_ports, m_res, m_vol, m_att, m_bind,
+            m_storage, m_gpu, m_spread, m_all,
+        )
+        code = STAGES[-1][1]
+        for s, m in enumerate(cascade_masks):
+            elim = alive & ~m
+            counts[u, s] = int(elim.sum())
+            first = node_ids[elim][:k]
+            wits[u, s, : len(first)] = first
+            alive = alive & m
+        feas[u] = int(alive.sum())
+        for s in range(len(cascade_masks) - 1, -1, -1):
+            if not cascade_masks[s].any():
+                code = STAGES[s][1]
+        codes[u] = code
+    return counts, feas, wits, codes
+
+
+# ---------------------------------------------------------------------------
+# Host driver + rendering
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FailureBreakdown:
+    """One explanation pass's result over a set of unplaced pods."""
+
+    n_nodes: int  # valid-node universe ("0/N nodes are available")
+    rows: np.ndarray  # [U] batch row of each explained pod
+    names: List[str]  # [U] "namespace/name"
+    reasons: np.ndarray  # [U] RECORDED fail codes (the legacy headline)
+    fail_code: np.ndarray  # [U] first-failing stage vs the explained state
+    counts: np.ndarray  # [U, S] nodes eliminated per cascade stage
+    feasible: np.ndarray  # [U] nodes surviving the whole cascade
+    witnesses: np.ndarray  # [U, S, K] example node indices (-1 pad)
+    node_names: List[str] = field(default_factory=list)
+    mode: str = "jit"  # jit | numpy (SIMTPU_EXPLAIN_JIT=0)
+    wall_s: float = 0.0
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def headline(self, i: int) -> str:
+        """The legacy reason — REASON_TEXT of the recorded fail code,
+        bit-equal to what the placement report already prints."""
+        return REASON_TEXT.get(int(self.reasons[i]), "unschedulable")
+
+    def status(self, i: int) -> str:
+        """The kube-scheduler-style status string: per-stage elimination
+        counts in cascade order, each rendered with the stage's
+        REASON_TEXT — so the entry for the first failing stage reads
+        exactly like the legacy headline reason."""
+        parts = [
+            f"{int(self.counts[i, s])} {REASON_TEXT[code]}"
+            for s, (_key, code) in enumerate(STAGES)
+            if int(self.counts[i, s]) > 0
+        ]
+        if int(self.feasible[i]) > 0:
+            parts.append(
+                f"{int(self.feasible[i])} node(s) would now be feasible "
+                "(ordering artifact: later placements satisfied this pod's "
+                "constraints after it failed)"
+            )
+        avail = int(self.feasible[i])
+        if parts:
+            tail = ", ".join(parts)
+        elif self.n_nodes == 0:
+            tail = "no nodes in the cluster"
+        else:
+            # a pod that never ran the cascade (spec.nodeName forced onto
+            # a node outside this cluster) has zero stage counts on a
+            # non-empty cluster: the recorded reason is the whole story
+            tail = self.headline(i)
+        return f"{avail}/{self.n_nodes} nodes are available: {tail}."
+
+    def witness_names(self, i: int, s: int) -> List[str]:
+        out = []
+        for w in self.witnesses[i, s]:
+            if int(w) >= 0 and int(w) < len(self.node_names):
+                out.append(self.node_names[int(w)])
+        return out
+
+    def groups(self, top: int = 10) -> List[Dict[str, object]]:
+        """Pods grouped by identical (headline code, per-stage counts) —
+        one entry per distinct failure shape, largest first, capped."""
+        by_key: Dict[tuple, Dict[str, object]] = {}
+        for i in range(len(self.rows)):
+            key = (int(self.reasons[i]), tuple(int(c) for c in self.counts[i]))
+            got = by_key.get(key)
+            if got is None:
+                by_key[key] = {
+                    "pods": 1,
+                    "example": self.names[i],
+                    "reason": self.headline(i),
+                    "fail_code": int(self.reasons[i]),
+                    "final_fail_code": int(self.fail_code[i]),
+                    "status": self.status(i),
+                    "stages": {
+                        STAGES[s][0]: int(self.counts[i, s])
+                        for s in range(len(STAGES))
+                        if int(self.counts[i, s]) > 0
+                    },
+                    "feasible": int(self.feasible[i]),
+                    "witnesses": {
+                        STAGES[s][0]: self.witness_names(i, s)
+                        for s in range(len(STAGES))
+                        if int(self.counts[i, s]) > 0
+                    },
+                }
+            else:
+                got["pods"] += 1
+        groups = sorted(by_key.values(), key=lambda d: -d["pods"])
+        return groups[:top]
+
+    def to_doc(self, top: int = 10) -> Dict[str, object]:
+        groups = self.groups(top=top)
+        distinct = len(
+            {
+                (int(self.reasons[i]), tuple(int(c) for c in self.counts[i]))
+                for i in range(len(self.rows))
+            }
+        )
+        doc = {
+            "version": EXPLAIN_VERSION,
+            "n_nodes": int(self.n_nodes),
+            "unplaced": int(len(self.rows)),
+            "mode": self.mode,
+            "wall_s": round(self.wall_s, 4),
+            "groups": groups,
+        }
+        if distinct > top:
+            # no silent caps: a truncated view must say what was dropped
+            doc["truncated_groups"] = distinct - top
+        return doc
+
+
+def build_explain_doc(
+    tensors,
+    batch,
+    rows: Sequence[int],
+    state,
+    nodes_arr: np.ndarray,
+    reasons: np.ndarray,
+    *,
+    node_valid: Optional[np.ndarray] = None,
+    sched_config=None,
+    new_node: Optional[dict] = None,
+    daemon_sets: Sequence[dict] = (),
+    corrected_ds_overhead: bool = False,
+    top: int = 10,
+    free: Optional[np.ndarray] = None,
+) -> Dict[str, object]:
+    """The ONE assembly of the versioned explain document — failures
+    breakdown (when a carried state is available) + bottleneck analysis —
+    shared by `Simulator.explain_result`, the planners' failure paths,
+    and the `simtpu explain` subcommand, so the EXPLAIN_VERSION-stamped
+    shape cannot drift across surfaces.  `state=None` (e.g. a
+    checkpoint-replayed candidate with no carry) degrades to the
+    bottleneck block alone, its free capacity taken from `free` when the
+    caller can supply the full picture (the incremental planner's probe
+    batches see only a slice of the placements) and otherwise derived
+    from the visible placements in `nodes_arr`."""
+    from .bottleneck import bottleneck_analysis
+
+    rows = np.asarray(list(rows), np.int64)
+    doc: Dict[str, object] = {"version": EXPLAIN_VERSION}
+    if not len(rows):
+        return {}
+    if state is not None:
+        bd = explain_failures(
+            tensors, batch, rows, state, reasons=reasons,
+            node_valid=node_valid, sched_config=sched_config,
+        )
+        doc["failures"] = bd.to_doc(top=top)
+        free = np.asarray(state.free)  # the carry is exact — it wins
+    doc["bottleneck"] = bottleneck_analysis(
+        tensors, batch, np.asarray(nodes_arr), np.asarray(reasons),
+        rows=rows, node_valid=node_valid, new_node=new_node,
+        daemon_sets=daemon_sets,
+        corrected_ds_overhead=corrected_ds_overhead, free=free,
+    )
+    return doc
+
+
+def explain_failures(
+    tensors,
+    batch,
+    rows: Sequence[int],
+    state,
+    *,
+    reasons: Optional[np.ndarray] = None,
+    node_valid: Optional[np.ndarray] = None,
+    sched_config=None,
+    names: Optional[List[str]] = None,
+    witnesses: int = WITNESS_K,
+    chunk: int = EXPLAIN_CHUNK,
+) -> FailureBreakdown:
+    """Explain the unplaced pods at `rows` against `state` (a dense
+    SchedState — `Engine.carried_state()` or a `build_state` output).
+
+    `reasons` carries the recorded per-row fail codes (the legacy
+    headline); when omitted, the breakdown's own first-failing stage is
+    the headline too.  Forced pods (spec.nodeName) that failed with
+    FAIL_NO_NODE never ran the cascade — they are reported with zero
+    stage counts and the recorded reason alone."""
+    t0 = time.perf_counter()
+    rows = np.asarray(list(rows), np.int64)
+    n = tensors.alloc.shape[0]
+    valid = (
+        np.ones(n, bool) if node_valid is None else np.asarray(node_valid, bool)
+    )
+    n_valid = int(valid.sum())
+    k = _witness_cap(n, witnesses)
+    s_n = len(STAGES)
+    counts = np.zeros((len(rows), s_n), np.int32)
+    feas = np.zeros(len(rows), np.int32)
+    wits = np.full((len(rows), s_n, k), -1, np.int32)
+    codes = np.zeros(len(rows), np.int32)
+
+    # forced-fail pods (FAIL_NO_NODE) skip the cascade: their failure is
+    # "the pinned node does not exist / is outside this cluster", not a
+    # filter verdict
+    forced = np.asarray(batch.forced)[rows].astype(bool)
+    codes[forced] = FAIL_NO_NODE
+    run_rows = rows[~forced]
+    run_idx = np.flatnonzero(~forced)
+
+    flags = flags_from(tensors, batch.ext)
+    mode = "jit" if jit_enabled() else "numpy"
+    if len(run_rows):
+        with span("explain.pass", pods=int(len(run_rows)), mode=mode):
+            if mode == "numpy":
+                state_host = type(state)(*(np.asarray(p) for p in state))
+                c, f, w, fc = numpy_breakdown(
+                    tensors, batch, run_rows, state_host, valid, flags, k
+                )
+                counts[run_idx], feas[run_idx] = c, f
+                wits[run_idx], codes[run_idx] = w, fc
+            else:
+                statics = statics_from(tensors, sched_config)
+                statics = statics._replace(
+                    node_valid=statics.node_valid & jnp.asarray(valid)
+                )
+                r_res = tensors.alloc.shape[1]
+                _, pods = build_pod_arrays(batch, r_res)
+                pos = 0
+                while pos < len(run_rows):
+                    sel = run_rows[pos : pos + chunk]
+                    seg = tuple(np.asarray(arr)[sel] for arr in pods)
+                    real = len(sel)
+                    pad = 1 << max(real - 1, 0).bit_length()
+                    seg = pad_pods_pow2(tuple(jnp.asarray(a) for a in seg), pad)
+                    out = fetch_outputs(
+                        _explain_call(statics, state, seg, flags, k)
+                    )
+                    c, f, w, fc = (np.asarray(o)[:real] for o in out)
+                    dst = run_idx[pos : pos + chunk]
+                    counts[dst], feas[dst] = c, f
+                    wits[dst], codes[dst] = w, fc
+                    pos += real
+    if reasons is not None:
+        recorded = np.asarray(reasons)[rows].astype(np.int32)
+        # placed/OK rows explained by mistake keep the recomputed code
+        recorded = np.where(recorded == OK, codes, recorded)
+    else:
+        recorded = codes.copy()
+    if names is None:
+        from ..core.objects import name_of, namespace_of
+
+        names = [
+            f"{namespace_of(batch.pods[int(r)])}/{name_of(batch.pods[int(r)])}"
+            if batch.pods
+            else f"pod[{int(r)}]"
+            for r in rows
+        ]
+    wall = time.perf_counter() - t0
+    REGISTRY.counter("explain.passes").inc()
+    REGISTRY.counter("explain.pods").inc(int(len(rows)))
+    REGISTRY.histogram("explain.wall_s").observe(wall)
+    return FailureBreakdown(
+        n_nodes=n_valid,
+        rows=rows,
+        names=list(names),
+        reasons=recorded,
+        fail_code=codes,
+        counts=counts,
+        feasible=feas,
+        witnesses=wits,
+        node_names=list(tensors.node_names),
+        mode=mode,
+        wall_s=wall,
+    )

@@ -1,0 +1,283 @@
+"""Score attribution: why did THIS node win, and by how much.
+
+For a placed pod, decompose the winning score into its per-plugin terms
+(the registry score plugins + the Simon/Open-Local/GPU-share extensions,
+weights from `schedconfig`), name the runner-up node, and report the
+margin — per-term.  This is the weight-sensitivity surface a scoring
+tuner needs: `d(margin)/d(w_i) = raw_i(winner) - raw_i(runner_up)`, so
+the attribution rows carry the RAW (pre-weight) normalized term values
+alongside the weighted contributions.
+
+Exactness: each attributed pod is re-evaluated against the state built
+from the placement-log prefix BEFORE it (one `build_state` per pod —
+which is why attribution is opt-in and capped): for engine-level runs
+(planners, `simtpu explain`) that is exactly the state its scheduling
+cycle saw, and the recomputed argmax is pinned to equal the recorded
+node (`consistent` flags the rare divergence — e.g. preemption log
+surgery reordered the log after the fact).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..engine.scan import filter_and_score, flags_from, statics_from
+from ..engine.state import build_state, take_rows
+from ..kernels.scores import (
+    MAX_NODE_SCORE,
+    balanced_allocation,
+    least_allocated,
+    maxabs_normalize,
+    minmax_normalize,
+    selector_spread_score,
+    simon_share,
+    taint_toleration_score,
+    topology_spread_score,
+)
+from ..obs.metrics import REGISTRY
+from ..obs.trace import span
+
+#: attributed pods per call unless the caller asks for more — one
+#: build_state + one [N] evaluation each
+DEFAULT_MAX_PODS = 8
+
+
+def extras_from_log(tensors, nodes_arr: np.ndarray, ext_log: dict) -> Dict[str, np.ndarray]:
+    """Reconstruct `Engine.place`-shaped extras arrays ([P, ...] per-pod
+    extended-resource allocations) from an engine's ext_log — the log
+    appends placed pods in batch order, so scattering its rows back onto
+    the placed batch rows recovers the per-row view `attribute_scores`
+    consumes.  Valid for engine-level runs whose log was not surgered
+    (no preemption), the same contract as prefix-state exactness."""
+    nodes_arr = np.asarray(nodes_arr)
+    p = len(nodes_arr)
+    ext = tensors.ext
+    v = ext.vg_cap.shape[1]
+    sd = ext.sdev_cap.shape[1]
+    gd = ext.gpu_dev_total.shape[1]
+    lvm = np.zeros((p, v), np.float32)
+    dev = np.zeros((p, sd), bool)
+    gpu = np.zeros((p, gd), np.float32)
+    placed = np.flatnonzero(nodes_arr >= 0)
+    for pos, j in enumerate(placed):
+        if pos >= len(ext_log["vg_alloc"]):
+            break
+        lvm[j] = np.asarray(ext_log["vg_alloc"][pos])
+        dev[j] = np.asarray(ext_log["sdev_take"][pos])
+        gpu[j] = np.asarray(ext_log["gpu_shares"][pos])
+    return {"lvm_alloc": lvm, "dev_take": dev, "gpu_shares": gpu}
+
+
+#: the attribution's plugin rows, in `score_pod`'s term order:
+#: (plugin name, schedconfig weight index)
+PLUGIN_TERMS = (
+    ("NodeResourcesLeastAllocated", 0),
+    ("NodeResourcesBalancedAllocation", 1),
+    ("Simon", 2),
+    ("Open-Gpu-Share", 3),
+    ("NodeAffinity", 4),
+    ("TaintToleration", 5),
+    ("InterPodAffinity", 6),
+    ("PodTopologySpread", 7),
+    ("SelectorSpread", 8),
+    ("ImageLocality", 9),
+    ("NodePreferAvoidPods", 11),
+    ("Open-Local", 10),
+)
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _eval_pod_terms(statics, state, pod, flags):
+    """One jitted evaluation of a pod against `state`: the engine's total
+    score vector plus the per-plugin RAW (pre-weight) normalized term
+    vectors, stacked in PLUGIN_TERMS order.
+
+    Terms are computed unconditionally — the per-pod lax.cond skips they
+    mirror return the same constants the unconditional kernels produce
+    for term-free pods (the wavefront verifier's pinned fact), so the
+    decomposition matches the engine's score term-for-term."""
+    import jax.numpy as jnp
+
+    (g, req, _pin, _forced, *_rest) = pod
+    ev = filter_and_score(statics, state, pod, flags)
+    m_all = ev.m_all
+    n = statics.alloc.shape[0]
+    w = statics.score_w
+    t_cap = statics.g_terms.shape[1]
+
+    least = least_allocated(state.free, statics.alloc, req)
+    balanced = balanced_allocation(state.free, statics.alloc, req)
+    simon = minmax_normalize(simon_share(statics.alloc, req), m_all)
+    node_pref = minmax_normalize(statics.node_pref[g], m_all)
+    taint = taint_toleration_score(statics.taint_intol[g], m_all)
+    if t_cap:
+        terms_g = statics.g_terms[g]
+        tvalid = terms_g >= 0
+        tsafe = jnp.clip(terms_g, 0)
+        cnt_sub = take_rows(state.cnt_match, terms_g)
+        ip_eff = jnp.where(tvalid, statics.ip_of[tsafe], -1)
+        from ..kernels.scores import interpod_score
+
+        ipa = maxabs_normalize(
+            interpod_score(
+                cnt_sub,
+                take_rows(state.cnt_own_aff, ip_eff),
+                take_rows(state.w_own_aff_pref, ip_eff),
+                take_rows(state.w_own_anti_pref, ip_eff),
+                statics.s_match[g],
+                statics.w_aff_pref[g],
+                statics.w_anti_pref[g],
+            ),
+            m_all,
+        )
+        spread = topology_spread_score(cnt_sub, statics.spread_soft[g], m_all)
+        ss = selector_spread_score(
+            cnt_sub, statics.ss_host[g], statics.ss_zone[g], m_all
+        )
+    else:
+        ipa = jnp.zeros(n, jnp.float32)
+        spread = jnp.full(n, MAX_NODE_SCORE, jnp.float32)
+        ss = jnp.full(n, MAX_NODE_SCORE, jnp.float32)
+    # the Open-Local term is owned by filter_and_score (the storage plans
+    # live there); its WEIGHTED value is exactly score - score_nostorage
+    storage_weighted = ev.score - ev.score_nostorage
+    w10 = w[10]
+    storage_raw = jnp.where(
+        w10 != 0, storage_weighted / jnp.where(w10 == 0, 1.0, w10), 0.0
+    )
+    terms = jnp.stack([
+        jnp.asarray(v, jnp.float32)
+        for v in (
+            least, balanced, simon, simon, node_pref, taint, ipa, spread,
+            ss, statics.static_score[g], statics.avoid_pen[g], storage_raw,
+        )
+    ])
+    return ev.score, terms
+
+
+def attribute_scores(
+    tensors,
+    batch,
+    nodes_arr: np.ndarray,
+    extras: Dict[str, np.ndarray],
+    *,
+    rows: Optional[Sequence[int]] = None,
+    max_pods: int = DEFAULT_MAX_PODS,
+    sched_config=None,
+    node_valid: Optional[np.ndarray] = None,
+) -> List[Dict[str, object]]:
+    """Per-plugin score decomposition for up to `max_pods` placed pods.
+
+    `nodes_arr`/`extras` are one engine placement's outputs over `batch`
+    (`Engine.place`); `rows` selects batch rows to attribute (default:
+    the first `max_pods` placed rows).  Returns one document per pod:
+    winner, runner-up, margin, and per-term rows with weight, raw
+    winner/runner-up values, and the weighted delta (the term's
+    contribution to the margin)."""
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    nodes_arr = np.asarray(nodes_arr)
+    placed = np.flatnonzero(nodes_arr >= 0)
+    if rows is None:
+        rows = placed[: max(int(max_pods), 0)]
+    else:
+        rows = np.asarray(list(rows), np.int64)[: max(int(max_pods), 0)]
+    if not len(rows):
+        return []
+    flags = flags_from(tensors, batch.ext)
+    statics = statics_from(tensors, sched_config)
+    if node_valid is not None:
+        statics = statics._replace(
+            node_valid=statics.node_valid & jnp.asarray(np.asarray(node_valid, bool))
+        )
+    r_res = tensors.alloc.shape[1]
+    req_pad = batch.req
+    if req_pad.shape[1] < r_res:
+        req_pad = np.pad(req_pad, ((0, 0), (0, r_res - req_pad.shape[1])))
+    from ..engine.scan import build_pod_arrays
+
+    _, pods = build_pod_arrays(batch, r_res)
+    from ..schedconfig import DEFAULT_WEIGHTS
+
+    weights = np.asarray(
+        sched_config.score_weights if sched_config is not None else DEFAULT_WEIGHTS,
+        np.float32,
+    )
+    from ..core.objects import name_of, namespace_of
+
+    node_names = list(tensors.node_names)
+    out: List[Dict[str, object]] = []
+    with span("explain.scores", pods=int(len(rows))):
+        for j in rows:
+            j = int(j)
+            # the placement-log prefix before batch row j: every earlier
+            # placed row, in batch order (engine-level log order)
+            prefix = placed[placed < j]
+            state = build_state(
+                tensors,
+                np.asarray(batch.group)[prefix].astype(np.int32),
+                nodes_arr[prefix].astype(np.int32),
+                req_pad[prefix].astype(np.float32),
+                {
+                    "node": nodes_arr[prefix].tolist(),
+                    "vg_alloc": list(np.asarray(extras["lvm_alloc"])[prefix]),
+                    "sdev_take": list(np.asarray(extras["dev_take"])[prefix]),
+                    "gpu_shares": list(np.asarray(extras["gpu_shares"])[prefix]),
+                    "gpu_mem": np.asarray(batch.ext["gpu_mem"])[prefix].tolist(),
+                },
+            )
+            pod = tuple(jnp.asarray(np.asarray(arr)[j]) for arr in pods)
+            score_dev, terms_dev = _eval_pod_terms(statics, state, pod, flags)
+            score = np.asarray(score_dev)
+            term_mat = np.asarray(terms_dev)
+            order = np.argsort(-score, kind="stable")
+            winner = int(order[0])
+            runner = int(order[1]) if len(order) > 1 and np.isfinite(score[order[1]]) else -1
+            recorded = int(nodes_arr[j])
+            margin = (
+                float(score[winner] - score[runner]) if runner >= 0 else None
+            )
+            terms = []
+            for t, (name, widx) in enumerate(PLUGIN_TERMS):
+                rw = float(term_mat[t, winner])
+                rr = float(term_mat[t, runner]) if runner >= 0 else None
+                wgt = float(weights[widx])
+                terms.append(
+                    {
+                        "plugin": name,
+                        "weight": wgt,
+                        "winner_raw": round(rw, 6),
+                        "runner_up_raw": None if rr is None else round(rr, 6),
+                        "delta": None if rr is None else round(wgt * (rw - rr), 6),
+                    }
+                )
+            pod_obj = batch.pods[j] if batch.pods else None
+            out.append(
+                {
+                    "pod": (
+                        f"{namespace_of(pod_obj)}/{name_of(pod_obj)}"
+                        if pod_obj is not None
+                        else f"pod[{j}]"
+                    ),
+                    "row": j,
+                    "node": node_names[recorded] if 0 <= recorded < len(node_names) else "",
+                    "winner": node_names[winner] if 0 <= winner < len(node_names) else "",
+                    "runner_up": (
+                        node_names[runner] if 0 <= runner < len(node_names) else ""
+                    ),
+                    "margin": None if margin is None else round(margin, 6),
+                    # pinned for engine-level runs: the recomputed argmax IS
+                    # the recorded landing node (prefix-state exactness)
+                    "consistent": winner == recorded,
+                    "terms": terms,
+                }
+            )
+    REGISTRY.counter("explain.scored_pods").inc(int(len(rows)))
+    REGISTRY.histogram("explain.scores_wall_s").observe(time.perf_counter() - t0)
+    return out

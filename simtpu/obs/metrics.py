@@ -10,11 +10,11 @@ and a uniform snapshot/delta protocol the CLI's `metrics` block and
 bench's JSON line both read.
 
 The legacy snapshot functions (`fetch_counts()`, `trace_counts()`,
-`wave_counts()`, `backoff_counts()`, `state_gauge()`) remain as ALIAS
-VIEWS over the registry — same keys, same values, bit-equal by
-construction because the registry is now the single backing store.  They
-stay for one release so downstream readers can migrate on the
-`schema_version` stamp.
+`wave_counts()`, `backoff_counts()`, `state_gauge()`) were kept for one
+release as alias views and are now REMOVED (ISSUE 13): the registry is
+the only read surface — `REGISTRY.value(name)`, `snapshot(prefix)`, or
+the `family(prefix, keys)` helper below for the flat short-key shape the
+old functions returned.
 
 Instruments:
 - `Counter`  — monotone int, `inc(n)`; thread-safe (bumped from the AOT
@@ -38,8 +38,11 @@ from typing import Dict
 
 #: bump when the `--json` metrics block (or any stable name in it)
 #: changes layout — downstream consumers pin on this, not on key probing
-#: (`simtpu version --json` reports it next to the package version)
-SCHEMA_VERSION = 1
+#: (`simtpu version --json` reports it next to the package version).
+#: 2 = ISSUE 13: the versioned `explain` block joins the --json document,
+#: `explain.*`/`compile.explain` instruments join the registry, and the
+#: one-release legacy alias views are gone
+SCHEMA_VERSION = 2
 
 
 class Counter:
@@ -204,7 +207,8 @@ REGISTRY = MetricsRegistry()
 
 
 def family(prefix: str, keys) -> Dict[str, object]:
-    """Legacy-alias helper: read `<prefix>.<key>` for each key, returning
-    the flat short-key dict the pre-registry snapshot functions exposed
-    (`fetch_counts() == family("fetch", ("get", "bytes"))`)."""
+    """Read `<prefix>.<key>` for each key as one flat short-key dict —
+    the shape the removed pre-registry snapshot functions exposed
+    (e.g. `family("fetch", ("get", "bytes"))`); never-bumped counters
+    read 0 rather than registering."""
     return {k: REGISTRY.value(f"{prefix}.{k}") for k in keys}

@@ -106,6 +106,13 @@ class PlanResult:
     # bumped whenever the metrics block or any stable field changes
     # shape — pin on this, not on key probing
     schema_version: int = SCHEMA_VERSION
+    # decision-observability block (simtpu/explain, `--explain`): the
+    # per-stage failure breakdown of the reported candidate's unplaced
+    # pods + the binding-constraint bottleneck analysis ("what to buy").
+    # {} = not requested (the zero-cost default); carries its own
+    # "version" stamp (explain.EXPLAIN_VERSION); rides --json as
+    # "explain" and the flight recorder's exit-3/4 bundles
+    explain: Dict[str, object] = field(default_factory=dict)
 
 
 def new_fake_nodes(template: dict, count: int) -> List[dict]:
@@ -246,8 +253,23 @@ def plan_capacity(
     checkpoint=None,
     control=None,
     audit: Optional[bool] = None,
+    explain: bool = False,
 ) -> PlanResult:
     """Find the minimum clone count of `new_node` that deploys everything.
+
+    `explain` (off by default — the off path adds zero device
+    dispatches) attaches the decision-observability block
+    (simtpu/explain) to the result: every live candidate simulation
+    computes the failure breakdown + bottleneck analysis of its
+    unplaced pods, and the reported candidate's block rides
+    `PlanResult.explain` — so an infeasible plan reports *what to buy*
+    (binding resource, template-node hint), not just *how many*.
+    Deliberate cost shape: any candidate can turn out terminal (the
+    diagnose failures return straight from the probe that hit them) and
+    the Simulator closes inside simulate(), so each failing candidate
+    pays its own explain pass — one vmapped dispatch per 64 unplaced
+    pods, small against the full simulation it rides; fully-placed
+    candidates pay nothing.
 
     `audit` (None = the SIMTPU_AUDIT default, on) runs the independent
     placement auditor (simtpu/audit) inside every candidate simulation
@@ -276,6 +298,21 @@ def plan_capacity(
     best_candidate: list = [None]  # lowest candidate found feasible
     last_result: list = [None]  # most recent live SimulateResult
     audit_on = audit_enabled() if audit is None else bool(audit)
+    # decision observability (simtpu/explain): the template context folds
+    # the can-another-node-ever-help verdict into the bottleneck block
+    explain_opts = (
+        {
+            "new_node": new_node,
+            "daemon_sets": all_daemon_sets,
+            "corrected": corrected_ds_overhead,
+        }
+        if explain
+        else False
+    )
+
+    def with_explain(out: PlanResult, result) -> PlanResult:
+        out.explain = getattr(result, "explain", None) or {}
+        return out
 
     def run(i: int, serial_exact: bool = False) -> SimulateResult:
         say(f"add {i} node(s)")
@@ -304,6 +341,7 @@ def plan_capacity(
                 engine_factory=factory,
                 sched_config=sched_config,
                 audit=True,
+                explain=explain_opts,
             )
         result = simulate(
             trial,
@@ -313,6 +351,7 @@ def plan_capacity(
             sched_config=sched_config,
             precompile=precompile,
             audit=audit_on,
+            explain=explain_opts,
             _audit_inject=audit_on and inject_divergence_enabled(),
         )
         probes[i] = len(result.unscheduled_pods)
@@ -407,7 +446,7 @@ def plan_capacity(
     def final_success(i: int, result) -> PlanResult:
         if result is None:  # checkpoint-replayed winner: materialize live
             _, _, _, result = evaluate(i, need_result=True)
-        out = PlanResult(True, i, result, "Success!", probes)
+        out = with_explain(PlanResult(True, i, result, "Success!", probes), result)
         rep = getattr(result, "audit", None)
         if not audit_on or rep is None:
             return out
@@ -430,17 +469,21 @@ def plan_capacity(
             "divergence": _result_divergence(result, fb, rep),
         }
         if not rep_f.ok or fb.unscheduled_pods:
-            out = PlanResult(
-                False, i, fb,
-                "audit failure: the winning candidate violates its claimed "
-                "constraints and the serial-exact fallback did not certify "
-                f"either ({rep_f.summary()})",
-                probes,
+            out = with_explain(
+                PlanResult(
+                    False, i, fb,
+                    "audit failure: the winning candidate violates its claimed "
+                    "constraints and the serial-exact fallback did not certify "
+                    f"either ({rep_f.summary()})",
+                    probes,
+                ),
+                fb,
             )
             out.audit = audit_doc
             return out
         audit_doc["ok"] = True
         out.result = fb
+        out.explain = getattr(fb, "explain", None) or {}
         out.audit = audit_doc
         return out
 
@@ -472,11 +515,13 @@ def plan_capacity(
             if ok:
                 return final_success(i, result)
             if unsched and msg:
-                return PlanResult(
-                    False, i, result or last_result[0], msg, probes
+                res = result or last_result[0]
+                return with_explain(
+                    PlanResult(False, i, res, msg, probes), res
                 )
-        return PlanResult(
-            False, max_new_nodes, last_result[0], fail_msg, probes
+        return with_explain(
+            PlanResult(False, max_new_nodes, last_result[0], fail_msg, probes),
+            last_result[0],
         )
 
     fail_msg = f"we have added {max_new_nodes} nodes but it still failed!!"
@@ -487,7 +532,8 @@ def plan_capacity(
         if ok:
             return final_success(0, result)
         if unsched and msg:
-            return PlanResult(False, 0, result or last_result[0], msg, probes)
+            res = result or last_result[0]
+            return with_explain(PlanResult(False, 0, res, msg, probes), res)
 
         # the reference's loop is `for i := 0; i < MaxNumNewNode; i++`
         # (apply.go:183) — the largest candidate ever tried is
@@ -524,23 +570,28 @@ def plan_capacity(
                 hi, hi_result = probe, result
                 break
             if unsched and msg:
-                return PlanResult(
-                    False, probe, result or last_result[0], msg, probes
+                res = result or last_result[0]
+                return with_explain(
+                    PlanResult(False, probe, res, msg, probes), res
                 )
             probe *= 2
         if hi is None:
             probe = max_new_nodes - 1
             if probe in probes:  # already tried as the last doubling step
-                return PlanResult(
-                    False, max_new_nodes, last_result[0], fail_msg, probes
+                return with_explain(
+                    PlanResult(
+                        False, max_new_nodes, last_result[0], fail_msg, probes
+                    ),
+                    last_result[0],
                 )
             ok, unsched, msg, result = evaluate(probe)
             if cap_rejected:
                 return cap_fallback()
             if not ok:
-                return PlanResult(
-                    False, max_new_nodes, result or last_result[0],
-                    fail_msg, probes,
+                res = result or last_result[0]
+                return with_explain(
+                    PlanResult(False, max_new_nodes, res, fail_msg, probes),
+                    res,
                 )
             hi, hi_result = probe, result
         lo = hi // 2  # lowest infeasible known is hi//2 (or 0)
@@ -624,6 +675,10 @@ class ApplierOptions:
     # placement auditor over the accepted candidate and fall back to the
     # serial exact engines on failure; False = --no-audit
     audit: Optional[bool] = None
+    # decision observability (simtpu/explain, --explain): attach failure
+    # breakdowns + the bottleneck analysis to the plan.  Off = zero cost
+    # (no explain import, no extra device dispatch)
+    explain: bool = False
     # observability (ISSUE 8, docs/observability.md): `trace` = output
     # path for a Perfetto-loadable Chrome trace of the run's spans
     # ("" = no trace file; arming leaves the process tracer on so a
@@ -910,6 +965,7 @@ class Applier:
                     checkpoint=checkpoint,
                     control=control,
                     audit=self.opts.audit,
+                    explain=self.opts.explain,
                 )
             else:
                 plan = plan_capacity(
@@ -926,6 +982,7 @@ class Applier:
                     checkpoint=checkpoint,
                     control=control,
                     audit=self.opts.audit,
+                    explain=self.opts.explain,
                 )
         timings["plan"] = _time.perf_counter() - t0
         plan.timings = timings

@@ -199,9 +199,17 @@ def plan_capacity_incremental(
     checkpoint=None,
     control=None,
     audit: Optional[bool] = None,
+    explain: bool = False,
 ) -> PlanResult:
     """Minimum clone count of `new_node` deploying everything, via the
     incremental probe strategy described in the module docstring.
+
+    `explain` (off by default; the off path adds zero device dispatches)
+    attaches the decision-observability block (simtpu/explain) to
+    terminal failure results: the per-stage breakdown of the failing
+    candidate's unplaced pods against its carried state, plus the
+    binding-constraint bottleneck with the template verdict — the plan
+    then reports *what to buy*, not just *how many*.
 
     `audit` (None = the SIMTPU_AUDIT default, on) runs the independent
     placement auditor (simtpu/audit) over the accepted candidate's fresh
@@ -252,7 +260,7 @@ def plan_capacity_incremental(
             cluster, apps, new_node, max_new_nodes, extended_resources,
             progress, sched_config, corrected_ds_overhead, verify,
             materialize, mesh, pipeline, speculate, checkpoint, control,
-            audit,
+            audit, explain,
         )
     except PlanInterrupted as exc:
         # deadline / SIGINT between candidates (docs/robustness.md): the
@@ -294,10 +302,17 @@ def _plan_capacity_incremental(
     checkpoint,
     control,
     audit=None,
+    explain=False,
 ) -> PlanResult:
     from ..audit.checker import audit_enabled
-    from ..engine.scan import statics_from, trace_counts
+    from ..engine.scan import COMPILE_COUNT_KINDS, statics_from
+    from ..obs.metrics import family as metrics_family
     from ..parallel.sweep import assemble_planning_problem
+
+    def trace_counts() -> Dict[str, int]:
+        # per-kind jit-trace counters off the obs registry (the ISSUE-8
+        # alias views are gone; this is the direct read)
+        return metrics_family("compile", COMPILE_COUNT_KINDS)
 
     # the auditor certifies the ACCEPTED candidate's fresh verify
     # placement; the explicitly-unverified verify=False path stays
@@ -432,6 +447,51 @@ def _plan_capacity_incremental(
             )
 
         return diff_state_planes(dense(a_eng), dense(b_eng))
+
+    def mk_explain(eng, ebatch, erows, enodes, ereasons, i, base_nodes=None):
+        """Decision-observability block for a failing candidate
+        (simtpu/explain): per-stage breakdown against the engine's
+        carried state + the bottleneck analysis with the template
+        verdict.  {} when --explain was not requested (the off path
+        dispatches nothing).  A checkpoint-replayed candidate has no
+        carried state — it explains with the bottleneck block alone, its
+        free capacity rebuilt from EVERY visible placement: probe call
+        sites hand in `base_nodes` because their `enodes`/`ebatch` cover
+        only the unplaced-from-base slice, and free derived from that
+        slice alone would overstate capacity and misname the binding
+        resource."""
+        if not explain or not len(erows):
+            return {}
+        from ..explain import build_explain_doc
+
+        all_ds = list(cluster.daemon_sets)
+        for app in apps:
+            all_ds += app.resource.daemon_sets
+        try:
+            state = eng.carried_state()
+        except ValueError:
+            state = None
+        free = None
+        if state is None:
+            used = np.zeros(tensors.alloc.shape, np.float32)
+            enodes_np = np.asarray(enodes)
+            ereq = np.asarray(ebatch.req, np.float32)
+            if ereq.shape[1] < r_res:
+                ereq = np.pad(ereq, ((0, 0), (0, r_res - ereq.shape[1])))
+            placed = np.flatnonzero(enodes_np >= 0)
+            np.add.at(used, enodes_np[placed], ereq[placed])
+            if base_nodes is not None:
+                base_np = np.asarray(base_nodes)
+                bplaced = np.flatnonzero(base_np >= 0)
+                np.add.at(used, base_np[bplaced], req_pad[bplaced])
+            free = tensors.alloc - used
+        return build_explain_doc(
+            tensors, ebatch, erows, state, np.asarray(enodes),
+            np.asarray(ereasons), node_valid=valid_mask(i),
+            sched_config=sched_config, new_node=new_node,
+            daemon_sets=all_ds, corrected_ds_overhead=corrected_ds_overhead,
+            free=free,
+        )
 
     r_res = tensors.alloc.shape[1]
     req_pad = batch.req
@@ -646,11 +706,19 @@ def _plan_capacity_incremental(
 
     msg = diagnose(u0)
     if msg:
-        return finalize(PlanResult(False, 0, None, msg, probes))
+        out = PlanResult(False, 0, None, msg, probes)
+        out.explain = mk_explain(
+            base_eng, batch, u0, base_nodes_arr, base_reasons, 0
+        )
+        return finalize(out)
     if max_new == 0:
         # no candidate beyond 0 exists (max_new_nodes <= 1, apply.go's
         # exclusive upper bound) — the base failure is terminal
-        return finalize(PlanResult(False, max_new_nodes, None, fail_msg, probes))
+        out = PlanResult(False, max_new_nodes, None, fail_msg, probes)
+        out.explain = mk_explain(
+            base_eng, batch, u0, base_nodes_arr, base_reasons, 0
+        )
+        return finalize(out)
 
     # -- snapshot + cheap probes ------------------------------------------
     t0 = time.perf_counter()
@@ -742,12 +810,22 @@ def _plan_capacity_incremental(
             lo = max(lo, cand)
             msg = diagnose(idx_i[failed_i])
             if msg:
-                return finalize(PlanResult(False, cand, None, msg, probes))
+                out = PlanResult(False, cand, None, msg, probes)
+                out.explain = mk_explain(
+                    eng_i, slice_batch(batch, idx_i),
+                    np.flatnonzero(failed_i), nodes_i, reasons_i, cand,
+                    base_nodes=base_nodes_arr,
+                )
+                return finalize(out)
         if hi is None:
             if cand >= max_new:
-                return finalize(
-                    PlanResult(False, max_new_nodes, None, fail_msg, probes)
+                out = PlanResult(False, max_new_nodes, None, fail_msg, probes)
+                out.explain = mk_explain(
+                    eng_i, slice_batch(batch, idx_i),
+                    np.flatnonzero(np.asarray(failed_i)), nodes_i,
+                    reasons_i, cand, base_nodes=base_nodes_arr,
                 )
+                return finalize(out)
             cand = min(cand * 2, max_new)
         elif hi == first_cand and lo == 0 and hi - 1 > lo:
             cand = hi - 1  # tight-bound fast path
@@ -775,9 +853,19 @@ def _plan_capacity_incremental(
                 continue
             msg = diagnose(np.flatnonzero(failed_v))
             if msg:
-                return finalize(PlanResult(False, i, None, msg, probes))
+                out = PlanResult(False, i, None, msg, probes)
+                out.explain = mk_explain(
+                    eng_v, batch, np.flatnonzero(failed_v), nodes_v,
+                    reasons_v, i,
+                )
+                return finalize(out)
             i += 1
-        return finalize(PlanResult(False, max_new_nodes, None, fail_msg, probes))
+        out = PlanResult(False, max_new_nodes, None, fail_msg, probes)
+        out.explain = mk_explain(
+            eng_v, batch, np.flatnonzero(failed_v), nodes_v, reasons_v,
+            max_new_nodes - 1,
+        )
+        return finalize(out)
 
     # -- incremental result: base placements + winning probe -------------
     eng_w, idx_w, nodes_w, gpu_w = hi_run

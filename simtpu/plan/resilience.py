@@ -77,6 +77,11 @@ class ResiliencePlan:
     #: divergence records when the bulk engine's answer failed its audit
     #: and the serial-exact fallback shipped instead.  {} = not audited
     audit: Dict[str, object] = field(default_factory=dict)
+    #: decision-observability block (simtpu/explain, `--explain`): for a
+    #: failed search, the last failing candidate's failure breakdown (base
+    #: placement strands) or the worst scenario's binding-constraint
+    #: bottleneck — *what to buy*, not just *how many*.  {} = not requested
+    explain: Dict[str, object] = field(default_factory=dict)
 
     def counters(self) -> Dict[str, object]:
         """Machine-readable summary (CLI --json, bench)."""
@@ -92,6 +97,8 @@ class ResiliencePlan:
             out["partial"] = True
         if self.audit:
             out["audit"] = dict(self.audit)
+        if self.explain:
+            out["explain"] = dict(self.explain)
         if self.sweep is not None:
             out.update(self.sweep.counters())
         return out
@@ -161,6 +168,7 @@ def plan_resilience(
     checkpoint=None,
     control=None,
     audit: Optional[bool] = None,
+    explain: bool = False,
 ) -> ResiliencePlan:
     """Minimum clone count of `new_node` whose cluster still fully places
     every workload under the failure model.
@@ -242,6 +250,9 @@ def plan_resilience(
         return m
 
     best_candidate: list = [None]  # lowest candidate found surviving
+    # the last candidate a live probe found FAILING — what a failed
+    # search's --explain block describes (simtpu/explain)
+    last_fail: Dict[str, object] = {}
     # artifacts of the best OK candidate's live base placement — what the
     # winner audit certifies (one slot: worse candidates are dropped)
     best_run: Dict[str, object] = {}
@@ -303,6 +314,12 @@ def plan_resilience(
 
         if base_unplaced:
             record(False)
+            if explain:
+                # retained ONLY under --explain: the engine pins its
+                # carried device state alive for the rest of the search
+                last_fail.update(
+                    i=i, eng=eng, nodes=nodes, reasons=np.asarray(reasons)
+                )
             return False
         pc = PlacedCluster(
             tz=tz, tensors=tensors, batch=batch, engine=eng,
@@ -326,6 +343,11 @@ def plan_resilience(
                 record(False, doomed_msg=msg or "")
                 raise _Doomed(msg)
         record(ok)
+        if not ok and explain:
+            # see above: only --explain pays the retained-engine memory
+            last_fail.update(
+                i=i, eng=eng, nodes=nodes, reasons=np.asarray(reasons)
+            )
         # <= : the winner's finish() re-probe (checkpoint-replayed runs
         # materialize the sweep live) must also refresh the audit
         # artifacts, or a resumed plan would ship unaudited
@@ -472,12 +494,72 @@ def plan_resilience(
             probes=probes, sweep=None, timings=timings, partial=True,
         )
 
+    def mk_explain() -> Dict[str, object]:
+        """The failed search's decision-observability block
+        (simtpu/explain): when the last failing candidate's BASE placement
+        stranded pods, the full per-stage breakdown + bottleneck; when its
+        base placed clean but a scenario sweep failed, the worst
+        scenario's binding-constraint bottleneck over its stranded set
+        (free capacity = the drained surviving cluster)."""
+        if not explain or not last_fail:
+            return {}
+        from ..explain import EXPLAIN_VERSION, bottleneck_analysis, build_explain_doc
+
+        i = int(last_fail["i"])
+        eng = last_fail["eng"]
+        nodes = np.asarray(last_fail["nodes"])
+        reasons_a = np.asarray(last_fail["reasons"])
+        valid = valid_mask(i)
+        phantom = clone_of >= i
+        doc: Dict[str, object] = {"version": EXPLAIN_VERSION}
+        unp = np.flatnonzero((nodes < 0) & ~phantom)
+        if len(unp):
+            try:
+                state = eng.carried_state()
+            except ValueError:
+                state = None
+            return build_explain_doc(
+                tensors, batch, unp, state, nodes, reasons_a,
+                node_valid=valid, sched_config=sched_config,
+                new_node=new_node, daemon_sets=all_ds,
+                corrected_ds_overhead=corrected_ds_overhead,
+            )
+        sweep = sweeps.get(i)
+        if sweep is None:
+            return doc
+        s_idx = int(np.argmax(sweep.unplaced))
+        rows_s = np.asarray(sweep.requeue_rows[s_idx])
+        nodes_s = np.asarray(sweep.requeue_nodes[s_idx])
+        reasons_s = np.asarray(sweep.requeue_reasons[s_idx])
+        live = rows_s >= 0
+        stranded = rows_s[live & (nodes_s < 0)]
+        if not len(stranded):
+            return doc
+        # the drained cluster's final placement: requeued pods move to
+        # their landing nodes, pods that died with a failed node vacate
+        alive = valid & ~np.asarray(sweep.scenarios.masks[s_idx], bool)
+        nodes_final = nodes.copy()
+        nodes_final[rows_s[live]] = nodes_s[live]
+        on_failed = (nodes_final >= 0) & ~alive[np.clip(nodes_final, 0, None)]
+        nodes_final[on_failed] = -1
+        reasons_full = np.zeros(len(nodes), np.int32)
+        reasons_full[rows_s[live]] = reasons_s[live]
+        doc["worst_scenario"] = sweep.scenarios.labels[s_idx]
+        doc["bottleneck"] = bottleneck_analysis(
+            tensors, batch, nodes_final, reasons_full, rows=stranded,
+            node_valid=alive, new_node=new_node, daemon_sets=all_ds,
+            corrected_ds_overhead=corrected_ds_overhead,
+        )
+        return doc
+
     def fail(msg: str) -> ResiliencePlan:
         timings["total_s"] = time.perf_counter() - t_start
-        return ResiliencePlan(
+        out = ResiliencePlan(
             False, max_new_nodes, k, quantile, msg, probes=probes,
             sweep=None, timings=timings,
         )
+        out.explain = mk_explain()
+        return out
 
     fail_msg = (
         f"we have added {max_new_nodes} nodes but the workloads still do "

@@ -30,11 +30,18 @@ from simtpu.engine.state import (
     compact_spec,
     compress_state,
     ensure_dense,
-    state_gauge,
     state_nbytes,
 )
+from simtpu.obs.metrics import family as metrics_family
 from simtpu.synth import make_node, synth_apps, synth_cluster
 from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
+
+
+def state_gauge():
+    # registry-backed carried-state gauges (the alias view is gone)
+    from simtpu.engine.state import STATE_KEYS
+
+    return metrics_family("state", STATE_KEYS)
 
 
 def _round_robin_pods(apps):

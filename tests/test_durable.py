@@ -10,7 +10,7 @@ The load-bearing pins:
 - OOM backoff: an injected RESOURCE_EXHAUSTED on the first N dispatches
   triggers chunk-halving replays that converge to bit-identical
   placements on the serial scan, the bulk rounds engine, and the fault
-  sweep, with the events recorded in `backoff_counts()`;
+  sweep, with the events recorded in the `backoff.*` registry counters;
 - deadline/SIGINT: the run exits with a structured `partial=True` result
   and a flushed checkpoint — never an unhandled traceback — and the CLI
   maps it to the documented exit code 3;
@@ -30,12 +30,21 @@ import numpy as np
 import pytest
 
 from simtpu import AppResource, ResourceTypes
+from simtpu.obs.metrics import family as _metrics_family
+
+
+def backoff_counts():
+    # registry-backed backoff counters (the alias view is gone)
+    from simtpu.durable.backoff import BACKOFF_KEYS
+
+    return _metrics_family("backoff", BACKOFF_KEYS)
+
+
 from simtpu.durable import (
     CheckpointMismatch,
     PlanCheckpoint,
     PlanInterrupted,
     RunControl,
-    backoff_counts,
     plan_fingerprint,
 )
 from simtpu.plan.capacity import plan_capacity

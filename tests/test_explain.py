@@ -1,0 +1,504 @@
+"""Decision observability (ISSUE 13, simtpu/explain):
+
+- failure breakdown: per-stage elimination counts + feasible survivors
+  sum to the valid node count for EVERY unplaced pod on a fuzz-generated
+  gnarly case, bit-equal between the jitted pass and the pure-numpy twin
+  (SIMTPU_EXPLAIN_JIT=0), with the rendered status string's first-failing
+  stage agreeing with the legacy REASON_TEXT reason bit-for-bit;
+- the cascade-order pin: STAGES mirrors engine/scan.FILTER_CASCADE and
+  StepEval.fail_code, and every FAIL_* code has a REASON_TEXT entry (the
+  exhaustiveness guard making `_record_failed`'s fallback unreachable);
+- the off path is zero-cost: a placement without --explain bumps no
+  explain.* instrument and traces no compile.explain executable;
+- score attribution: recomputed argmax == recorded landing node
+  (prefix-state exactness), all plugins present, margin >= 0;
+- bottleneck: a cpu-starved problem names cpu as binding and sizes the
+  deficit in template nodes;
+- surfaces: simulate(explain=), the three planners' explain blocks, the
+  `simtpu explain` subcommand, and --explain on apply --json.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from simtpu.core.tensorize import Tensorizer
+from simtpu.engine.scan import (
+    FILTER_CASCADE,
+    OK,
+    REASON_TEXT,
+    Engine,
+    StepEval,
+)
+from simtpu.explain import (
+    STAGES,
+    attribute_scores,
+    bottleneck_analysis,
+    explain_failures,
+)
+from simtpu.obs.metrics import REGISTRY
+from simtpu.synth import make_deployment, make_node, synth_apps, synth_cluster
+from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
+
+
+def _expand(apps):
+    pods = []
+    for a in apps:
+        pods.extend(get_valid_pods_exclude_daemonset(a.resource))
+    return pods
+
+
+def _place(cluster, pods, factory=Engine):
+    tz = Tensorizer(cluster.nodes, storage_classes=cluster.storage_classes)
+    eng = factory(tz)
+    batch = tz.add_pods(pods)
+    nodes, reasons, extras = eng.place(batch)
+    return tz, eng, batch, np.asarray(nodes), np.asarray(reasons), extras
+
+
+@pytest.fixture(scope="module")
+def gnarly():
+    """A fuzz-generated gnarly case (the audit fuzzer's generator) made
+    infeasible on several axes: hard anti-affinity pressure plus a fat
+    deployment no node can hold."""
+    from simtpu.audit.fuzz import gen_case
+
+    cluster, apps, _mix = gen_case(seed=5, n_nodes=12, n_pods=72)
+    apps[0].resource.deployments.append(
+        make_deployment("fat-cpu", 3, 10_000_000, 8)
+    )
+    return _place(cluster, _expand(apps))
+
+
+class TestCascadeOrderPin:
+    def test_stages_mirror_filter_cascade(self):
+        """The explain stage table IS FILTER_CASCADE (field names
+        shortened) — the breakdown's first-failing stage and
+        StepEval.fail_code can never drift."""
+        assert len(STAGES) == len(FILTER_CASCADE)
+        for (key, code), (field, fcode) in zip(STAGES, FILTER_CASCADE):
+            assert code == fcode
+            assert field == ("m_all" if key == "interpod" else f"m_{key}")
+        assert set(f for f, _ in FILTER_CASCADE) <= set(StepEval._fields)
+
+    def test_reason_text_exhaustive(self):
+        """Every FAIL_* code renders a real reason — the guard that makes
+        `Simulator._record_failed`'s "unschedulable" fallback (and the
+        incremental planner's copy) unreachable."""
+        import simtpu.engine.scan as scan
+
+        codes = {
+            v for k, v in vars(scan).items()
+            if k.startswith("FAIL_") and isinstance(v, int)
+        }
+        assert codes == set(REASON_TEXT)
+        assert OK not in REASON_TEXT
+
+    def test_fail_code_is_first_empty_stage(self):
+        """StepEval.fail_code == the first FILTER_CASCADE stage whose
+        mask is empty, on every single-empty-stage combination."""
+        import jax.numpy as jnp
+
+        n = 4
+        fields = [f for f, _ in FILTER_CASCADE]
+        for empty_at in range(len(fields)):
+            masks = {}
+            for s, f in enumerate(fields):
+                masks[f] = jnp.zeros(n, bool) if s >= empty_at else jnp.ones(n, bool)
+            ev = StepEval(
+                **masks,
+                score=jnp.zeros(n),
+                score_nostorage=jnp.zeros(n),
+                lvm_alloc=jnp.zeros((n, 1)),
+                dev_take=jnp.zeros((n, 1), bool),
+                gpu_shares=jnp.zeros((n, 1)),
+            )
+            assert int(ev.fail_code()) == FILTER_CASCADE[empty_at][1]
+
+
+class TestFailureBreakdown:
+    def test_counts_sum_to_n_and_match_numpy_oracle(self, gnarly, monkeypatch):
+        """The acceptance pin: for EVERY unplaced pod of the gnarly case,
+        per-stage elimination counts (+ feasible survivors) sum to N, and
+        the jitted pass is bit-equal to the pure-numpy twin — counts,
+        survivors, witnesses, and fail codes."""
+        tz, eng, batch, nodes, reasons, _ = gnarly
+        tensors = tz.freeze()
+        unp = np.flatnonzero(nodes < 0)
+        assert len(unp) >= 3, "the gnarly case must actually strand pods"
+        state = eng.carried_state()
+        bd = explain_failures(tensors, batch, unp, state, reasons=reasons)
+        assert bd.mode == "jit"
+        n = tensors.alloc.shape[0]
+        assert bd.n_nodes == n
+        total = bd.counts.sum(axis=1) + bd.feasible
+        assert np.array_equal(total, np.full(len(unp), n)), (
+            bd.counts, bd.feasible
+        )
+        monkeypatch.setenv("SIMTPU_EXPLAIN_JIT", "0")
+        twin = explain_failures(tensors, batch, unp, state, reasons=reasons)
+        assert twin.mode == "numpy"
+        assert np.array_equal(bd.counts, twin.counts)
+        assert np.array_equal(bd.feasible, twin.feasible)
+        assert np.array_equal(bd.fail_code, twin.fail_code)
+        assert np.array_equal(bd.witnesses, twin.witnesses)
+
+    def test_witnesses_are_eliminated_nodes(self, gnarly):
+        tz, eng, batch, nodes, reasons, _ = gnarly
+        tensors = tz.freeze()
+        unp = np.flatnonzero(nodes < 0)
+        state = eng.carried_state()
+        bd = explain_failures(tensors, batch, unp, state, reasons=reasons)
+        k = bd.witnesses.shape[2]
+        for i in range(len(bd)):
+            for s in range(len(STAGES)):
+                wit = bd.witnesses[i, s]
+                real = wit[wit >= 0]
+                # as many witnesses as eliminations, up to the cap, all
+                # valid node indices, strictly ascending (lowest-first)
+                assert len(real) == min(int(bd.counts[i, s]), k)
+                assert np.all(real < bd.n_nodes)
+                assert np.all(np.diff(real) > 0)
+
+    def test_status_first_failing_stage_is_legacy_reason(self):
+        """A pod that fails AFTER everything else placed (end state ==
+        attempt state): the recorded fail code equals the breakdown's
+        first-failing stage, and the rendered status entry for it is the
+        REASON_TEXT string bit-for-bit — the legacy headline, now with a
+        count in front."""
+        cluster = synth_cluster(6, seed=11, zones=2, taint_frac=0.0)
+        apps = synth_apps(12, seed=12, zones=2, pods_per_deployment=6)
+        apps[-1].resource.deployments.append(
+            make_deployment("zz-fat", 1, 10_000_000, 8)
+        )
+        tz, eng, batch, nodes, reasons, _ = _place(cluster, _expand(apps))
+        tensors = tz.freeze()
+        unp = np.flatnonzero(nodes < 0)
+        assert len(unp) == 1
+        state = eng.carried_state()
+        bd = explain_failures(tensors, batch, unp, state, reasons=reasons)
+        assert int(bd.fail_code[0]) == int(reasons[unp[0]])
+        assert bd.headline(0) == REASON_TEXT[int(reasons[unp[0]])]
+        # the first failing stage = the LAST stage in cascade order with
+        # a nonzero elimination count; its status entry is
+        # "<count> <REASON_TEXT>" verbatim
+        nz = [s for s in range(len(STAGES)) if bd.counts[0, s] > 0]
+        first_fail = nz[-1]
+        assert STAGES[first_fail][1] == int(bd.fail_code[0])
+        expected = f"{int(bd.counts[0, first_fail])} {REASON_TEXT[STAGES[first_fail][1]]}"
+        assert expected in bd.status(0)
+        assert bd.status(0).startswith(f"0/{bd.n_nodes} nodes are available: ")
+        assert int(bd.feasible[0]) == 0
+
+    def test_forced_pod_status_reports_recorded_reason(self):
+        """A spec.nodeName pod pinned to a node outside the cluster never
+        ran the cascade: zero stage counts on a non-empty cluster must
+        render the recorded reason — not 'no nodes in the cluster',
+        which would be false on a cluster that has nodes."""
+        from simtpu.engine.scan import FAIL_NO_NODE
+
+        cluster = synth_cluster(4, seed=81, zones=2)
+        apps = synth_apps(4, seed=82, zones=2, pods_per_deployment=2)
+        pods = _expand(apps)
+        pods[0]["spec"]["nodeName"] = "no-such-node"
+        tz, eng, batch, nodes, reasons, _ = _place(cluster, pods)
+        tensors = tz.freeze()
+        unp = np.flatnonzero(nodes < 0)
+        bd = explain_failures(
+            tensors, batch, unp, eng.carried_state(), reasons=reasons
+        )
+        idx = [i for i in range(len(bd)) if int(bd.reasons[i]) == FAIL_NO_NODE]
+        assert idx, "the forced pod must strand with FAIL_NO_NODE"
+        i = idx[0]
+        assert bd.counts[i].sum() == 0 and int(bd.feasible[i]) == 0
+        assert REASON_TEXT[FAIL_NO_NODE] in bd.status(i)
+        assert "no nodes in the cluster" not in bd.status(i)
+
+    def test_groups_cap_reported_not_silent(self, gnarly):
+        tz, eng, batch, nodes, reasons, _ = gnarly
+        tensors = tz.freeze()
+        unp = np.flatnonzero(nodes < 0)
+        state = eng.carried_state()
+        bd = explain_failures(tensors, batch, unp, state, reasons=reasons)
+        doc = bd.to_doc(top=1)
+        assert len(doc["groups"]) == 1
+        distinct = len(
+            {
+                (int(bd.reasons[i]), tuple(map(int, bd.counts[i])))
+                for i in range(len(bd))
+            }
+        )
+        if distinct > 1:
+            assert doc["truncated_groups"] == distinct - 1
+        assert doc["version"] >= 1
+        assert doc["unplaced"] == len(unp)
+
+
+class TestOffPathZeroCost:
+    def test_no_explain_instruments_without_request(self):
+        """The acceptance pin for the off path: an ordinary placement
+        (explain never requested) bumps no explain.* instrument and
+        traces no compile.explain executable — pinned via registry
+        deltas, the same counters that account every device dispatch."""
+        cluster = synth_cluster(6, seed=31, zones=2)
+        apps = synth_apps(18, seed=32, zones=2, pods_per_deployment=6)
+        before = REGISTRY.snapshot()
+        _place(cluster, _expand(apps))
+        delta = REGISTRY.delta_since(before)
+        for name, v in delta.items():
+            if name.startswith("explain.") or name == "compile.explain":
+                base = before.get(name)
+                assert v == 0 or v == base or (
+                    isinstance(v, dict) and v.get("count") == 0
+                ), f"{name} moved without --explain: {v}"
+
+    def test_simulate_without_explain_attaches_nothing(self):
+        from simtpu.api import simulate
+        from simtpu.core.objects import ResourceTypes
+
+        cluster = synth_cluster(4, seed=33, zones=2)
+        trial = ResourceTypes(**{k: list(v) for k, v in vars(cluster).items()})
+        trial.pods = _expand(synth_apps(6, seed=34, zones=2, pods_per_deployment=3))
+        result = simulate(trial)
+        assert result.explain is None
+
+
+class TestScoreAttribution:
+    def test_argmax_matches_recorded_and_all_plugins_present(self):
+        cluster = synth_cluster(8, seed=41, zones=2, taint_frac=0.1)
+        apps = synth_apps(
+            24, seed=42, zones=2, pods_per_deployment=8,
+            anti_affinity_frac=0.3, spread_frac=0.4, selector_frac=0.3,
+        )
+        tz, eng, batch, nodes, reasons, extras = _place(cluster, _expand(apps))
+        tensors = tz.freeze()
+        docs = attribute_scores(tensors, batch, nodes, extras, max_pods=6)
+        assert 0 < len(docs) <= 6
+        plugins = {
+            "NodeResourcesLeastAllocated", "NodeResourcesBalancedAllocation",
+            "Simon", "Open-Gpu-Share", "NodeAffinity", "TaintToleration",
+            "InterPodAffinity", "PodTopologySpread", "SelectorSpread",
+            "ImageLocality", "NodePreferAvoidPods", "Open-Local",
+        }
+        for d in docs:
+            assert d["consistent"], d
+            assert d["winner"] == d["node"]
+            assert {t["plugin"] for t in d["terms"]} == plugins
+            if d["margin"] is not None:
+                assert d["margin"] >= 0
+
+    def test_extras_from_log_round_trip(self):
+        from simtpu.explain import extras_from_log
+
+        cluster = synth_cluster(6, seed=43, zones=2)
+        apps = synth_apps(12, seed=44, zones=2, pods_per_deployment=4)
+        tz, eng, batch, nodes, reasons, extras = _place(cluster, _expand(apps))
+        tensors = tz.freeze()
+        rebuilt = extras_from_log(tensors, nodes, eng.ext_log)
+        for key in ("lvm_alloc", "dev_take", "gpu_shares"):
+            assert np.array_equal(
+                np.asarray(rebuilt[key]), np.asarray(extras[key])
+            ), key
+
+
+class TestBottleneck:
+    def test_cpu_starved_names_cpu_binding_and_sizes_template(self):
+        cluster = synth_cluster(4, seed=51, zones=2)
+        apps = synth_apps(8, seed=52, zones=2, pods_per_deployment=4)
+        # 6 pods of 48 cores each against a small cluster: cpu-binding
+        apps[0].resource.deployments.append(
+            make_deployment("hungry", 6, 48000, 1)
+        )
+        tz, eng, batch, nodes, reasons, _ = _place(cluster, _expand(apps))
+        tensors = tz.freeze()
+        unp = np.flatnonzero(nodes < 0)
+        assert len(unp) >= 1
+        template = make_node("tmpl", 64000, 128, {"kubernetes.io/hostname": "tmpl"})
+        doc = bottleneck_analysis(
+            tensors, batch, nodes, reasons, new_node=template,
+            free=np.asarray(eng.carried_state().free),
+        )
+        assert doc["unplaced"] == len(unp)
+        assert doc["binding"]["resource"] == "cpu"
+        assert doc["capacity_shaped"] >= 1
+        tpl = doc["template"]
+        assert tpl["helpable"] >= 1
+        assert tpl.get("template_nodes_hint", 0) >= 1
+
+    def test_stateless_doc_free_override_wins(self):
+        """build_explain_doc(state=None, free=...): a caller that can see
+        more placements than `nodes_arr` covers (the incremental
+        planner's checkpoint-replayed probe candidates, whose sliced
+        batch hides the base run's consumption) supplies the full free
+        matrix — the bottleneck must use it, not re-derive an overstated
+        one from the slice."""
+        from simtpu.explain import build_explain_doc
+
+        cluster = synth_cluster(4, seed=55, zones=2)
+        apps = synth_apps(8, seed=56, zones=2, pods_per_deployment=4)
+        apps[0].resource.deployments.append(
+            make_deployment("fat", 2, 10_000_000, 4)
+        )
+        tz, eng, batch, nodes, reasons, _ = _place(cluster, _expand(apps))
+        tensors = tz.freeze()
+        unp = np.flatnonzero(nodes < 0)
+        assert len(unp) >= 1
+        exhausted = np.zeros_like(np.asarray(tensors.alloc))
+        doc = build_explain_doc(
+            tensors, batch, unp, None, nodes, reasons, free=exhausted
+        )
+        assert "failures" not in doc  # no carry, breakdown degrades away
+        for res in doc["bottleneck"]["resources"]:
+            assert res["free"] == 0.0, res
+        # and without the override the slice-derived free is nonzero
+        doc2 = build_explain_doc(tensors, batch, unp, None, nodes, reasons)
+        assert any(r["free"] > 0 for r in doc2["bottleneck"]["resources"])
+
+    def test_empty_unplaced_set_is_empty_doc(self):
+        cluster = synth_cluster(4, seed=53, zones=2)
+        apps = synth_apps(6, seed=54, zones=2, pods_per_deployment=3)
+        tz, eng, batch, nodes, reasons, _ = _place(cluster, _expand(apps))
+        assert bottleneck_analysis(tz.freeze(), batch, nodes, reasons) == {}
+
+
+class TestSurfaces:
+    def test_simulate_explain_block(self):
+        from simtpu.api import simulate
+        from simtpu.core.objects import ResourceTypes
+
+        cluster = synth_cluster(4, seed=61, zones=2)
+        apps = synth_apps(6, seed=62, zones=2, pods_per_deployment=3)
+        apps[0].resource.deployments.append(
+            make_deployment("fat", 2, 10_000_000, 4)
+        )
+        trial = ResourceTypes(**{k: list(v) for k, v in vars(cluster).items()})
+        trial.pods = _expand(apps)
+        result = simulate(trial, explain=True)
+        doc = result.explain
+        assert doc and doc["failures"]["unplaced"] == len(result.unscheduled_pods)
+        groups = doc["failures"]["groups"]
+        assert groups and all("status" in g for g in groups)
+        # the headline stays the legacy reason: each group's reason text
+        # appears verbatim inside the recorded UnscheduledPod reason
+        by_reason = {g["reason"] for g in groups}
+        assert any(
+            any(r in u.reason for r in by_reason)
+            for u in result.unscheduled_pods
+        )
+        assert doc["bottleneck"]["unplaced"] >= 1
+
+    def test_plan_capacity_failure_carries_explain(self):
+        from simtpu.plan.capacity import plan_capacity
+
+        cluster = synth_cluster(3, seed=63, zones=2)
+        apps = synth_apps(4, seed=64, zones=2, pods_per_deployment=2)
+        apps[0].resource.deployments.append(
+            make_deployment("fat", 2, 10_000_000, 4)
+        )
+        template = make_node("tmpl", 4000, 8, {"kubernetes.io/hostname": "tmpl"})
+        plan = plan_capacity(
+            cluster, apps, template, max_new_nodes=3, explain=True, audit=False
+        )
+        assert not plan.success
+        assert plan.explain, "a failing explained plan must carry the block"
+        assert plan.explain.get("bottleneck", {}).get("unplaced", 0) >= 1
+
+    def test_plan_capacity_incremental_failure_carries_explain(self):
+        from simtpu.plan.incremental import plan_capacity_incremental
+
+        cluster = synth_cluster(3, seed=65, zones=2)
+        apps = synth_apps(4, seed=66, zones=2, pods_per_deployment=2)
+        apps[0].resource.deployments.append(
+            make_deployment("fat", 2, 10_000_000, 4)
+        )
+        template = make_node("tmpl", 4000, 8, {"kubernetes.io/hostname": "tmpl"})
+        plan = plan_capacity_incremental(
+            cluster, apps, template, max_new_nodes=3, explain=True, audit=False
+        )
+        assert not plan.success
+        assert plan.explain
+        bn = plan.explain.get("bottleneck", {})
+        assert bn.get("unplaced", 0) >= 1
+        assert "failures" in plan.explain
+        # the what-to-buy verdict rides the template block
+        assert "template" in bn
+
+    def test_plan_resilience_failure_carries_explain(self):
+        from simtpu.plan.resilience import plan_resilience
+
+        cluster = synth_cluster(3, seed=67, zones=2)
+        apps = synth_apps(6, seed=68, zones=2, pods_per_deployment=3)
+        apps[0].resource.deployments.append(
+            make_deployment("fat", 2, 10_000_000, 4)
+        )
+        plan = plan_resilience(
+            cluster, apps, new_node=None, spec="k=1", explain=True, audit=False
+        )
+        assert not plan.success
+        assert plan.explain
+        assert plan.explain.get("bottleneck", {}).get("unplaced", 0) >= 1
+
+    @pytest.mark.slow
+    def test_cli_explain_subcommand_json(self, capsys):
+        from simtpu.cli import main
+
+        rc = main([
+            "explain", "-f", "examples/simtpu-config.yaml", "--json",
+            "--scores", "2",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0
+        doc = json.loads(out)
+        assert doc["version"] >= 1
+        assert doc["placed"] + doc["unplaced"] == doc["pods"]
+        assert len(doc.get("scores") or []) <= 2
+        for s in doc.get("scores") or []:
+            assert s["consistent"]
+
+    @pytest.mark.slow
+    def test_cli_apply_explain_json_and_off_default(self, capsys):
+        from simtpu.cli import main
+
+        rc = main([
+            "apply", "-f", "examples/simtpu-config.yaml", "--json",
+            "--explain", "--no-audit",
+        ])
+        out = capsys.readouterr().out
+        assert rc in (0, 1)
+        doc = json.loads(out)
+        # a feasible plan explains nothing (no unplaced pods) — the block
+        # is version-only or absent; an infeasible one carries failures
+        if "explain" in doc:
+            assert doc["explain"]["version"] >= 1
+
+    def test_explain_report_renders(self):
+        from simtpu.report import explain_report
+
+        cluster = synth_cluster(4, seed=71, zones=2)
+        apps = synth_apps(6, seed=72, zones=2, pods_per_deployment=3)
+        apps[0].resource.deployments.append(
+            make_deployment("fat", 2, 10_000_000, 4)
+        )
+        tz, eng, batch, nodes, reasons, extras = _place(cluster, _expand(apps))
+        tensors = tz.freeze()
+        unp = np.flatnonzero(nodes < 0)
+        bd = explain_failures(
+            tensors, batch, unp, eng.carried_state(), reasons=reasons
+        )
+        doc = {
+            "version": 1,
+            "failures": bd.to_doc(),
+            "bottleneck": bottleneck_analysis(
+                tensors, batch, nodes, reasons,
+                free=np.asarray(eng.carried_state().free),
+            ),
+            "scores": attribute_scores(tensors, batch, nodes, extras, max_pods=2),
+        }
+        text = explain_report(doc)
+        assert "Why Unschedulable" in text
+        assert "Bottleneck" in text
+        assert "Score Attribution" in text
+        assert "nodes are available" in text

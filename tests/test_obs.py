@@ -4,9 +4,10 @@
   wraparound, Perfetto (Chrome trace-event) export validity, and the
   zero-overhead no-op contract when disabled;
 - metrics registry: typed instruments, snapshot/delta protocol, and the
-  legacy alias views (`fetch_counts` / `trace_counts` / `wave_counts` /
-  `backoff_counts` / `state_gauge`) staying bit-equal to the registry
-  across the wavefront/compact engine A/Bs;
+  engine counter families (`fetch.*` / `compile.*` / `wavefront.*` /
+  `backoff.*` / `state.*`) read directly off the registry across the
+  wavefront/compact engine A/Bs (the one-release legacy alias views are
+  gone — ISSUE 13 — and their removal is pinned here);
 - flight recorder: a bundle lands on the injected exit-3 (deadline) and
   exit-4 (audit divergence) CLI paths, and SIMTPU_FLIGHT=0 disables it;
 - CLI surface: `apply --trace` writes a valid trace whose span sums
@@ -216,26 +217,39 @@ def problem():
     return cluster, pods
 
 
-class TestRegistryAliases:
-    """The five legacy counter families are ALIAS VIEWS of the registry:
-    same keys, values bit-equal — across the wavefront and compact-carry
-    engine A/Bs (the GSPMD shard A/B rides the same counters through
-    tests/test_telemetry.py's sharded-plan cases)."""
+class TestRegistryCounters:
+    """The engine counter families read directly off the registry —
+    across the wavefront and compact-carry engine A/Bs (the GSPMD shard
+    A/B rides the same counters through tests/test_telemetry.py's
+    sharded-plan cases).  The one-release legacy alias views
+    (`fetch_counts` et al.) are gone; their absence is pinned so they
+    cannot silently resurrect."""
+
+    def test_legacy_alias_views_removed(self):
+        import simtpu.durable.backoff as backoff_mod
+        import simtpu.engine.scan as scan_mod
+        import simtpu.engine.state as state_mod
+
+        for mod, name in (
+            (scan_mod, "fetch_counts"),
+            (scan_mod, "trace_counts"),
+            (scan_mod, "wave_counts"),
+            (backoff_mod, "backoff_counts"),
+            (state_mod, "state_gauge"),
+        ):
+            assert not hasattr(mod, name), (
+                f"{mod.__name__}.{name} was removed in ISSUE 13 — read "
+                "the obs registry instead"
+            )
 
     @pytest.mark.parametrize("speculate", [False, True])
     @pytest.mark.parametrize("compact", [False, True])
-    def test_aliases_bit_equal_after_placement(
+    def test_registry_counters_after_placement(
         self, problem, speculate, compact
     ):
         from simtpu.core.tensorize import Tensorizer
-        from simtpu.durable.backoff import backoff_counts
-        from simtpu.engine.scan import (
-            Engine,
-            fetch_counts,
-            trace_counts,
-            wave_counts,
-        )
-        from simtpu.engine.state import state_gauge
+        from simtpu.engine.scan import Engine
+        from simtpu.obs.metrics import family
 
         cluster, pods = problem
         before = REGISTRY.snapshot()
@@ -245,50 +259,30 @@ class TestRegistryAliases:
         eng.compact = compact
         nodes, _, _ = eng.place(tz.add_pods(pods))
 
-        fetch = fetch_counts()
-        assert fetch == {
-            "get": REGISTRY.value("fetch.get"),
-            "bytes": REGISTRY.value("fetch.bytes"),
-        }
+        from simtpu.durable.backoff import BACKOFF_KEYS
+        from simtpu.engine.scan import FETCH_KEYS, WAVE_KEYS
+
+        fetch = family("fetch", FETCH_KEYS)
         assert fetch["get"] > before.get("fetch.get", 0)
         assert fetch["bytes"] - before.get("fetch.bytes", 0) >= nodes.size * 4
 
-        waves = wave_counts()
-        assert waves == {
-            k: REGISTRY.value(f"wavefront.{k}")
-            for k in (
-                "wavefronts", "pods", "accepted", "rollbacks",
-                "rollback_pods",
-            )
-        }
+        waves = family("wavefront", WAVE_KEYS)
         if speculate:
             assert waves["pods"] > before.get("wavefront.pods", 0)
         # accept/rollback accounting is complete: every drafted pod is
         # either accepted or rolled back
         assert waves["accepted"] + waves["rollback_pods"] == waves["pods"]
 
-        traces = trace_counts()
-        assert traces == {
-            k: REGISTRY.value(f"compile.{k}")
-            for k in ("scan", "rounds", "wave")
-        }
+        gauge_bytes = REGISTRY.value("state.carried_bytes")
+        planes = REGISTRY.value("state.planes", default={})
+        assert gauge_bytes == sum(planes.values())
 
-        gauge = state_gauge()
-        assert gauge["carried_bytes"] == REGISTRY.value("state.carried_bytes")
-        assert gauge["compact"] == REGISTRY.value("state.compact")
-        assert gauge["carried_bytes"] == sum(gauge["planes"].values())
-
-        back = backoff_counts()
-        assert back == {
-            "events": REGISTRY.value("backoff.events"),
-            "splits": REGISTRY.value("backoff.splits"),
-            "chunk_min": REGISTRY.value("backoff.chunk_min"),
-        }
+        back = family("backoff", BACKOFF_KEYS)
+        assert back["events"] >= 0 and back["splits"] >= 2 * back["events"] - 1
 
     def test_compact_ab_same_placements_different_gauge(self, problem):
         from simtpu.core.tensorize import Tensorizer
         from simtpu.engine.rounds import RoundsEngine
-        from simtpu.engine.state import state_gauge
 
         cluster, pods = problem
         results = {}
@@ -299,10 +293,13 @@ class TestRegistryAliases:
             eng = RoundsEngine(tz)
             eng.compact = compact
             nodes, _, _ = eng.place(tz.add_pods(pods))
-            results[compact] = (np.asarray(nodes), state_gauge())
+            results[compact] = (
+                np.asarray(nodes),
+                bool(REGISTRY.value("state.compact", default=False)),
+            )
         assert np.array_equal(results[True][0], results[False][0])
-        assert results[True][1]["compact"] is True
-        assert results[False][1]["compact"] is False
+        assert results[True][1] is True
+        assert results[False][1] is False
 
 
 class TestFlightRecorder:

@@ -88,7 +88,11 @@ def test_concurrent_precompile_one_executable_per_signature():
     once per trace, shared by the AOT lowering and the jit path)."""
     import jax
 
-    from simtpu.engine.scan import trace_counts
+    from simtpu.engine.scan import COMPILE_COUNT_KINDS
+    from simtpu.obs.metrics import family as metrics_family
+
+    def trace_counts():
+        return metrics_family("compile", COMPILE_COUNT_KINDS)
 
     jax.clear_caches()  # compile accounting must start cold
     pods = _mixed_pods()
@@ -128,7 +132,12 @@ def test_stretch_group_fetch_coalescing():
     batch has >= 3 kind-stretches and no scan segments or leftovers, so
     the whole placement pays exactly one fetch."""
     from simtpu.engine.rounds import RoundsEngine
-    from simtpu.engine.scan import fetch_counts
+    from simtpu.obs.metrics import family as metrics_family
+
+    from simtpu.engine.scan import FETCH_KEYS
+
+    def fetch_counts():
+        return metrics_family("fetch", FETCH_KEYS)
 
     pods = _mixed_pods()
     tz = Tensorizer(_nodes())

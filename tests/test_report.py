@@ -1,0 +1,252 @@
+"""report.py rendering edge cases (ISSUE 13 satellite — ~400 lines of
+table assembly with no dedicated test module until now):
+
+- `render_table`: the column-0 auto-merge (tablewriter's
+  SetAutoMergeCellsByColumnIndex([0])) interacting with multi-line cells,
+  width computation across embedded newlines, empty-row tables;
+- `resilience_report` / `audit_report`: the truncation caps (worst
+  scenarios / critical nodes / witness detail) stay caps, not crashes;
+- `explain_report`: renders every section and degrades to one line on an
+  empty doc.
+"""
+
+from __future__ import annotations
+
+from simtpu.report import (
+    audit_report,
+    explain_report,
+    render_table,
+    resilience_report,
+)
+
+
+class TestRenderTable:
+    def test_empty_rows_renders_header_only(self):
+        out = render_table(["A", "Bee"], [])
+        lines = out.split("\n")
+        # separator, header, separator — nothing else
+        assert len(lines) == 3
+        assert lines[0] == lines[2]
+        assert "A" in lines[1] and "BEE" in lines[1]
+
+    def test_col0_merge_repeats_blanked(self):
+        rows = [["n1", "a"], ["n1", "b"], ["n2", "c"], ["n1", "d"]]
+        out = render_table(["Node", "Pod"], rows)
+        body = [ln for ln in out.split("\n") if ln.startswith("|")]
+        # row 2 ("n1", "b") merges col 0; row 4's "n1" is a NEW run and
+        # stays (the merge compares adjacent rows only)
+        cells0 = [ln.split("|")[1].strip() for ln in body[1:]]
+        assert cells0 == ["n1", "", "n2", "n1"]
+
+    def test_col0_merge_off(self):
+        rows = [["x", "a"], ["x", "b"]]
+        out = render_table(["K", "V"], rows, merge_col0=False)
+        body = [ln for ln in out.split("\n") if ln.startswith("|")]
+        assert [ln.split("|")[1].strip() for ln in body[1:]] == ["x", "x"]
+
+    def test_multiline_cells_set_height_and_width(self):
+        rows = [
+            ["n1", "line-one\nline-two-is-much-longer", "z"],
+            ["n1", "short", "w"],
+        ]
+        out = render_table(["Node", "Detail", "X"], rows)
+        lines = out.split("\n")
+        body = [ln for ln in lines if ln.startswith("|")]
+        # first data row renders as TWO physical lines
+        assert len(body) == 1 + 2 + 1  # header + 2-line row + 1-line row
+        # width follows the longest LINE, not the whole cell
+        sep = lines[0]
+        assert len("line-two-is-much-longer") + 2 <= max(
+            len(part) for part in sep.split("+")
+        )
+        # the second physical line of the multi-line row keeps the grid:
+        # col 0 and col 2 pad with spaces, every line has equal length
+        assert len({len(ln) for ln in lines}) == 1
+
+    def test_multiline_cell_in_merge_column(self):
+        """A multi-line cell in column 0 merges by FULL value — the next
+        row's identical multi-line value blanks entirely."""
+        rows = [["a\nb", "1"], ["a\nb", "2"], ["c", "3"]]
+        out = render_table(["K", "V"], rows)
+        body = [ln for ln in out.split("\n") if ln.startswith("|")]
+        # rows: header, 2-line row1, 1-line row2 (merged -> blank), row3
+        assert len(body) == 1 + 2 + 1 + 1
+        merged_row = body[3]
+        assert merged_row.split("|")[1].strip() == ""
+
+
+class _FakeScenarios:
+    def __init__(self, labels):
+        self.labels = labels
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class _FakeSweep:
+    """Duck-typed stand-in for faults.sweep.SweepResult — exactly the
+    surface resilience_report consumes."""
+
+    def __init__(self, n=25):
+        self.scenarios = _FakeScenarios(
+            tuple(f"node:n-{i:03d}" for i in range(n))
+        )
+        self.unplaced = [i % 7 for i in range(n)]
+
+    def worst(self, top: int = 10):
+        pairs = sorted(
+            zip(self.scenarios.labels, self.unplaced), key=lambda kv: -kv[1]
+        )
+        pairs = [kv for kv in pairs if kv[1] > 0]
+        return pairs[:top]
+
+    def critical_nodes(self, top: int = 10):
+        return [(f"n-{i:03d}", 7 - i) for i in range(min(top, 6))]
+
+
+class TestResilienceReport:
+    def test_truncation_caps_apply(self):
+        sweep = _FakeSweep(25)
+        out = resilience_report(sweep, top=3)
+        # worst-scenario table capped at 3 data rows
+        worst_section = out.split("Worst Scenarios")[1].split(
+            "Most Critical Nodes"
+        )[0]
+        data_rows = [
+            ln for ln in worst_section.split("\n")
+            if ln.startswith("|") and "SCENARIO" not in ln.upper()
+        ]
+        assert len(data_rows) == 3
+        crit_section = out.split("Most Critical Nodes")[1]
+        crit_rows = [
+            ln for ln in crit_section.split("\n")
+            if ln.startswith("|") and "NODE" not in ln.upper()
+        ]
+        assert len(crit_rows) == 3
+
+    def test_all_survived_omits_worst_table(self):
+        sweep = _FakeSweep(4)
+        sweep.unplaced = [0, 0, 0, 0]
+        out = resilience_report(sweep)
+        assert "Worst Scenarios" not in out
+        assert "SURVIVAL" in out  # header row renders uppercased
+
+
+class TestAuditReport:
+    def test_not_run_and_clean_one_liners(self):
+        assert audit_report({}) == "Audit: not run (--no-audit)"
+        clean = audit_report(
+            {"ok": True, "checked": 9, "wall_s": 0.123, "mode": "jit"}
+        )
+        assert clean.startswith("Audit: clean (9 placements certified")
+        assert "\n" not in clean
+
+    def test_detail_rows_render_capped_witnesses(self):
+        doc = {
+            "ok": False,
+            "checked": 5,
+            "violations": 2,
+            "detail": [
+                {
+                    "class": "overcommit",
+                    "pod": "ns/p1",
+                    "node": "n1",
+                    "witness": {"cpu": 9, "free": -1},
+                },
+                {"class": "ports", "pod": "ns/p2", "node": "n2"},
+            ],
+        }
+        out = audit_report(doc)
+        assert "Audit: FAILED — 2 violation(s) over 5 placements" in out
+        assert "overcommit" in out and "cpu=9" in out
+
+    def test_fallback_and_divergence_sections(self):
+        doc = {
+            "ok": False,
+            "fallback": True,
+            "fallback_audit": {"ok": True},
+            "checked": 3,
+            "violations": 1,
+            "divergence": {
+                "divergent_pods": 1,
+                "first_divergent_pod": "ns/p",
+                "state_planes": ["free: max|d|=1", "cnt_match: max|d|=2"],
+            },
+        }
+        out = audit_report(doc)
+        assert "PRIMARY ENGINE DIVERGED" in out
+        assert "serial-exact fallback certified" in out
+        assert "differing state planes: free: max|d|=1; cnt_match: max|d|=2" in out
+
+
+class TestExplainReport:
+    def test_empty_doc_degrades_to_one_line(self):
+        assert explain_report({}) == (
+            "Explain: nothing to explain (no unplaced pods selected)"
+        )
+        assert explain_report({"version": 1}) == (
+            "Explain: nothing to explain (no unplaced pods selected)"
+        )
+
+    def test_sections_render_from_doc(self):
+        doc = {
+            "version": 1,
+            "failures": {
+                "unplaced": 2,
+                "n_nodes": 5,
+                "mode": "jit",
+                "truncated_groups": 3,
+                "groups": [
+                    {
+                        "pods": 2,
+                        "example": "ns/p",
+                        "reason": "r",
+                        "status": "0/5 nodes are available: 5 x.",
+                        "stages": {"static": 3, "res": 2},
+                        "witnesses": {"static": ["n1", "n2"]},
+                        "feasible": 0,
+                    }
+                ],
+            },
+            "bottleneck": {
+                "capacity_shaped": 1,
+                "constraint_shaped": 1,
+                "resources": [
+                    {
+                        "resource": "cpu",
+                        "requested": 12.0,
+                        "free": 1.0,
+                        "share": 12.0,
+                        "fragmented": True,
+                    }
+                ],
+                "binding": {"resource": "cpu", "requested": 12.0, "free": 1.0},
+                "template": {
+                    "probed": 2,
+                    "helpable": 1,
+                    "never_helpable": 1,
+                    "never_reason": "taints",
+                    "template_nodes_hint": 4,
+                },
+            },
+            "scores": [
+                {
+                    "pod": "ns/q",
+                    "node": "n1",
+                    "runner_up": "n2",
+                    "margin": 1.5,
+                    "consistent": True,
+                    "terms": [
+                        {"plugin": "Simon", "weight": 1.0, "delta": 0.5},
+                        {"plugin": "SelectorSpread", "weight": 1.0, "delta": -1.0},
+                    ],
+                }
+            ],
+        }
+        out = explain_report(doc)
+        assert "Why Unschedulable (2 pod(s), 5 node(s), jit pass)" in out
+        assert "3 more failure shape(s)" in out
+        assert "binding constraint: cpu" in out
+        assert "4 template node(s)" in out
+        assert "Score Attribution" in out
+        assert "SelectorSpread: -1" in out

@@ -1,7 +1,8 @@
 """Byte-level transfer + carried-state telemetry (ISSUE 5): the
-`fetch_counts` round-trip/byte counters, the `state_gauge` per-plane
-carried-state breakdown, and their surfacing through `simtpu apply --json`'s
-engine block — present and consistent under the SIMTPU_WAVEFRONT and
+`fetch.*` round-trip/byte counters, the `state.*` per-plane carried-state
+gauges (read off the obs registry — the legacy alias views are gone,
+ISSUE 13), and their surfacing through `simtpu apply --json`'s engine
+block — present and consistent under the SIMTPU_WAVEFRONT and
 shard/no-shard A/Bs (the counters are observability, never behavior).
 """
 
@@ -14,8 +15,17 @@ import pytest
 
 from simtpu.core.tensorize import Tensorizer
 from simtpu.engine.rounds import RoundsEngine
-from simtpu.engine.scan import Engine, fetch_counts
-from simtpu.engine.state import CompactState, SchedState, state_gauge
+from simtpu.engine.scan import FETCH_KEYS, Engine
+from simtpu.engine.state import STATE_KEYS, CompactState, SchedState
+from simtpu.obs.metrics import family as metrics_family
+
+
+def fetch_counts():
+    return metrics_family("fetch", FETCH_KEYS)
+
+
+def state_gauge():
+    return metrics_family("state", STATE_KEYS)
 from simtpu.synth import make_node, synth_apps, synth_cluster
 from simtpu.workloads.expand import get_valid_pods_exclude_daemonset
 

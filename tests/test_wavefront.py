@@ -15,7 +15,13 @@ import pytest
 from simtpu import constants as C
 from simtpu.core.objects import set_label
 from simtpu.core.tensorize import Tensorizer
-from simtpu.engine.scan import Engine, wave_counts
+from simtpu.engine.scan import WAVE_KEYS, Engine
+from simtpu.obs.metrics import family as metrics_family
+
+
+def wave_counts():
+    # registry-backed speculation counters (the alias view is gone)
+    return metrics_family("wavefront", WAVE_KEYS)
 from simtpu.synth import make_deployment, make_node, synth_apps, synth_cluster
 from simtpu.workloads.expand import (
     get_valid_pods_exclude_daemonset,
