@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all install lint test test-all test-perf bench bench-cold bench-faults bench-layout bench-durable bench-audit bench-obs bench-explain fuzz-smoke clean
+.PHONY: all install lint test test-all test-perf bench bench-cold bench-faults bench-layout bench-durable bench-audit bench-obs bench-explain bench-multihost fuzz-smoke clean
 
 all: test
 
@@ -134,6 +134,19 @@ bench-explain:
 	SIMTPU_BENCH_PLAN=0 SIMTPU_BENCH_BIG=0 SIMTPU_BENCH_FAULTS=0 \
 	SIMTPU_BENCH_LAYOUT=0 SIMTPU_BENCH_DURABLE=0 SIMTPU_BENCH_AUDIT=0 \
 	SIMTPU_BENCH_OBS=0 $(PY) bench.py
+
+# multihost bench-point smoke: the `--multihost` launcher end to end at a
+# tiny shape — a fresh 8-forced-host-device subprocess places the
+# north-star mix through the GSPMD ShardedRoundsEngine, ASSERTING record
+# schema + pod accounting + the publish round-trip into a scratch
+# BASELINE (vs_target recomputed by the one documented formula, no warm
+# number from a single run). The full-shape run behind BASELINE.json's
+# `published` block is this same path at default knobs with --publish.
+bench-multihost:
+	SIMTPU_BENCH_MULTIHOST_ASSERT=1 \
+	SIMTPU_BENCH_MULTIHOST_NODES=200 SIMTPU_BENCH_MULTIHOST_PODS=1000 \
+	SIMTPU_BENCH_PODS_PER_DEP=50 \
+	$(PY) bench.py --multihost
 
 # differential fuzz over the fixed seed corpus at small shapes, across
 # the FULL engine-config matrix — 8 forced host devices arm the

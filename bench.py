@@ -74,6 +74,25 @@ warm placement, next to the `fetches` round-trip count),
 `engine_state_bytes` (the carried scheduling state under the active
 layout, per-plane gauge via the registry's `state.*` gauges), and
 `device_peak_bytes` (accelerator memory_stats high-water; None on CPU).
+
+`bench.py --multihost` is the separate MULTIHOST bench point: a fresh
+subprocess (8 forced host devices by default,
+SIMTPU_BENCH_MULTIHOST_DEVICES / _FORCE_HOST=0 for real TPU/GPU meshes)
+places the north-star mix through the GSPMD ShardedRoundsEngine with the
+node axis sharded over the mesh. It is ONE process over that mesh — the
+same computation tests/test_multihost.py pins bit-identical when the
+8-device mesh spans two real jax.distributed processes, but the walls
+include no cross-process DCN overhead (the record says so:
+`processes`) — and it emits the `multihost_place_*` record
+(`value`, `trajectory` = expand_tensorize_s / place_cold_s / optional
+place_warm_s when SIMTPU_BENCH_MULTIHOST_RUNS > 1 / end_to_end_s, full
+registry snapshot). `--record-out FILE` saves the raw record (the
+committed MULTIHOST_r*.json provenance artifacts); `--publish` /
+`bench.py --publish-multihost RECORD.json` write BASELINE.json's
+`published` block through publish_multihost() — the only writer, which
+recomputes every derived field (vs_target = round(60/value, 2)) so the
+published number is always reproducible from a committed measured record.
+`make bench-multihost` is the small-shape asserting smoke.
 """
 
 from __future__ import annotations
@@ -254,6 +273,17 @@ def time_serial_baseline(tensors, batch, req, limit: int) -> float:
     return (time.perf_counter() - t0) / max(n_pods, 1)
 
 
+class _FrozenTensorizer:
+    """Engine-constructor shim for an already-frozen tensor set: the
+    engines only ever call `.freeze()` on the tensorizer they are given."""
+
+    def __init__(self, tensors):
+        self._tensors = tensors
+
+    def freeze(self):
+        return self._tensors
+
+
 def time_bulk(tensors, batch, precompile: bool = False):
     """Seconds for a full bulk (rounds-engine) placement of the batch: the
     best of two fresh-engine runs, so the reported rate is the steady state a
@@ -267,16 +297,12 @@ def time_bulk(tensors, batch, precompile: bool = False):
     from simtpu.engine.rounds import RoundsEngine
     from simtpu.obs.metrics import REGISTRY
 
-    class _TZ:
-        def freeze(self):
-            return tensors
-
     nodes = reasons = None
     best, cold = float("inf"), None
     extra = {}
     pipe = None
     for i in range(2):
-        eng = RoundsEngine(_TZ())
+        eng = RoundsEngine(_FrozenTensorizer(tensors))
         t0 = time.perf_counter()
         if precompile and i == 0:
             from simtpu.engine.precompile import precompile_place
@@ -1148,6 +1174,321 @@ def time_plan():
     return out
 
 
+# the north-star constraint mix in words (build_problem mix="north"):
+# what the multihost published record certifies it ran
+_NORTH_CONSTRAINTS = (
+    "zone topology spread + preferred inter-pod anti-affinity + "
+    "node selectors/tolerations + Open-Local storage"
+)
+
+# the one published multihost metric: BASELINE.json's `published` block
+# carries ONLY the north-star shape (100k nodes x 1M pods — the <60 s
+# target vs_target measures distance to is DEFINED at that shape);
+# publish_multihost refuses anything else
+_NORTH_STAR_METRIC = "multihost_place_1m_pods_100k_nodes"
+_NORTH_STAR_PODS = 1_000_000
+
+# exactly the keys publish_multihost() copies into BASELINE.json's
+# `published` block, in published order — a worker record missing any of
+# them is rejected, extra worker keys (unplaced_reasons, ...) stay out of
+# the published block so its shape is stable
+_PUBLISH_KEYS = (
+    "metric", "value", "unit", "measured_at", "backend", "devices",
+    "engine", "constraints", "affinity", "spread", "trajectory", "metrics",
+)
+
+
+def _count_tag(n: int) -> str:
+    """1_000_000 -> '1m', 100_000 -> '100k', 200 -> '200' — exact counts
+    only, so no shape ever degrades to a colliding '0k' tag."""
+    if n >= 1_000_000 and n % 1_000_000 == 0:
+        return f"{n // 1_000_000}m"
+    if n >= 1_000 and n % 1_000 == 0:
+        return f"{n // 1_000}k"
+    return str(n)
+
+
+def multihost_worker_main() -> int:
+    """`bench.py --multihost-worker`: the in-subprocess half of
+    multihost_point(). Runs the north-star constraint mix through the bulk
+    GSPMD `ShardedRoundsEngine` with the node axis sharded over every
+    visible device (the launcher forces the host-platform device count
+    before this process imports jax) and prints ONE JSON record line —
+    the measured record publish_multihost() accepts.
+
+    Env knobs: SIMTPU_BENCH_MULTIHOST_NODES (default 100000),
+    SIMTPU_BENCH_MULTIHOST_PODS (default 1000000),
+    SIMTPU_BENCH_MULTIHOST_RUNS (default 1 — with a single run only
+    `place_cold_s` exists; warm timings appear only when runs > 1 actually
+    measured one)."""
+    from datetime import datetime, timezone
+
+    import jax
+
+    from simtpu.cache import enable_compilation_cache
+    from simtpu.obs.metrics import REGISTRY
+    from simtpu.parallel import ShardedRoundsEngine
+    from simtpu.parallel.mesh import make_mesh
+
+    cache_dir = enable_compilation_cache()
+    note(f"compilation cache: {cache_dir or 'disabled'}")
+    n_nodes = int(os.environ.get("SIMTPU_BENCH_MULTIHOST_NODES", 100_000))
+    n_pods = int(os.environ.get("SIMTPU_BENCH_MULTIHOST_PODS", 1_000_000))
+    runs = max(int(os.environ.get("SIMTPU_BENCH_MULTIHOST_RUNS", 1)), 1)
+
+    t0 = time.perf_counter()
+    tensors, batch = build_problem(n_nodes, n_pods, with_state=False)
+    expand_tensorize_s = time.perf_counter() - t0
+    mesh = make_mesh(sweep=1)
+    n_devices = len(jax.devices())
+    note(
+        f"multihost point: {n_nodes} nodes x {n_pods} pods over "
+        f"{n_devices} {jax.default_backend()} devices (runs={runs})"
+    )
+
+    nodes = reasons = None
+    walls = []
+    for i in range(runs):
+        # a fresh engine per run: the first run pays jit compilation
+        # (place_cold_s), later runs ride the in-process executable cache
+        # (place_warm_s) — the same cold/warm split time_bulk() reports
+        eng = ShardedRoundsEngine(_FrozenTensorizer(tensors), mesh)
+        t0 = time.perf_counter()
+        nodes, reasons, _ = eng.place(batch)
+        walls.append(time.perf_counter() - t0)
+        note(f"multihost run {i}: {walls[-1]:.1f}s")
+    place_cold_s = walls[0]
+    # the headline `value` is the steady-state (warm) wall when it was
+    # measured, else the single cold run — never a copy of the other
+    value = min(walls[1:]) if runs > 1 else place_cold_s
+    total = len(batch.group)
+    placed = int((np.asarray(nodes) >= 0).sum())
+    hist = reason_histogram(nodes, reasons)
+    if hist:
+        note(f"unplaced={total - placed}; reasons:")
+        for reason, cnt in hist.items():
+            note(f"  {cnt:8d}  {reason}")
+
+    trajectory = {
+        "expand_tensorize_s": round(expand_tensorize_s, 1),
+        "place_cold_s": round(place_cold_s, 2),
+        "end_to_end_s": round(expand_tensorize_s + place_cold_s, 2),
+        "pods_per_s": round(total / value, 1),
+        "placed": placed,
+        "unplaced": total - placed,
+        "runs": runs,
+    }
+    if runs > 1:
+        trajectory["place_warm_s"] = round(value, 2)
+    record = {
+        "metric": (
+            f"multihost_place_{_count_tag(n_pods)}_pods_"
+            f"{_count_tag(n_nodes)}_nodes"
+        ),
+        "value": round(value, 2),
+        "unit": "s",
+        "measured_at": datetime.now(timezone.utc).isoformat(timespec="seconds"),
+        "backend": jax.default_backend(),
+        "devices": n_devices,
+        # honesty marker for the "multihost" name: this point runs ONE
+        # process GSPMD-sharding the node axis over the mesh — the
+        # computation tests/test_multihost.py pins bit-identical when the
+        # same 8-device mesh spans 2 real processes (jax.distributed);
+        # cross-process DCN overhead is NOT in these walls
+        "processes": jax.process_count(),
+        "engine": (
+            f"ShardedRoundsEngine (GSPMD, node axis over "
+            f"{n_devices}-device mesh)"
+        ),
+        "constraints": _NORTH_CONSTRAINTS,
+        "affinity": True,
+        "spread": True,
+        "trajectory": trajectory,
+        "unplaced_reasons": hist,
+        "metrics": REGISTRY.snapshot(),
+    }
+    print(json.dumps(record))
+    return 0
+
+
+def publish_multihost(record: dict, baseline_path: str | None = None) -> dict:
+    """Write a measured multihost record into BASELINE.json's `published`
+    block — the ONLY writer of that block. Derived fields are recomputed
+    here from the measured primitives, never copied through: `vs_target`
+    always follows the one documented formula (round(60.0 / value, 2) —
+    the same <60 s target distance main() publishes for the north-star
+    point), `pods_per_s`/`end_to_end_s` are re-derived, and a runs==1
+    record publishes NO `place_warm_s` (a single measurement is a cold run
+    only). Raises ValueError on a record missing any measured primitive,
+    so a hand-assembled block can't slip through the door."""
+    path = baseline_path or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BASELINE.json"
+    )
+    missing = [k for k in _PUBLISH_KEYS if k not in record]
+    if missing:
+        raise ValueError(f"multihost record missing measured keys: {missing}")
+    if record["metric"] != _NORTH_STAR_METRIC:
+        # only the north-star shape is publishable: the <60 s target that
+        # vs_target measures distance to is defined at 100k x 1M, so a
+        # smoke-shape record must never overwrite the headline block
+        raise ValueError(
+            f"only {_NORTH_STAR_METRIC!r} is publishable, "
+            f"got {record['metric']!r}"
+        )
+    traj = dict(record["trajectory"])
+    for k in ("expand_tensorize_s", "place_cold_s", "placed", "unplaced"):
+        if k not in traj:
+            raise ValueError(f"multihost trajectory missing {k!r}")
+    runs = int(traj.get("runs", 1))
+    value = float(record["value"])
+    total = int(traj["placed"]) + int(traj["unplaced"])
+    if value <= 0:
+        raise ValueError(f"degenerate record: value={value}")
+    if total != _NORTH_STAR_PODS:
+        raise ValueError(
+            f"pod accounting ({total}) does not match the north-star shape"
+        )
+    traj["runs"] = runs
+    traj["end_to_end_s"] = round(
+        float(traj["expand_tensorize_s"]) + float(traj["place_cold_s"]), 2
+    )
+    traj["pods_per_s"] = round(total / value, 1)
+    if runs <= 1:
+        traj.pop("place_warm_s", None)
+    published = {}
+    for key in _PUBLISH_KEYS:
+        published[key] = record[key]
+        if key == "unit":
+            # distance to the <60 s BASELINE.json target, right after the
+            # headline value it qualifies
+            published["vs_target"] = round(60.0 / value, 2)
+    published["value"] = round(value, 2)
+    published["trajectory"] = traj
+    published["source"] = "bench.py multihost_point (publish_multihost)"
+    with open(path) as f:
+        baseline = json.load(f)
+    baseline["published"] = published
+    with open(path, "w") as f:
+        json.dump(baseline, f, indent=2)
+        f.write("\n")
+    note(f"published {published['metric']} = {published['value']}s -> {path}")
+    return published
+
+
+def multihost_point(argv) -> int:
+    """`bench.py --multihost`: launcher half of the multihost bench point.
+    Spawns the measurement in a FRESH subprocess (the forced host-platform
+    device count must be set before jax is imported, so an in-process run
+    can never see the requested mesh), echoes the worker's one-line JSON
+    record, and optionally: saves the raw record (`--record-out FILE` —
+    the MULTIHOST_r*.json provenance artifact) and publishes it into
+    BASELINE.json (`--publish`). SIMTPU_BENCH_MULTIHOST_DEVICES (default
+    8) sizes the forced mesh; SIMTPU_BENCH_MULTIHOST_FORCE_HOST=0 uses the
+    real visible devices instead (TPU/GPU pods). `make bench-multihost`
+    runs the small-shape asserting smoke (SIMTPU_BENCH_MULTIHOST_ASSERT=1:
+    schema + accounting + publish round-trip into a scratch BASELINE)."""
+    import subprocess
+    import tempfile
+
+    devices = int(os.environ.get("SIMTPU_BENCH_MULTIHOST_DEVICES", 8))
+    env = dict(os.environ)
+    forced = False
+    if env.get("SIMTPU_BENCH_MULTIHOST_FORCE_HOST", "1") != "0":
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={devices}"
+            ).strip()
+            forced = True
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--multihost-worker"],
+        env=env,
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    if proc.returncode != 0:
+        note(f"multihost worker failed (exit {proc.returncode})")
+        return proc.returncode or 1
+    line = proc.stdout.strip().splitlines()[-1]
+    record = json.loads(line)
+    print(line)
+    if "--record-out" in argv:
+        out = argv[argv.index("--record-out") + 1]
+        with open(out, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+        note(f"raw record -> {out}")
+    if os.environ.get("SIMTPU_BENCH_MULTIHOST_ASSERT", "0") == "1":
+        traj = record["trajectory"]
+        total = traj["placed"] + traj["unplaced"]
+        assert record["metric"].startswith("multihost_place_"), record["metric"]
+        assert record["unit"] == "s" and record["value"] > 0, record
+        # the devices knob is only a promise when this launcher actually
+        # forced the host platform count (a preset XLA_FLAGS or a real
+        # TPU/GPU mesh decides its own size)
+        if forced:
+            assert record["devices"] == devices, (record["devices"], devices)
+        assert record["devices"] >= 1 and record["processes"] >= 1, record
+        assert total == int(env.get("SIMTPU_BENCH_MULTIHOST_PODS", 1_000_000))
+        assert (
+            abs(
+                traj["end_to_end_s"]
+                - (traj["expand_tensorize_s"] + traj["place_cold_s"])
+            )
+            < 0.2
+        ), traj
+        with tempfile.TemporaryDirectory() as tmp:
+            scratch = os.path.join(tmp, "BASELINE.json")
+            with open(scratch, "w") as f:
+                json.dump({"published": {}}, f)
+            # smoke shapes must be refused by the published-block door...
+            if record["metric"] != _NORTH_STAR_METRIC:
+                try:
+                    publish_multihost(dict(record), scratch)
+                except ValueError:
+                    pass
+                else:
+                    raise AssertionError(
+                        "non-north-star record was publishable"
+                    )
+            # ...and the publish round-trip (exercised on a north-star-
+            # LABELED copy) recomputes the derived fields; a lone cold run
+            # publishes no warm number
+            labeled = dict(record, metric=_NORTH_STAR_METRIC)
+            labeled["trajectory"] = dict(
+                traj,
+                placed=_NORTH_STAR_PODS - traj["unplaced"],
+            )
+            published = publish_multihost(labeled, scratch)
+            assert published["vs_target"] == round(60.0 / record["value"], 2)
+            assert published["source"].startswith("bench.py multihost_point")
+            if published["trajectory"]["runs"] <= 1:
+                assert "place_warm_s" not in published["trajectory"]
+            with open(scratch) as f:
+                assert json.load(f)["published"] == published
+        note("multihost smoke asserts passed")
+    if "--publish" in argv:
+        publish_multihost(record)
+    return 0
+
+
+def publish_multihost_main(argv) -> int:
+    """`bench.py --publish-multihost RECORD.json [--baseline FILE]`:
+    (re)publish a saved measured record (a `--record-out` artifact, e.g.
+    the committed MULTIHOST_r*.json) into BASELINE.json — the derived
+    fields are recomputed by publish_multihost(), so the published block
+    is always reproducible from the committed record + this code path."""
+    rec_path = argv[argv.index("--publish-multihost") + 1]
+    with open(rec_path) as f:
+        record = json.load(f)
+    baseline = None
+    if "--baseline" in argv:
+        baseline = argv[argv.index("--baseline") + 1]
+    publish_multihost(record, baseline)
+    return 0
+
+
 def main() -> int:
     from simtpu.cache import enable_compilation_cache
 
@@ -1431,4 +1772,10 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--multihost-worker" in sys.argv:
+        sys.exit(multihost_worker_main())
+    if "--multihost" in sys.argv:
+        sys.exit(multihost_point(sys.argv[1:]))
+    if "--publish-multihost" in sys.argv:
+        sys.exit(publish_multihost_main(sys.argv[1:]))
     sys.exit(main())
