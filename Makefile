@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all install lint test test-all test-perf bench bench-cold bench-faults bench-layout bench-durable bench-audit bench-obs bench-explain bench-multihost fuzz-smoke clean
+.PHONY: all install lint test test-all test-perf bench bench-cold bench-faults bench-layout bench-durable bench-audit bench-obs bench-explain bench-multihost bench-serve fuzz-smoke clean
 
 all: test
 
@@ -147,6 +147,25 @@ bench-multihost:
 	SIMTPU_BENCH_MULTIHOST_NODES=200 SIMTPU_BENCH_MULTIHOST_PODS=1000 \
 	SIMTPU_BENCH_PODS_PER_DEP=50 \
 	$(PY) bench.py --multihost
+
+# long-lived service smoke (ISSUE 14, mirrors bench-durable): drive
+# tools/serve_loadgen.py --smoke against a real `simtpu serve`
+# subprocess — seeded mixed burst, ASSERTING the robustness matrix:
+# request coalescing counters moved (serve.coalesced > 0, fewer sweep
+# dispatches than requests), an over-deadline request answered a
+# structured 504 while peers completed, the overload tail drew 429s with
+# Retry-After and zero effect on admitted work, kill -9 + restart
+# rehydrated the session bit-identically from the checkpoint, and
+# SIGTERM drained to a clean exit 0 —
+# serve_qps / serve_coalesce_ratio / serve_p99_s land in the JSON line
+bench-serve:
+	SIMTPU_BENCH_SERVE=1 SIMTPU_BENCH_SERVE_ASSERT=1 \
+	SIMTPU_BENCH_NODES=500 SIMTPU_BENCH_PODS=2000 \
+	SIMTPU_BENCH_SCAN_PODS=200 SIMTPU_BENCH_BASELINE_PODS=50 \
+	SIMTPU_BENCH_SMALL=0 SIMTPU_BENCH_HARD=0 SIMTPU_BENCH_MATRIX=0 \
+	SIMTPU_BENCH_PLAN=0 SIMTPU_BENCH_BIG=0 SIMTPU_BENCH_FAULTS=0 \
+	SIMTPU_BENCH_LAYOUT=0 SIMTPU_BENCH_DURABLE=0 SIMTPU_BENCH_AUDIT=0 \
+	SIMTPU_BENCH_OBS=0 SIMTPU_BENCH_EXPLAIN=0 $(PY) bench.py
 
 # differential fuzz over the fixed seed corpus at small shapes, across
 # the FULL engine-config matrix — 8 forced host devices arm the

@@ -67,7 +67,11 @@ SIMTPU_COMPACT A/B, `make bench-layout` = the small-shape asserting smoke),
 SIMTPU_BENCH_DURABLE=1/0 to force/skip the durable-execution smoke
 (checkpoint→kill→resume bit-identity + injected-OOM backoff A/B, `make
 bench-durable` = the asserting smoke; `backoff_events`/`backoff_chunk_min`
-ride every run's JSON line).
+ride every run's JSON line), SIMTPU_BENCH_SERVE=1/0 to force/skip the
+long-lived service smoke (tools/serve_loadgen.py against a real `simtpu
+serve` subprocess; serve_qps/serve_coalesce_ratio/serve_p99_s in the JSON
+line; `make bench-serve` = the asserting robustness-matrix smoke with
+SIMTPU_BENCH_SERVE_ASSERT=1).
 
 Byte telemetry rides every run: `fetch_bytes` (device→host payload of one
 warm placement, next to the `fetches` round-trip count),
@@ -491,6 +495,67 @@ def layout_point() -> dict:
         "layout_compact_s": round(compact_s, 2),
         "layout_dense_s": round(dense_s, 2),
     }
+
+
+def serve_point() -> dict:
+    """Long-lived service smoke (ISSUE 14, docs/serving.md): drive
+    tools/serve_loadgen.py against a real `simtpu serve` subprocess —
+    seeded mixed burst (coalescible sweep queries, one over-deadline, one
+    malformed, overload tail past the admission queue), reading the
+    daemon's own serve.* registry counters.  serve_qps /
+    serve_coalesce_ratio / serve_p99_s land in the JSON line.  With
+    SIMTPU_BENCH_SERVE_ASSERT=1 (`make bench-serve`) the loadgen runs
+    --smoke and this point FAILS unless the whole robustness matrix held:
+    structured 504s, 429s with Retry-After and unharmed admitted work,
+    kill -9 + restart bit-identical session recovery, SIGTERM drain to
+    exit 0, and a coalesce ratio above zero."""
+    import subprocess
+    import sys as _sys
+
+    assert_on = os.environ.get("SIMTPU_BENCH_SERVE_ASSERT", "0") == "1"
+    # cwd-independent, like the multihost point: the loadgen lives next
+    # to this file, and the example config's inner paths resolve against
+    # the repo root, so the subprocess runs THERE whatever cwd bench got
+    repo = os.path.dirname(os.path.abspath(__file__))
+    args = [
+        _sys.executable,
+        os.path.join(repo, "tools", "serve_loadgen.py"),
+        "--json",
+    ]
+    if assert_on:
+        args.append("--smoke")
+    burst = os.environ.get("SIMTPU_BENCH_SERVE_BURST", "")
+    if burst:
+        args += ["--burst", burst]
+    # timeout comfortably inside the CI tier budget: a wedged daemon must
+    # become a recorded serve_error in the JSON line, not a killed job
+    out = subprocess.run(
+        args, capture_output=True, text=True, timeout=600, cwd=repo
+    )
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln.startswith("{")]
+    if not lines:
+        raise RuntimeError(
+            f"serve loadgen produced no JSON (rc={out.returncode}): "
+            f"{out.stderr[-400:]}"
+        )
+    doc = json.loads(lines[-1])
+    rec = {
+        k: doc[k]
+        for k in (
+            "serve_qps", "serve_p50_s", "serve_p99_s",
+            "serve_coalesce_ratio", "serve_requests", "serve_coalesced",
+            "serve_sweeps", "serve_shed", "serve_timeouts",
+        )
+        if k in doc
+    }
+    rec["serve_ok"] = bool(doc.get("ok"))
+    if assert_on:
+        assert out.returncode == 0 and doc.get("ok"), (
+            f"serve smoke failed: {doc.get('checks')}"
+        )
+        assert rec["serve_coalesce_ratio"] > 0, rec
+        assert rec["serve_sweeps"] < rec["serve_requests"], rec
+    return rec
 
 
 def obs_point() -> dict:
@@ -1749,6 +1814,17 @@ def main() -> int:
         except Exception as exc:  # noqa: BLE001 - report, keep the line
             note(f"explain point failed: {type(exc).__name__}: {exc}")
             record["explain_error"] = f"{type(exc).__name__}: {exc}"
+    # long-lived service smoke (ISSUE 14): on by default at north-star
+    # runs, SIMTPU_BENCH_SERVE=1 forces it at any configuration (`make
+    # bench-serve` = the asserting smoke via tools/serve_loadgen.py), =0
+    # skips
+    serve_env = os.environ.get("SIMTPU_BENCH_SERVE", "")
+    if serve_env != "0" and (north_star or serve_env == "1"):
+        try:
+            record.update(serve_point())
+        except Exception as exc:  # noqa: BLE001 - report, keep the line
+            note(f"serve point failed: {type(exc).__name__}: {exc}")
+            record["serve_error"] = f"{type(exc).__name__}: {exc}"
     # OOM-backoff telemetry (durable/backoff.py): process-lifetime
     # counters — nonzero only when a dispatch really hit
     # RESOURCE_EXHAUSTED (or the durable point injected one)
@@ -1767,6 +1843,7 @@ def main() -> int:
         for key in (
             "plan_error", "big_point_error", "fault_error", "layout_error",
             "durable_error", "audit_error", "obs_error", "explain_error",
+            "serve_error",
         )
     ) else 0
 

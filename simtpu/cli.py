@@ -867,6 +867,46 @@ def _cmd_explain(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_serve(args: argparse.Namespace) -> int:
+    return _with_obs(args, lambda: _cmd_serve(args))
+
+
+def _cmd_serve(args: argparse.Namespace) -> int:
+    """The long-lived simulation daemon (simtpu/serve, docs/serving.md).
+
+    The serve package imports ONLY here — `simtpu apply`/every other
+    subcommand runs with the daemon-off cost provably zero (no
+    simtpu.serve import, pinned by tests/test_serve.py)."""
+    from .serve import ServeOptions, serve_main
+
+    opts = ServeOptions(
+        host=args.host,
+        port=args.port,
+        state_dir=args.state_dir or "",
+        max_sessions=args.max_sessions,
+        queue_depth=args.queue_depth,
+        default_deadline_s=args.default_deadline,
+        coalesce_window_s=args.coalesce_window,
+        audit=args.audit,
+        sched_config=args.default_scheduler_config or "",
+        extended_resources=args.extended_resources or [],
+        drain_timeout_s=args.drain_timeout,
+    )
+
+    def progress(msg: str) -> None:
+        print(msg, flush=True)
+
+    try:
+        return serve_main(opts, progress=progress)
+    except OSError as exc:
+        # startup failures (port taken, bad host, unwritable state dir)
+        # are config errors, not tracebacks — the same one-line contract
+        # as apply's fail_early; the message stays phase-neutral because
+        # the bind and the state-dir setup both land here
+        print(f"simtpu serve: startup failed: {exc}", file=sys.stderr)
+        return 1
+
+
 def cmd_version(args: argparse.Namespace) -> int:
     if getattr(args, "json", False):
         # downstream consumers of the --json metrics block detect layout
@@ -1349,6 +1389,80 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _add_obs_flags(fuzz_p)
     fuzz_p.set_defaults(func=cmd_fuzz)
+
+    serve_p = sub.add_parser(
+        "serve",
+        help="run the persistent simulation daemon: warm snapshot "
+        "sessions, coalesced what-if queries, HTTP/JSON API",
+        description="Long-lived simulation service (simtpu/serve, "
+        "docs/serving.md): hold cluster snapshots warm in checkpointed "
+        "sessions and answer concurrent what-if queries — fit / drain / "
+        "capacity / resilience — over HTTP/JSON.  Queued sweep-shaped "
+        "queries against one snapshot coalesce into a single vmapped "
+        "dispatch.  Robustness contract: per-request cooperative "
+        "deadlines (structured 504), bounded-queue load shedding (429 + "
+        "Retry-After), OOM chunk-halving backoff with session eviction "
+        "under pressure (503 + Retry-After), crash-safe session "
+        "recovery from --state-dir after kill -9, SIGTERM graceful "
+        "drain (exit 0), /healthz /readyz /metrics endpoints, span "
+        "tracing with flight-recorder bundles on request failure, and "
+        "the independent auditor certifying every served answer.",
+    )
+    serve_p.add_argument(
+        "--host", default="127.0.0.1",
+        help="bind address (default 127.0.0.1 — front a reverse proxy "
+        "for anything wider)",
+    )
+    serve_p.add_argument(
+        "--port", type=int, default=8090,
+        help="bind port (default 8090; 0 = ephemeral, printed at start)",
+    )
+    serve_p.add_argument(
+        "--state-dir", metavar="DIR", default="",
+        help="session checkpoint directory (durable/checkpoint.py): "
+        "sessions created here survive kill -9 and rehydrate "
+        "bit-identically on restart (default: memory-only sessions)",
+    )
+    serve_p.add_argument(
+        "--max-sessions", type=int, default=8, metavar="N",
+        help="in-memory session cap; past it the least-recently-used "
+        "session is evicted (rehydratable from --state-dir; default 8)",
+    )
+    serve_p.add_argument(
+        "--queue-depth", type=int, default=64, metavar="N",
+        help="admission-control queue bound; a full queue sheds new "
+        "queries with 429 + Retry-After (default 64)",
+    )
+    serve_p.add_argument(
+        "--default-deadline", type=float, default=30.0, metavar="SECONDS",
+        help="per-request deadline when the body carries no deadline_s "
+        "(default 30; expiry answers a structured 504 partial and the "
+        "daemon is unharmed)",
+    )
+    serve_p.add_argument(
+        "--coalesce-window", type=float, default=0.0, metavar="SECONDS",
+        help="extra wait for more coalescible queries after the first "
+        "(default 0 = fuse only what is already queued; bursts queued "
+        "behind an executing batch coalesce either way)",
+    )
+    serve_p.add_argument(
+        "--drain-timeout", type=float, default=30.0, metavar="SECONDS",
+        help="SIGTERM drain budget: how long to wait for the queue and "
+        "in-flight requests before abandoning them (default 30)",
+    )
+    serve_p.add_argument(
+        "-d", "--default-scheduler-config",
+        help="path of scheduler-config overrides applied to every "
+        "session",
+    )
+    serve_p.add_argument(
+        "-e", "--extended-resources", nargs="*",
+        choices=["open-local", "gpu"],
+        help="extended resources to model in every session",
+    )
+    _add_audit_flags(serve_p)
+    _add_obs_flags(serve_p)
+    serve_p.set_defaults(func=cmd_serve)
 
     ver_p = sub.add_parser("version", help="print version")
     ver_p.add_argument(

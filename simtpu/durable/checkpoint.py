@@ -6,7 +6,10 @@ fingerprint, record index) plus one `.npz` per completed search candidate
 (its placement vectors and verdict scalars).  Every write is atomic
 (tmp + os.replace), and the manifest is rewritten after each record — a
 kill at ANY point leaves a loadable checkpoint describing exactly the
-candidates that completed.
+candidates that completed.  Transient filesystem errors (EINTR, rename
+races between concurrent writers) get one jittered retry before
+surfacing as `CheckpointError`; ENOSPC fails immediately and loudly
+(`_retry_transient`).
 
 Resume contract: the planners re-run their deterministic search, and
 every candidate with a record returns its persisted outcome instead of
@@ -26,9 +29,13 @@ silently replaying records from a different problem.
 
 from __future__ import annotations
 
+import errno
 import hashlib
 import json
 import os
+import random
+import threading
+import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -39,6 +46,11 @@ from ..obs.trace import span
 CHECKPOINT_VERSION = 1
 
 _MANIFEST = "manifest.json"
+
+#: jitter window (seconds) before the single transient-error retry — the
+#: write path is contended by design (a daemon's session store checkpoints
+#: from worker threads), so an immediate retry would replay the same race
+_RETRY_JITTER_S = (0.005, 0.05)
 
 
 class CheckpointError(ValueError):
@@ -54,6 +66,85 @@ class CheckpointMismatch(ValueError):
     """The checkpoint on disk does not match this plan (format version,
     planner kind, or config/cluster fingerprint) — resuming would replay
     records from a different problem, so we refuse loudly."""
+
+
+def _is_transient(exc: BaseException, racy: bool) -> bool:
+    """Filesystem errors worth ONE retry.
+
+    EINTR: defense-in-depth.  CPython's PEP 475 auto-retries
+    syscalls when a signal handler returns normally (so the flag-setting
+    handlers of durable/deadline.py never surface it), but handlers that
+    RAISE, non-CPython-controlled callers, and exotic filesystems can
+    still deliver it — and swallowing one spurious EINTR costs a jittered
+    sleep, while surfacing it costs an operator a failed plan.
+
+    ENOENT, on the WRITE path only (`racy`): the shape of an
+    atomic-write race against concurrent directory surgery — a session
+    DELETE (serve/session.py rmtree) or checkpoint-dir cleanup sweeping
+    the tmp file between write and rename.  Re-running the whole write
+    transaction is exact (the payload is deterministic); if the
+    directory itself is gone the retry fails too and surfaces as one
+    CheckpointError line.  (Writers never share tmp NAMES — `_tmp_path`
+    is writer-unique — so this is about the directory, not the file.)
+    ENOENT on the read path stays a real missing-record error.
+
+    ENOSPC is deliberately not transient: retrying a full disk only
+    delays the loud failure the operator needs to see."""
+    if not isinstance(exc, OSError):
+        return False
+    return exc.errno == errno.EINTR or (racy and exc.errno == errno.ENOENT)
+
+
+def _tmp_path(path: str) -> str:
+    """A writer-unique tmp name for the atomic write: concurrent writers
+    of the same record (a daemon's worker threads, two processes sharing
+    a checkpoint dir) must never share one tmp file, or one writer's
+    os.replace could publish the other's half-written bytes — breaking
+    the 'a kill at ANY point leaves a loadable checkpoint' guarantee.
+    Stale tmps from killed writers are harmless: the manifest is the
+    index, and resume never reads unindexed files."""
+    return f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+
+
+def _retry_transient(what: str, fn, racy: bool = True):
+    """Run one filesystem transaction with a single jittered retry on
+    transient errors (ISSUE 14 satellite, pinned by
+    tests/test_durable.py):
+
+    - ENOSPC surfaces IMMEDIATELY as a loud `CheckpointError` — no retry;
+    - EINTR / rename-race ENOENT gets exactly one retry after a small
+      random sleep; a second failure surfaces as `CheckpointError` (one
+      actionable line, never a raw OSError mid-plan);
+    - every other error propagates untouched for the caller's own
+      handling."""
+
+    def _enospc(exc: OSError) -> CheckpointError:
+        return CheckpointError(
+            f"checkpoint: no space left on device while {what}; free "
+            "disk space and re-run (the checkpoint directory may hold a "
+            "partial .tmp file, which is ignored on resume)"
+        )
+
+    try:
+        return fn()
+    except OSError as exc:
+        if exc.errno == errno.ENOSPC:
+            raise _enospc(exc) from exc
+        if not _is_transient(exc, racy):
+            raise
+        time.sleep(random.uniform(*_RETRY_JITTER_S))
+        try:
+            return fn()
+        except OSError as exc2:
+            if exc2.errno == errno.ENOSPC:
+                raise _enospc(exc2) from exc2
+            if _is_transient(exc2, racy):
+                raise CheckpointError(
+                    f"checkpoint: {what} failed twice on a transient "
+                    f"filesystem error ({exc2}); check the checkpoint "
+                    "directory's filesystem and re-run"
+                ) from exc2
+            raise
 
 
 def file_digest(path: Optional[str]) -> str:
@@ -173,6 +264,7 @@ class PlanCheckpoint:
                 f"--checkpoint: {directory!r} is not writable; "
                 "pass a writable directory"
             )
+        self._sweep_stale_tmps()
         mpath = os.path.join(directory, _MANIFEST)
         if resume:
             if not os.path.isfile(mpath):
@@ -212,6 +304,31 @@ class PlanCheckpoint:
             # unrelated plan are harmless — the manifest is the index)
             self._write_manifest()
 
+    #: tmp files older than this are orphans from a killed writer and
+    #: are swept at checkpoint open; younger ones may belong to a LIVE
+    #: concurrent writer (the rename-race scenario) and are left alone
+    STALE_TMP_S = 300.0
+
+    def _sweep_stale_tmps(self) -> None:
+        """Best-effort cleanup of orphaned `*.tmp` files: writer-unique
+        tmp names (`_tmp_path`) mean a kill mid-write leaves a file no
+        later writer ever reuses, so without this sweep a crash-looping
+        process would grow the directory monotonically."""
+        cutoff = time.time() - self.STALE_TMP_S
+        try:
+            entries = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in entries:
+            if not name.endswith(".tmp"):
+                continue
+            path = os.path.join(self.directory, name)
+            try:
+                if os.path.getmtime(path) < cutoff:
+                    os.unlink(path)
+            except OSError:
+                continue  # raced away or unreadable — someone else's
+
     # -- record IO --------------------------------------------------------
 
     @staticmethod
@@ -225,10 +342,19 @@ class PlanCheckpoint:
         if fname is None:
             return None
         path = os.path.join(self.directory, fname)
+        def read():
+            with np.load(path, allow_pickle=False) as z:
+                return {k: z[k] for k in z.files}
+
         try:
             with span("checkpoint.get", phase=phase, cand=int(cand)):
-                with np.load(path, allow_pickle=False) as z:
-                    return {k: z[k] for k in z.files}
+                # EINTR-only retry on the read path (racy=False): a
+                # missing record file is a real error, not a race
+                return _retry_transient(
+                    f"reading record {fname!r}", read, racy=False
+                )
+        except CheckpointError:
+            raise
         except (OSError, ValueError, KeyError, EOFError) as exc:
             # a truncated/empty/garbage record (a kill mid-rename window,
             # disk-full, manual edits) must read as ONE actionable line,
@@ -245,14 +371,22 @@ class PlanCheckpoint:
         key = self._key(phase, cand)
         fname = f"rec_{phase}_{int(cand)}.npz"
         path = os.path.join(self.directory, fname)
-        tmp = path + ".tmp"
+        tmp = _tmp_path(path)
         with span("checkpoint.put", phase=phase, cand=int(cand)) as sp:
-            with open(tmp, "wb") as f:
-                np.savez_compressed(
-                    f, **{k: np.asarray(v) for k, v in entries.items()}
-                )
-            sp.set(bytes=os.path.getsize(tmp))
-            os.replace(tmp, path)
+
+            def write():
+                # the whole transaction re-runs on a transient retry —
+                # rewriting the tmp file is what makes an ENOENT rename
+                # race (the tmp was renamed/swept by the racing writer)
+                # recoverable
+                with open(tmp, "wb") as f:
+                    np.savez_compressed(
+                        f, **{k: np.asarray(v) for k, v in entries.items()}
+                    )
+                sp.set(bytes=os.path.getsize(tmp))
+                os.replace(tmp, path)
+
+            _retry_transient(f"writing record {fname!r}", write)
             self._records[key] = fname
             self._write_manifest()
 
@@ -261,16 +395,20 @@ class PlanCheckpoint:
 
     def _write_manifest(self) -> None:
         mpath = os.path.join(self.directory, _MANIFEST)
-        tmp = mpath + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(
-                {
-                    "version": CHECKPOINT_VERSION,
-                    "kind": self.kind,
-                    "fingerprint": self.fingerprint,
-                    "records": self._records,
-                },
-                f,
-                indent=1,
-            )
-        os.replace(tmp, mpath)
+        tmp = _tmp_path(mpath)
+
+        def write():
+            with open(tmp, "w") as f:
+                json.dump(
+                    {
+                        "version": CHECKPOINT_VERSION,
+                        "kind": self.kind,
+                        "fingerprint": self.fingerprint,
+                        "records": self._records,
+                    },
+                    f,
+                    indent=1,
+                )
+            os.replace(tmp, mpath)
+
+        _retry_transient("writing the manifest", write)

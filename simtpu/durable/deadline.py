@@ -2,9 +2,11 @@
 
 `RunControl` is the cooperative cancellation token the planners poll
 between search candidates (`control.check()`): a wall-clock deadline or a
-delivered SIGINT turns the NEXT check into a `PlanInterrupted`, which the
-planners catch to flush a final checkpoint and return a structured
-partial result (`PlanResult.partial`) instead of dying with a traceback.
+delivered SIGINT/SIGTERM turns the NEXT check into a `PlanInterrupted`,
+which the planners catch to flush a final checkpoint and return a
+structured partial result (`PlanResult.partial`) instead of dying with a
+traceback.  SIGTERM gets the same first-signal grace as ^C because that
+is what daemons, `timeout(1)`, and CI runners actually send.
 
 Polling granularity is the candidate boundary by design: a candidate's
 placement is one pipelined device workload (interrupting it mid-flight
@@ -83,26 +85,37 @@ class RunControl:
                 f"deadline of {self.deadline:g}s exceeded"
             )
 
+    #: the signals sigint() makes cooperative.  SIGTERM rides along
+    #: because daemons and CI runners send it where a human sends ^C —
+    #: without the handler it kills the process with no partial result,
+    #: no flushed checkpoint, and no flight bundle (docs/robustness.md).
+    SIGNALS = (signal.SIGINT, signal.SIGTERM)
+
     @contextlib.contextmanager
     def sigint(self):
-        """Install a SIGINT handler that flags this control (first ^C =
-        graceful partial result; second ^C = the default KeyboardInterrupt
-        so a stuck run can still be killed).  Restores the previous
-        handler on exit.  No-op outside the main thread (signal.signal
-        refuses there — library callers on worker threads just don't get
-        the handler)."""
+        """Install SIGINT *and* SIGTERM handlers that flag this control
+        (first delivery of either = graceful partial result; a second
+        delivery = KeyboardInterrupt so a stuck run can still be killed).
+        Restores the previous handlers on exit.  No-op outside the main
+        thread (signal.signal refuses there — library callers on worker
+        threads just don't get the handlers)."""
 
         def handler(signum, frame):
             if self._interrupt is not None:
                 raise KeyboardInterrupt
-            self.trigger("SIGINT")
+            self.trigger(signal.Signals(signum).name)
 
+        prev = {}
         try:
-            prev = signal.signal(signal.SIGINT, handler)
-        except ValueError:  # not the main thread
+            for sig in self.SIGNALS:
+                prev[sig] = signal.signal(sig, handler)
+        except ValueError:
+            # not the main thread: signal.signal refuses EVERY call
+            # there, so the first one failed and nothing was installed
             yield self
             return
         try:
             yield self
         finally:
-            signal.signal(signal.SIGINT, prev)
+            for sig, old in prev.items():
+                signal.signal(sig, old)

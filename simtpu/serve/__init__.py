@@ -1,0 +1,60 @@
+"""`simtpu serve`: a hardened long-lived simulation service (ISSUE 14).
+
+The one-shot CLI pays ingest, tensorization, and (on CPU) compilation on
+every run; this package turns the simulator into a persistent daemon for
+Tesserae-style interactive what-if traffic — "does this app fit",
+"capacity after this drain", "resilience at k=2" — against warm cluster
+snapshots, with request batching: queued sweep-shaped queries against
+the same snapshot coalesce into ONE vmapped dispatch (the scenario-axis
+trick of faults/sweep.py, applied to the request axis).
+
+The daemon is first and foremost a robustness artifact; the layer map:
+
+- `errors`   — the failure taxonomy and its HTTP mapping (the served
+               twin of docs/robustness.md's exit-code table);
+- `session`  — warm snapshot sessions, checkpointed through
+               durable/checkpoint.py, rehydrated bit-identically after
+               kill -9, evictable under pressure;
+- `batching` — bounded-queue admission (429), request coalescing,
+               cooperative deadlines, OOM graceful degradation;
+- `server`   — the stdlib ThreadingHTTPServer front-end, SIGTERM drain,
+               /healthz /readyz /metrics, spans + flight bundles.
+
+IMPORT CONTRACT: nothing outside `simtpu serve` imports this package —
+the daemon-off cost of serving is provably zero (no import, no behavior
+change on any CLI path; pinned by tests/test_serve.py, the same pattern
+as the explain off-path pin).
+"""
+
+from .errors import (
+    AuditRejected,
+    BadRequest,
+    DeadlineExceeded,
+    Degraded,
+    HTTP_TAXONOMY,
+    InternalError,
+    NotFound,
+    Overloaded,
+    ServeError,
+    error_doc,
+)
+from .server import ServeOptions, SimtpuServer, serve_main
+from .session import Session, SessionStore
+
+__all__ = [
+    "AuditRejected",
+    "BadRequest",
+    "DeadlineExceeded",
+    "Degraded",
+    "HTTP_TAXONOMY",
+    "InternalError",
+    "NotFound",
+    "Overloaded",
+    "ServeError",
+    "ServeOptions",
+    "Session",
+    "SessionStore",
+    "SimtpuServer",
+    "error_doc",
+    "serve_main",
+]

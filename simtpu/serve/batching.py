@@ -1,0 +1,698 @@
+"""Admission control + request coalescing for the simtpu daemon.
+
+Two robustness mechanisms live here:
+
+1. ADMISSION: the query queue is bounded.  A full queue sheds the new
+   request with a 429 (`errors.Overloaded`) — queued and in-flight work
+   is never touched, so overload degrades arrival rate, not correctness.
+
+2. COALESCING: queued sweep-shaped queries (drain what-ifs, resilience
+   assessments) against the SAME session collapse into one vmapped
+   dispatch.  A drain query is one scenario row; a resilience query is a
+   generated scenario set — both are `[S, N]` masks, so a burst of K
+   queries becomes `stack_scenarios` + ONE `sweep_scenarios` call
+   instead of K engine round-trips (the scenario-axis trick
+   `faults/sweep.py` already proves out, re-used on the request axis).
+   Answers are sliced back out per query and are bit-identical to the
+   serial one-query-at-a-time path because scenario rows are independent
+   (the sweep-vs-serial-oracle pin, tests/test_faults.py).
+
+Fit and capacity queries never coalesce (their pod sets differ); they
+amortize through the session's warm ingest and the process-global
+compile caches instead.
+
+Deadlines are cooperative (`durable/deadline.py`): each query carries a
+`RunControl` whose clock starts at submission, so queue wait counts
+against the budget.  The worker drops queries already past deadline
+before dispatching, `plan_capacity` polls the control at candidate
+boundaries (a capacity query's 504 carries the structured partial), and
+a sweep that outlives its callers simply completes into the void — the
+daemon is unharmed either way.
+
+Memory pressure: every dispatch already rides the OOM chunk-halving
+backoff (durable/backoff.py, inside the scan/rounds/sweep dispatchers).
+When even that exhausts, the batcher evicts idle sessions (they
+rehydrate from checkpoint) and answers 503 `Degraded` with Retry-After —
+shed state, keep the process.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..durable.backoff import is_resource_exhausted
+from ..durable.checkpoint import name_seed
+from ..durable.deadline import PlanInterrupted, RunControl
+from ..obs.metrics import REGISTRY
+from ..obs.trace import span
+from .errors import (
+    BadRequest,
+    DeadlineExceeded,
+    Degraded,
+    InternalError,
+    Overloaded,
+    ServeError,
+)
+from .session import EXPAND_LOCK as _EXPAND_LOCK, Session, SessionStore
+
+log = logging.getLogger("simtpu.serve")
+
+#: query kinds that compile to scenario rows and may share one dispatch
+SWEEP_KINDS = ("drain", "resilience")
+
+#: all query kinds the batcher executes
+QUERY_KINDS = ("fit", "drain", "capacity", "resilience")
+
+#: hard cap on queries fused into one sweep dispatch (the scenario-chunk
+#: machinery below it re-chunks for memory anyway; this caps latency skew)
+MAX_BATCH = 64
+
+#: Retry-After (seconds) stamped on load-shed and degraded responses
+RETRY_AFTER_S = 2.0
+
+#: server-side ceiling on a resilience query's per-term sample budget:
+#: `samples` also gates the exhaustive C(n,k) branch of
+#: faults/scenarios.k_node_scenarios, so an uncapped (or <= 0 =
+#: "exhaustive") client value could enumerate terabytes of combinations
+#: host-side — outside the XLA RESOURCE_EXHAUSTED path the OOM backoff
+#: protects
+MAX_SWEEP_SAMPLES = 4096
+
+_REQUESTS = REGISTRY.counter("serve.requests")
+_BATCHES = REGISTRY.counter("serve.batches")
+_COALESCED = REGISTRY.counter("serve.coalesced")
+_SHED = REGISTRY.counter("serve.shed")
+_OOM_DEGRADED = REGISTRY.counter("serve.oom_degraded")
+#: engine sweep dispatches the daemon issued — the coalescing pin reads
+#: serve.sweeps against serve.requests: K fused queries bump requests K
+#: times and sweeps once (tests/test_serve.py, `make bench-serve`)
+_SWEEPS = REGISTRY.counter("serve.sweeps")
+_QUEUE_DEPTH = REGISTRY.gauge("serve.queue_depth")
+_REQUEST_S = REGISTRY.histogram("serve.request_s")
+
+# pod-name-stream serialization lives in session.EXPAND_LOCK (imported
+# above as _EXPAND_LOCK): session creation/rehydration and the
+# fit/capacity expansions below must never interleave RNG draws
+
+
+@dataclass
+class Query:
+    """One admitted request, handed from the HTTP thread to the worker
+    and completed through `done`/`result`/`error`."""
+
+    kind: str
+    session: Session
+    payload: Dict[str, object]
+    control: RunControl
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Optional[Dict[str, object]] = None
+    error: Optional[Exception] = None
+    coalesced: bool = False
+    t_submit: float = field(default_factory=time.perf_counter)
+
+    @property
+    def fingerprint(self) -> str:
+        """Deterministic per-request fingerprint: seeds the pod-name
+        stream for fit/capacity expansion, so the served answer is
+        reproducible (and test-pinnable) as a one-shot run with the same
+        seed."""
+        h = hashlib.sha256()
+        h.update(self.session.fingerprint.encode())
+        h.update(self.kind.encode())
+        h.update(json.dumps(self.payload, sort_keys=True, default=str).encode())
+        return h.hexdigest()
+
+    def finish(self, result=None, error=None) -> None:
+        if error is not None:
+            self.error = error
+        else:
+            self.result = result
+        _REQUEST_S.observe(time.perf_counter() - self.t_submit)
+        self.done.set()
+
+
+def int_field(payload: Dict[str, object], key: str, default: int) -> int:
+    """Integer body field, or the taxonomy's 400 — client garbage must
+    never escape as a 500 bug report (with a flight bundle behind it)."""
+    value = payload.get(key, default)
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        raise BadRequest(
+            f"{key!r} must be an integer, got {value!r}"
+        ) from None
+
+
+def app_from_payload(payload: Dict[str, object], name: str = "query"):
+    """An `AppResource` from a request body: inline `workloads` (a list
+    of manifest dicts — JSON is already the object form) or an `app`
+    path on the daemon's filesystem (the CLI-config workflow)."""
+    from ..core.objects import AppResource, ResourceTypes
+
+    workloads = payload.get("workloads")
+    app_path = payload.get("app")
+    if bool(workloads) == bool(app_path):
+        raise BadRequest(
+            "body must carry exactly one of 'workloads' (inline manifest "
+            "list) or 'app' (a path readable by the daemon)"
+        )
+    if workloads:
+        if not isinstance(workloads, list) or not all(
+            isinstance(w, dict) for w in workloads
+        ):
+            raise BadRequest("'workloads' must be a list of manifest objects")
+        resources = ResourceTypes()
+        for obj in workloads:
+            resources.add(obj)
+        return AppResource(name=str(payload.get("name", name)), resource=resources)
+    from ..io.yaml_loader import load_resources
+
+    try:
+        return AppResource(
+            name=str(payload.get("name", name)),
+            resource=load_resources(str(app_path)),
+        )
+    except (OSError, ValueError) as exc:
+        raise BadRequest(f"cannot load app from {app_path!r}: {exc}") from exc
+
+
+class Batcher:
+    """Bounded queue + one dispatch worker.
+
+    One worker by design: engine dispatch is serial on the backend
+    anyway, a second dispatch thread would only interleave the pod-name
+    stream and contend for the device — concurrency lives in the
+    HTTP threads (ThreadingHTTPServer) and inside each vmapped dispatch."""
+
+    def __init__(
+        self,
+        store: SessionStore,
+        queue_depth: int = 64,
+        coalesce_window_s: float = 0.0,
+    ):
+        self.store = store
+        self.queue_depth = max(int(queue_depth), 1)
+        self.coalesce_window_s = max(float(coalesce_window_s), 0.0)
+        self._dq: deque = deque()
+        self._cv = threading.Condition()
+        self._stopping = False
+        self._thread: Optional[threading.Thread] = None
+        self._idle = threading.Event()
+        self._idle.set()
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, query: Query) -> None:
+        """Admit or shed.  Shedding raises `Overloaded` (HTTP 429) and
+        touches nothing already admitted."""
+        with self._cv:
+            if self._stopping:
+                raise Degraded(
+                    "daemon is draining; retry against the next instance",
+                    retry_after=RETRY_AFTER_S,
+                )
+            if len(self._dq) >= self.queue_depth:
+                _SHED.inc()
+                raise Overloaded(
+                    f"query queue is full ({self.queue_depth} deep); "
+                    "retry after the backlog drains",
+                    retry_after=RETRY_AFTER_S,
+                )
+            _REQUESTS.inc()
+            self._dq.append(query)
+            _QUEUE_DEPTH.set(len(self._dq))
+            self._idle.clear()
+            self._cv.notify()
+
+    # -- worker ------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="simtpu-serve-worker", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> bool:
+        """Stop the worker.  With `drain`, admitted queries complete
+        first (the SIGTERM contract: in-flight work always finishes);
+        without, the backlog is failed fast with `Degraded`."""
+        with self._cv:
+            self._stopping = True
+            if not drain:
+                while self._dq:
+                    q = self._dq.popleft()
+                    q.finish(error=Degraded(
+                        "daemon shut down before this query ran",
+                        retry_after=RETRY_AFTER_S,
+                    ))
+                _QUEUE_DEPTH.set(0)
+                self._idle.set()
+            self._cv.notify_all()
+        drained = self._idle.wait(timeout)
+        # snapshot the thread: stop() may race a concurrent stop() (the
+        # second-SIGTERM force path vs the graceful-drain thread), and
+        # joining an already-joined thread is harmless while reading a
+        # torn None is not
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout)
+            self._thread = None
+        return drained
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            try:
+                self._execute(batch)
+            except BaseException:  # noqa: BLE001 — the worker must survive
+                log.exception("serve: batch execution escaped; replying 500")
+                for q in batch:
+                    if not q.done.is_set():
+                        q.finish(error=InternalError(
+                            "internal error; see the daemon log"
+                        ))
+
+    def _take_batch(self) -> Optional[List[Query]]:
+        """Pop the next query plus everything queued that can share its
+        dispatch.  Returns None when stopping with an empty queue."""
+        with self._cv:
+            while not self._dq:
+                self._idle.set()
+                if self._stopping:
+                    return None
+                self._cv.wait(timeout=0.5)
+            first = self._dq.popleft()
+            batch = [first]
+            if first.kind in SWEEP_KINDS:
+                self._coalesce_locked(first, batch)
+            _QUEUE_DEPTH.set(len(self._dq))
+        if (
+            first.kind in SWEEP_KINDS
+            and self.coalesce_window_s > 0
+            and len(batch) < MAX_BATCH
+            and not self._stopping
+        ):
+            # optional micro-window for bursty clients whose requests
+            # arrive a hair apart; default 0 = coalesce only what is
+            # already queued (no added latency for lone queries)
+            t_end = time.monotonic() + self.coalesce_window_s
+            while len(batch) < MAX_BATCH and time.monotonic() < t_end:
+                time.sleep(min(0.002, self.coalesce_window_s))
+                with self._cv:
+                    self._coalesce_locked(first, batch)
+                    _QUEUE_DEPTH.set(len(self._dq))
+        return batch
+
+    def _coalesce_locked(self, first: Query, batch: List[Query]) -> None:
+        keep: deque = deque()
+        while self._dq and len(batch) < MAX_BATCH:
+            q = self._dq.popleft()
+            if q.kind in SWEEP_KINDS and q.session is first.session:
+                q.coalesced = True
+                batch.append(q)
+            else:
+                keep.append(q)
+        keep.extend(self._dq)
+        self._dq.clear()
+        self._dq.extend(keep)
+
+    # -- execution ---------------------------------------------------------
+
+    def _execute(self, batch: List[Query]) -> None:
+        live = []
+        for q in batch:
+            try:
+                q.control.check()
+            except PlanInterrupted as exc:
+                # expired while queued: answer the structured timeout
+                # without burning a dispatch on it
+                q.finish(error=DeadlineExceeded(
+                    f"deadline expired before dispatch ({exc.reason})",
+                    extra={"partial": None},
+                ))
+            else:
+                live.append(q)
+        if not live:
+            return
+        # counted on LIVE queries only: expired/malformed riders never
+        # touch a dispatch, and the coalesce metrics are a CI pin —
+        # rider-only "coalescing" must not satisfy it
+        _BATCHES.inc()
+        session = live[0].session
+        try:
+            with session.lock:
+                session.touch(len(live))
+                if live[0].kind in SWEEP_KINDS:
+                    self._run_sweep_batch(session, live)
+                else:
+                    for q in live:
+                        self._run_single(q)
+        except Exception as exc:  # noqa: BLE001 — taxonomy-mapped below
+            err = self._map_error(exc, session)
+            for q in live:
+                if not q.done.is_set():
+                    q.finish(error=err)
+
+    def _map_error(self, exc: Exception, session: Session) -> Exception:
+        if isinstance(exc, ServeError):
+            return exc
+        if is_resource_exhausted(exc):
+            # the chunk-halving backoff inside the dispatchers already
+            # retried down to single-row chunks and still could not fit:
+            # shed warm state (sessions rehydrate from checkpoint) and
+            # tell clients to back off — the process survives
+            _OOM_DEGRADED.inc()
+            evicted = self.store.evict_idle(keep=(session.sid,))
+            return Degraded(
+                "memory pressure: a served dispatch exhausted its OOM "
+                f"backoff; evicted {evicted} idle session(s), retry "
+                "shortly",
+                retry_after=RETRY_AFTER_S,
+            )
+        # deliberately NO blanket ValueError/KeyError -> 400 mapping: an
+        # error escaping a dispatch that no validation layer claimed is
+        # OUR bug, and blaming the client would also skip the 500 path's
+        # flight bundle.  Client-input errors are wrapped as BadRequest
+        # at their sources (_scenarios_for, app_from_payload, the
+        # SpecError catch in _run_fit).
+        log.exception("serve: unexpected error executing query")
+        return InternalError(f"{type(exc).__name__}: {exc}")
+
+    # -- sweep-shaped queries (coalescible) --------------------------------
+
+    def _scenarios_for(self, q: Query):
+        from ..faults import generate_scenarios
+        from ..faults.scenarios import ScenarioSet
+
+        session = q.session
+        n = len(session.cluster.nodes)
+        if q.kind == "drain":
+            names = q.payload.get("nodes")
+            if not isinstance(names, list) or not names:
+                raise BadRequest(
+                    "drain body must carry {'nodes': ['<name-or-index>', ...]}"
+                )
+            mask = np.zeros(n, bool)
+            for name in names:
+                if isinstance(name, bool):
+                    raise BadRequest(f"bad node reference {name!r}")
+                if isinstance(name, int):
+                    # index form, for clients that only know the node
+                    # count (tools/serve_loadgen.py)
+                    if not 0 <= name < n:
+                        raise BadRequest(
+                            f"node index {name} out of range [0, {n})"
+                        )
+                    mask[name] = True
+                    continue
+                idx = session.node_index.get(str(name))
+                if idx is None:
+                    raise BadRequest(f"unknown node {name!r} in this snapshot")
+                mask[idx] = True
+            return ScenarioSet(
+                masks=mask[None, :],
+                labels=(f"drain:{','.join(str(x) for x in names)}",),
+                kind="mixed",
+                k=int(mask.sum()),
+            )
+        spec = str(q.payload.get("spec", "k=1"))
+        samples = int_field(q.payload, "samples", 256)
+        if not 1 <= samples <= MAX_SWEEP_SAMPLES:
+            raise BadRequest(
+                f"samples must be in [1, {MAX_SWEEP_SAMPLES}] (got "
+                f"{samples}; <= 0 would force exhaustive C(n,k) "
+                "enumeration host-side)"
+            )
+        seed = int_field(q.payload, "seed", 0)
+        try:
+            return generate_scenarios(
+                session.cluster.nodes, spec, samples=samples, seed=seed
+            )
+        except ValueError as exc:
+            raise BadRequest(f"bad fault spec {spec!r}: {exc}") from exc
+
+    def _run_sweep_batch(self, session: Session, batch: List[Query]) -> None:
+        """K queued sweep queries → ONE vmapped dispatch: build each
+        query's scenario rows, stack, sweep once, slice the answers back
+        out.  Rows are independent, so slices are bit-identical to the
+        one-query-at-a-time answers (the sweep-vs-serial-oracle pin)."""
+        from ..faults import sweep_scenarios
+        from ..faults.scenarios import stack_scenarios
+
+        sets, ranges, valid = [], [], []
+        s0 = 0
+        for q in batch:
+            try:
+                scen = self._scenarios_for(q)
+            except ServeError as exc:
+                # one malformed query must not poison its batch
+                q.finish(error=exc)
+                continue
+            sets.append(scen)
+            ranges.append((s0, s0 + len(scen)))
+            valid.append(q)
+            s0 += len(scen)
+        if not valid:
+            return
+        if len(valid) > 1:
+            _COALESCED.inc(len(valid) - 1)
+        with span(
+            "serve.sweep", queries=len(valid), scenarios=int(s0),
+            sid=session.sid,
+        ):
+            _SWEEPS.inc()
+            sweep = sweep_scenarios(session.pc, stack_scenarios(sets))
+        batch_doc = {
+            "batched_queries": len(valid),
+            "batch_scenarios": int(s0),
+        }
+        for q, (a, b) in zip(valid, ranges):
+            if q.kind == "drain":
+                q.finish(result=self._drain_doc(session, sweep, a, batch_doc))
+            else:
+                q.finish(result=self._resilience_doc(sweep, a, b, batch_doc))
+
+    def _drain_doc(self, session, sweep, row: int, batch_doc) -> dict:
+        unplaced_rows = sweep.requeue_rows[row][
+            (sweep.requeue_nodes[row] < 0) & (sweep.requeue_rows[row] >= 0)
+        ]
+        pods = session.pc.batch.pods
+        doc = {
+            "ok": True,
+            "kind": "drain",
+            "label": sweep.scenarios.labels[row],
+            "evicted": int(sweep.evicted[row]),
+            "lost": int(sweep.lost[row]),
+            "requeued": int(sweep.requeued[row]),
+            "unplaced": int(sweep.unplaced[row]),
+            "survived": bool(sweep.unplaced[row] == 0),
+            "unplaced_pods": [
+                ((pods[int(r)].get("metadata") or {}).get("name", f"pod[{r}]"))
+                for r in unplaced_rows[:50]
+            ],
+        }
+        doc.update(batch_doc)
+        return doc
+
+    def _resilience_doc(self, sweep, a: int, b: int, batch_doc) -> dict:
+        unplaced = sweep.unplaced[a:b]
+        survived = int((unplaced == 0).sum())
+        order = np.argsort(-unplaced, kind="stable")[:5]
+        doc = {
+            "ok": True,
+            "kind": "resilience",
+            "scenarios": int(b - a),
+            "survived": survived,
+            "survival_rate": round(float(survived) / (b - a), 4) if b > a else 1.0,
+            "evicted_total": int(sweep.evicted[a:b].sum()),
+            "unplaced_max": int(unplaced.max()) if b > a else 0,
+            "worst": [
+                [sweep.scenarios.labels[a + int(s)], int(unplaced[s])]
+                for s in order
+                if unplaced[s] > 0
+            ],
+        }
+        doc.update(batch_doc)
+        return doc
+
+    # -- singleton queries -------------------------------------------------
+
+    def _run_single(self, q: Query) -> None:
+        try:
+            if q.kind == "fit":
+                q.finish(result=self._run_fit(q))
+            elif q.kind == "capacity":
+                q.finish(result=self._run_capacity(q))
+            else:
+                q.finish(error=BadRequest(f"unknown query kind {q.kind!r}"))
+        except ServeError as exc:
+            q.finish(error=exc)
+        except PlanInterrupted as exc:
+            q.finish(error=DeadlineExceeded(
+                f"deadline expired mid-query ({exc.reason})",
+                extra={"partial": None},
+            ))
+
+    def _run_fit(self, q: Query) -> dict:
+        """Does this app fit? — the FULL one-shot `simulate()` semantics
+        (preemption included) over the session's WHOLE snapshot: cluster
+        workloads AND the session's app list place first, then the query
+        app, so every endpoint of a session answers against the same
+        cluster state.  The pod-name stream is seeded from the request
+        fingerprint: the served answer is bit-identical to a one-shot
+        run with the same seed (the acceptance pin), and compiled
+        executables stay warm across requests because the cluster shapes
+        repeat.  The verdict (`fits`, `unscheduled*`, `placements`)
+        covers the QUERY app's pods; strands among the snapshot's own
+        pods are reported separately as `session_unscheduled`."""
+        from .. import constants as C
+        from ..api import simulate
+        from ..audit.checker import audit_enabled
+        from ..core.objects import AppResource
+        from ..workloads.expand import seed_name_hashes
+        from ..workloads.validate import SpecError
+
+        session = q.session
+        app = app_from_payload(q.payload)
+        existing = {a.name for a in session.apps}
+        qname = app.name
+        while qname in existing:
+            # the app-name label is the query/session discriminator
+            # below — keep suffixing until genuinely unique (a session
+            # may itself contain '<name>-query')
+            qname = f"{qname}-query"
+        if qname != app.name:
+            app = AppResource(name=qname, resource=app.resource)
+        want_audit = (
+            audit_enabled() if self.store.audit is None else self.store.audit
+        )
+        with span("serve.fit", sid=session.sid):
+            with _EXPAND_LOCK:
+                seed_name_hashes(name_seed(q.fingerprint))
+                try:
+                    result = simulate(
+                        session.cluster, list(session.apps) + [app],
+                        extended_resources=self.store.extended_resources,
+                        sched_config=session.sched_config,
+                        audit=want_audit,
+                    )
+                except SpecError as exc:
+                    # a malformed inline workload is the client's 400;
+                    # anything else escaping simulate() is OUR bug and
+                    # surfaces as the taxonomy's 500 + flight bundle
+                    raise BadRequest(f"fit query rejected: {exc}") from exc
+
+        def is_query(pod: dict) -> bool:
+            labels = (pod.get("metadata") or {}).get("labels") or {}
+            return labels.get(C.LABEL_APP_NAME) == app.name
+
+        q_unscheduled = [
+            u for u in result.unscheduled_pods if is_query(u.pod)
+        ]
+        unscheduled = [
+            {"pod": (u.pod.get("metadata") or {}).get("name", ""),
+             "reason": u.reason}
+            for u in q_unscheduled[:50]
+        ]
+        placements = {}
+        for s in result.node_status:
+            names = sorted(
+                p["metadata"]["name"] for p in s.pods if is_query(p)
+            )
+            if names:
+                placements[s.node["metadata"]["name"]] = names
+        doc = {
+            "ok": True,
+            "kind": "fit",
+            "app": app.name,
+            "fits": not q_unscheduled,
+            "unscheduled": len(q_unscheduled),
+            "session_unscheduled": len(result.unscheduled_pods)
+            - len(q_unscheduled),
+            "preempted": len(result.preempted_pods),
+            "unscheduled_pods": unscheduled,
+            "placements": placements,
+            "fingerprint": q.fingerprint,
+        }
+        if result.audit is not None:
+            doc["audit"] = result.audit.counters()
+        return doc
+
+    def _run_capacity(self, q: Query) -> dict:
+        """Minimum newNode clones for the given workloads — the planner's
+        own search with the query's cooperative deadline at candidate
+        boundaries.  A deadline-expired search answers 504 with the
+        structured partial (best candidate verified so far), the exit-3
+        contract over HTTP."""
+        from ..plan.capacity import plan_capacity
+        from ..workloads.expand import seed_name_hashes
+        from ..workloads.validate import SpecError
+
+        session = q.session
+        if session.new_node is None:
+            raise BadRequest(
+                "this snapshot has no newNode template; capacity planning "
+                "needs one (spec.newNode in the Config CR)"
+            )
+        apps = (
+            [app_from_payload(q.payload)]
+            if (q.payload.get("workloads") or q.payload.get("app"))
+            else session.apps
+        )
+        from .. import constants as C
+
+        max_new = int_field(q.payload, "max_new_nodes", 64)
+        if not 1 <= max_new <= C.MAX_NUM_NEW_NODE:
+            # the search tensorizes base + max_new candidate nodes up
+            # front — an uncapped client value is a host-OOM lever
+            raise BadRequest(
+                f"max_new_nodes must be in [1, {C.MAX_NUM_NEW_NODE}], "
+                f"got {max_new}"
+            )
+        with span("serve.capacity", sid=session.sid):
+            with _EXPAND_LOCK:
+                seed_name_hashes(name_seed(q.fingerprint))
+                try:
+                    plan = plan_capacity(
+                        session.cluster, apps, session.new_node,
+                        max_new_nodes=max_new,
+                        extended_resources=self.store.extended_resources,
+                        sched_config=session.sched_config,
+                        control=q.control,
+                        audit=self.store.audit,
+                    )
+                except SpecError as exc:
+                    raise BadRequest(
+                        f"capacity query rejected: {exc}"
+                    ) from exc
+        doc = {
+            "ok": bool(plan.success),
+            "kind": "capacity",
+            "success": bool(plan.success),
+            "nodes_added": int(plan.nodes_added),
+            "message": plan.message,
+            "partial": bool(plan.partial),
+            "probes": {str(k): v for k, v in sorted(plan.probes.items())},
+            "fingerprint": q.fingerprint,
+        }
+        if plan.audit:
+            doc["audit"] = plan.audit
+        if plan.partial:
+            raise DeadlineExceeded(
+                plan.message or "capacity search interrupted by deadline",
+                extra={"partial": doc},
+            )
+        return doc

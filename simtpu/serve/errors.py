@@ -1,0 +1,132 @@
+"""The serve error taxonomy and its HTTP mapping (ISSUE 14).
+
+One hierarchy, one table: every failure class a served request can hit
+maps to exactly one HTTP status + machine-readable `code`, the same way
+the one-shot CLI's failure classes map to exit codes (docs/robustness.md
+— the two tables cross-reference each other in docs/serving.md).  The
+daemon never answers a request with a traceback: anything not in the
+taxonomy is an `InternalError` (500) with a flight-recorder bundle
+behind it (obs/flight.py).
+
+The design rule mirrors the CLI's: a *structured* failure is part of the
+API (400/404/429/503/504 bodies are stable JSON documents clients
+dispatch on), while a 500 is a bug report.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class ServeError(Exception):
+    """Base of the served-failure taxonomy.  `status` is the HTTP code,
+    `code` the stable machine-readable discriminator in the JSON body,
+    `retry_after` an optional Retry-After header value in seconds, and
+    `extra` additional body fields (e.g. a partial result)."""
+
+    status = 500
+    code = "internal"
+
+    def __init__(
+        self,
+        message: str,
+        retry_after: Optional[float] = None,
+        extra: Optional[Dict[str, object]] = None,
+    ):
+        super().__init__(message)
+        self.retry_after = retry_after
+        self.extra = extra or {}
+
+
+class BadRequest(ServeError):
+    """Malformed body/params, unknown query kind, or an ingest-rejected
+    spec (`SpecError`, bad fault spec) — the client's problem, one
+    actionable line, exactly like the CLI's exit-1 `fail_early` path."""
+
+    status = 400
+    code = "bad_request"
+
+
+class NotFound(ServeError):
+    """Unknown session id or route."""
+
+    status = 404
+    code = "not_found"
+
+
+class Overloaded(ServeError):
+    """Admission control shed this request: the bounded query queue is
+    full.  In-flight and queued work is untouched — the 429 is the
+    pressure-release valve, not a failure of anything already admitted."""
+
+    status = 429
+    code = "overloaded"
+
+
+class Degraded(ServeError):
+    """The daemon is shedding state to survive — draining for SIGTERM, or
+    a served dispatch exhausted the OOM chunk-halving backoff and idle
+    sessions were evicted (they rehydrate from checkpoint on next use).
+    Always carries Retry-After: the condition is transient by design."""
+
+    status = 503
+    code = "degraded"
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline expired.  504-style by analogy (the
+    *upstream work*, not a proxy, timed out); the body is a structured
+    partial document — `partial` carries whatever the cooperative
+    interrupt salvaged (a capacity search's best-verified candidate, the
+    CLI exit-3 contract) or null when nothing completed.  The dispatch
+    keeps running to completion on the worker; the daemon is unharmed."""
+
+    status = 504
+    code = "deadline"
+
+
+class AuditRejected(ServeError):
+    """The independent placement auditor (simtpu/audit) refused to
+    certify the answer AND the serial-exact fallback did not certify
+    either — the served analog of CLI exit 4's hard case.  Nothing
+    uncertified is ever served."""
+
+    status = 500
+    code = "audit"
+
+
+class InternalError(ServeError):
+    """Everything outside the taxonomy.  The handler wraps the original
+    exception's one-line repr and dumps a flight bundle."""
+
+    status = 500
+    code = "internal"
+
+
+#: status/code table for docs/serving.md + the error-taxonomy test —
+#: ONE source for the mapping so docs and behavior cannot drift
+HTTP_TAXONOMY = {
+    cls.code: cls.status
+    for cls in (
+        BadRequest,
+        NotFound,
+        Overloaded,
+        Degraded,
+        DeadlineExceeded,
+        AuditRejected,
+        InternalError,
+    )
+}
+
+
+def error_doc(exc: ServeError) -> Dict[str, object]:
+    """The stable JSON body of a failed request."""
+    doc: Dict[str, object] = {
+        "ok": False,
+        "error": exc.code,
+        "message": str(exc),
+    }
+    if exc.retry_after is not None:
+        doc["retry_after_s"] = round(float(exc.retry_after), 3)
+    doc.update(exc.extra)
+    return doc

@@ -1,0 +1,676 @@
+"""Warm snapshot sessions with crash-safe checkpoint recovery (ISSUE 14).
+
+A session is one loaded cluster snapshot held warm for interactive
+what-if queries: the ingested objects (cluster / apps / newNode template,
+parsed once), the tensorized problem, and a `PlacedCluster` base
+placement whose engine carry every drain/resilience sweep reads — the
+compact carried state of PR 5, amortized across requests instead of
+re-paid per CLI run.
+
+Durability contract (the robustness headline): every session checkpoints
+through `durable/checkpoint.py` at creation — a `meta` record (where the
+snapshot came from) plus a `base` record (the full placement vectors,
+the same shape the incremental planner persists per candidate).  After a
+kill -9, the restarted daemon re-indexes the session directories and
+rehydrates each session on first use WITHOUT re-dispatching: the pod-name
+stream is re-seeded from the session fingerprint
+(`durable.checkpoint.name_seed`), expansion + tensorization re-run
+deterministically, and the engine's placement log + carried state are
+rebuilt from the recorded vectors (`build_state` — bit-identical to the
+dispatched carry by the donated-state reuse guard's pinned contract, the
+same replay the planners' `--resume` rides).
+
+Session ids are the first 12 hex digits of the problem fingerprint, so
+loading the same snapshot twice is idempotent and recovery needs no
+separate id↔problem index.  Eviction (capacity or memory pressure) drops
+only the in-memory state — the checkpoint stays, and the next query
+rehydrates.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..durable.checkpoint import (
+    CheckpointError,
+    CheckpointMismatch,
+    PlanCheckpoint,
+    file_digest,
+    name_seed,
+    plan_fingerprint,
+)
+from ..obs.metrics import REGISTRY
+from ..obs.trace import span
+from .errors import AuditRejected, BadRequest, NotFound
+
+log = logging.getLogger("simtpu.serve")
+
+#: checkpoint `kind` stamp for session records — a plan checkpoint can
+#: never be mistaken for a session and vice versa (CheckpointMismatch)
+SESSION_KIND = "serve-session"
+
+#: session id length (hex digits of the problem fingerprint)
+SID_LEN = 12
+
+_SESSIONS_GAUGE = REGISTRY.gauge("serve.sessions")
+_RECOVERED = REGISTRY.counter("serve.recovered")
+_EVICTIONS = REGISTRY.counter("serve.evictions")
+
+#: serializes pod-name-stream seeding + expansion PROCESS-WIDE: generated
+#: pod names draw from one global RNG (workloads/expand.py), and every
+#: bit-identity contract in the daemon — served-vs-one-shot fit answers,
+#: kill -9 rehydration — rests on the seeding owner holding the stream
+#: for its whole expansion.  Session creation/rehydration (here) and the
+#: batcher's fit/capacity queries (batching.py) all take it.
+EXPAND_LOCK = threading.Lock()
+
+
+class Session:
+    """One warm snapshot: ingested objects + placed base + per-session
+    lock (engine access serializes on it — the engine's placement log and
+    carried state are single-writer structures)."""
+
+    def __init__(
+        self,
+        sid: str,
+        fingerprint: str,
+        config_path: str,
+        cluster,
+        apps,
+        new_node: Optional[dict],
+        sched_config,
+        pc,
+        audit: Optional[dict] = None,
+        recovered: bool = False,
+    ):
+        self.sid = sid
+        self.fingerprint = fingerprint
+        self.config_path = config_path
+        self.cluster = cluster
+        self.apps = apps
+        self.new_node = new_node
+        self.sched_config = sched_config
+        self.pc = pc
+        self.audit = audit
+        self.recovered = recovered
+        self.lock = threading.RLock()
+        self.created_unix = time.time()
+        self.last_used = time.monotonic()
+        self.queries = 0
+        # node name -> index, for drain masks
+        self.node_index = {
+            (n.get("metadata") or {}).get("name", f"node[{i}]"): i
+            for i, n in enumerate(cluster.nodes)
+        }
+
+    def touch(self, n: int = 1) -> None:
+        """Mark `n` queries served (a coalesced batch touches once with
+        its width, so the summary's per-session count stays honest)."""
+        self.last_used = time.monotonic()
+        self.queries += n
+
+    def summary(self) -> Dict[str, object]:
+        nodes = np.asarray(self.pc.nodes)
+        return {
+            "session": self.sid,
+            "config": self.config_path,
+            "nodes": int(len(self.cluster.nodes)),
+            "pods": int(len(nodes)),
+            "placed": int((nodes >= 0).sum()),
+            "unplaced": int((nodes < 0).sum()),
+            "queries": int(self.queries),
+            "recovered": bool(self.recovered),
+            "created_unix": self.created_unix,
+            "audit_ok": bool(self.audit.get("ok")) if self.audit else None,
+            "has_new_node": self.new_node is not None,
+        }
+
+
+def _extras_rows(pc) -> Dict[str, np.ndarray]:
+    """Row-parallel extended-resource vectors of a fresh base placement,
+    rebuilt from the engine log (a fresh `place_cluster` appends placed
+    pods in batch order, the `PlacedCluster.log_row` contract) — the
+    payload of the `base` checkpoint record, mirroring what the
+    incremental planner persists per candidate."""
+    tensors = pc.tensors
+    ext = pc.engine.ext_log
+    p = len(pc.nodes)
+    lvm = np.zeros((p, tensors.ext.vg_cap.shape[1]), np.float32)
+    dev = np.zeros((p, tensors.ext.sdev_cap.shape[1]), bool)
+    gpu = np.zeros((p, tensors.ext.gpu_dev_total.shape[1]), np.float32)
+    for j, row in enumerate(pc.log_row):
+        lvm[row] = np.asarray(ext["vg_alloc"][j], np.float32)
+        dev[row] = np.asarray(ext["sdev_take"][j], bool)
+        gpu[row] = np.asarray(ext["gpu_shares"][j], np.float32)
+    return {"lvm": lvm, "dev": dev, "gpu": gpu}
+
+
+def _replay_placed_cluster(
+    cluster, apps, rec, sched_config, extended_resources=()
+):
+    """A `PlacedCluster` equivalent to one that just ran the recorded
+    base placement: tensorization re-runs (deterministic given the
+    re-seeded name stream, and with the SAME extended-resource terms the
+    creation-time tensorization used — the recorded lvm/dev/gpu vectors
+    carry those widths), the engine's log and carried state rebuild from
+    the record — no dispatch (the planners' checkpoint-replay contract,
+    plan/incremental.py `replay_engine`)."""
+    from ..engine.rounds import RoundsEngine
+    from ..engine.state import build_state
+    from ..faults.drain import PlacedCluster
+    from ..parallel.sweep import assemble_planning_problem
+
+    tz, _all_nodes, _n_base, ordered = assemble_planning_problem(
+        cluster, apps, cluster.nodes[0], 0, tuple(extended_resources)
+    )
+    batch = tz.add_pods(ordered)
+    tensors = tz.freeze()
+    nodes = np.asarray(rec["nodes"])
+    reasons = np.asarray(rec["reasons"])
+    if nodes.shape[0] != len(batch.pods):
+        raise CheckpointMismatch(
+            f"session base record covers {nodes.shape[0]} pods, the "
+            f"re-expanded snapshot has {len(batch.pods)}; refusing to "
+            "rehydrate (the snapshot files changed since the checkpoint)"
+        )
+    eng = RoundsEngine(tz)
+    eng.sched_config = sched_config
+    r = tensors.alloc.shape[1]
+    req_pad = batch.req
+    if req_pad.shape[1] < r:
+        req_pad = np.pad(req_pad, ((0, 0), (0, r - req_pad.shape[1])))
+    ok = np.flatnonzero(nodes >= 0)
+    lvm = np.asarray(rec["lvm"], np.float32)
+    dev = np.asarray(rec["dev"], bool)
+    gpu = np.asarray(rec["gpu"], np.float32)
+    eng.placed_group = np.asarray(batch.group)[ok].tolist()
+    eng.placed_node = nodes[ok].tolist()
+    eng.placed_req = list(req_pad[ok])
+    eng.ext_log = {
+        "node": nodes[ok].tolist(),
+        "vg_alloc": list(lvm[ok]),
+        "sdev_take": list(dev[ok]),
+        "gpu_shares": list(gpu[ok]),
+        "gpu_mem": np.asarray(batch.ext["gpu_mem"])[ok].tolist(),
+    }
+    dense = build_state(
+        tensors,
+        np.asarray(eng.placed_group, np.int32),
+        np.asarray(eng.placed_node, np.int32),
+        eng.log_req_matrix(r),
+        eng.ext_log,
+    )
+    eng.last_state = eng._store_state(tensors, dense)
+    eng._last_vocab = eng.state_vocab(tensors)
+    eng._state_dirty = False
+    return PlacedCluster(
+        tz=tz, tensors=tensors, batch=batch, engine=eng,
+        nodes=nodes, reasons=reasons,
+    )
+
+
+class SessionStore:
+    """Thread-safe session registry with checkpoint-backed recovery.
+
+    `state_dir` "" disables durability (sessions are memory-only and die
+    with the process — the bench/ephemeral mode); otherwise each session
+    owns `state_dir/<sid>/` with the durable/checkpoint.py layout."""
+
+    def __init__(
+        self,
+        state_dir: str = "",
+        max_sessions: int = 8,
+        audit: Optional[bool] = None,
+        sched_config_path: str = "",
+        extended_resources: Sequence[str] = (),
+        progress=None,
+    ):
+        self.state_dir = state_dir
+        self.max_sessions = max(int(max_sessions), 1)
+        self.audit = audit
+        self.sched_config_path = sched_config_path
+        self.extended_resources = tuple(extended_resources)
+        self._say = progress or (lambda msg: None)
+        self._lock = threading.Lock()
+        self._sessions: Dict[str, Session] = {}
+        # sid -> config path for every session this store can produce —
+        # includes evicted and crash-recovered ones not yet rehydrated
+        self._known: Dict[str, str] = {}
+        # sid -> Event for a creation in progress: concurrent loads of
+        # the same snapshot wait for the winner instead of each paying
+        # the full placement + audit and discarding all but one
+        self._pending: Dict[str, threading.Event] = {}
+        if state_dir:
+            os.makedirs(state_dir, exist_ok=True)
+
+    # -- ingest ------------------------------------------------------------
+
+    def _load_problem(self, config_path: str):
+        """Ingest one simon config into (cluster, apps, new_node,
+        sched_config) — the Applier's loaders, so serve sessions accept
+        exactly what `simtpu apply -f` accepts (charts included)."""
+        from ..plan.capacity import Applier, ApplierOptions
+
+        try:
+            applier = Applier(ApplierOptions(
+                simon_config=config_path,
+                default_scheduler_config=self.sched_config_path,
+                extended_resources=list(self.extended_resources),
+            ))
+            cluster = applier.load_cluster()
+            apps = applier.load_apps()
+            new_node = None
+            try:
+                new_node = applier.load_new_node()
+            except (ValueError, FileNotFoundError, OSError):
+                # newNode is optional for a session: without it only the
+                # capacity endpoint refuses (BadRequest), fit/drain/
+                # resilience queries need no template
+                pass
+            sched_config = applier._sched_config()
+        except (ValueError, OSError) as exc:
+            # OSError covers the whole client-controlled-path family
+            # (FileNotFoundError, PermissionError, IsADirectoryError...):
+            # a bad snapshot path is the client's 400, never a 500 bug
+            # report with a flight bundle behind it
+            raise BadRequest(f"snapshot ingest failed: {exc}") from exc
+        if not cluster.nodes:
+            raise BadRequest(
+                f"snapshot {config_path!r} has no nodes; nothing to serve"
+            )
+        return cluster, apps, new_node, sched_config
+
+    def _fingerprint(self, cluster, apps, new_node) -> str:
+        return plan_fingerprint(
+            cluster, apps, new_node,
+            extra={
+                "serve": SESSION_KIND,
+                "extended_resources": list(self.extended_resources),
+                "sched_config": file_digest(self.sched_config_path),
+            },
+        )
+
+    def _place_base(self, fingerprint: str, cluster, apps, sched_config):
+        """The session's base placement: deterministic (name stream
+        seeded from the fingerprint, so creation and recovery expand
+        identical pods) and audited before anything is served from it."""
+        from ..audit.checker import (
+            audit_enabled,
+            audit_placed_cluster,
+            inject_divergence_enabled,
+        )
+        from ..faults import place_cluster
+        from ..workloads.expand import seed_name_hashes
+
+        with EXPAND_LOCK, span(
+            "serve.place_base", nodes=len(cluster.nodes)
+        ):
+            seed_name_hashes(name_seed(fingerprint))
+            pc = place_cluster(
+                cluster, apps,
+                extended_resources=self.extended_resources,
+                sched_config=sched_config,
+            )
+        audit_doc = None
+        want_audit = audit_enabled() if self.audit is None else self.audit
+        if want_audit:
+            pc, audit_doc, hard_fail = audit_placed_cluster(
+                pc, self._say, inject=inject_divergence_enabled()
+            )
+            if hard_fail is not None:
+                raise AuditRejected(
+                    f"session base placement failed certification: "
+                    f"{hard_fail}"
+                )
+        return pc, audit_doc
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def create(self, config_path: str):
+        """Load a snapshot into a session (idempotent: the same problem
+        returns the existing session).  Returns (session, created)."""
+        if not config_path or not isinstance(config_path, str):
+            raise BadRequest("body must carry {'config': '<path>'}")
+        cluster, apps, new_node, sched_config = self._load_problem(
+            config_path
+        )
+        fingerprint = self._fingerprint(cluster, apps, new_node)
+        sid = fingerprint[:SID_LEN]
+        while True:
+            with self._lock:
+                got = self._sessions.get(sid)
+                if got is not None:
+                    got.touch()
+                    return got, False
+                pending = self._pending.get(sid)
+                if pending is None:
+                    self._pending[sid] = threading.Event()
+                    break
+            # another thread is already building this exact session:
+            # wait for its result instead of duplicating the placement
+            pending.wait(timeout=600.0)
+        try:
+            sdir = self._session_dir(sid)
+            if sdir and os.path.isdir(sdir):
+                # an evicted (or pre-crash) session with a checkpoint on
+                # disk: rehydrate (zero dispatches) instead of re-paying
+                # the full placement + audit — re-loading IS the daemon's
+                # whole amortization story.  A broken checkpoint falls
+                # through to a fresh placement below.
+                try:
+                    return self._rehydrate(sid, config_path), False
+                except (BadRequest, NotFound, CheckpointError,
+                        CheckpointMismatch) as exc:
+                    log.warning(
+                        "serve: session %s checkpoint unusable (%s); "
+                        "re-placing fresh", sid, exc,
+                    )
+            session = self._build_fresh(
+                None, config_path,
+                problem=(cluster, apps, new_node, sched_config),
+                fingerprint=fingerprint,
+            )
+            return session, True
+        finally:
+            with self._lock:
+                done = self._pending.pop(sid, None)
+            if done is not None:
+                done.set()
+
+    def _build_fresh(
+        self,
+        expected_sid: Optional[str],
+        config_path: str,
+        problem=None,
+        fingerprint: Optional[str] = None,
+    ) -> Session:
+        """Full fresh build of one session (ingest unless handed in,
+        place, audit, checkpoint, insert) — the shared tail of `create`
+        and of `get`'s broken-checkpoint fallback.  `expected_sid`
+        guards the fallback: a rebuild whose fingerprint no longer
+        matches the requested session means the snapshot files changed,
+        and silently answering from a different problem would be worse
+        than the 400."""
+        if problem is None:
+            problem = self._load_problem(config_path)
+        cluster, apps, new_node, sched_config = problem
+        if fingerprint is None:
+            fingerprint = self._fingerprint(cluster, apps, new_node)
+        sid = fingerprint[:SID_LEN]
+        if expected_sid is not None and sid != expected_sid:
+            raise BadRequest(
+                f"session {expected_sid!r} cannot be rebuilt: the "
+                f"snapshot files changed (they now define problem "
+                f"{sid}); delete the session and reload"
+            )
+        pc, audit_doc = self._place_base(
+            fingerprint, cluster, apps, sched_config
+        )
+        session = Session(
+            sid, fingerprint, config_path, cluster, apps, new_node,
+            sched_config, pc, audit=audit_doc,
+        )
+        self._checkpoint(session)
+        with self._lock:
+            raced = self._sessions.get(sid)
+            if raced is not None:
+                # a concurrent get() rehydrated it first — keep the
+                # copy queries may already hold
+                return raced
+            self._evict_for_capacity_locked()
+            self._sessions[sid] = session
+            self._known[sid] = config_path
+            _SESSIONS_GAUGE.set(len(self._sessions))
+        self._say(f"session {sid} loaded from {config_path}")
+        return session
+
+    def get(self, sid: str) -> Session:
+        """The live session, rehydrating from its checkpoint when it was
+        evicted or belongs to a pre-crash incarnation of the daemon.
+        Concurrent misses on one sid dedup through `_pending`, exactly
+        like `create`: a post-crash burst of K queries pays ONE
+        rehydration, not K."""
+        while True:
+            with self._lock:
+                got = self._sessions.get(sid)
+                if got is not None:
+                    return got
+                config_path = self._known.get(sid)
+                if config_path is None:
+                    raise NotFound(
+                        f"no session {sid!r} (load the snapshot first)"
+                    )
+                pending = self._pending.get(sid)
+                if pending is None:
+                    self._pending[sid] = threading.Event()
+                    break
+            pending.wait(timeout=600.0)
+        try:
+            return self._rehydrate(sid, config_path)
+        except CheckpointError as exc:
+            # a corrupt/incomplete checkpoint must not turn this sid
+            # into a permanent 500: rebuild fresh, exactly as create()
+            # does for the same condition (the fingerprint guard inside
+            # keeps a CHANGED snapshot a 400, not a silent swap)
+            log.warning(
+                "serve: session %s checkpoint unusable (%s); "
+                "re-placing fresh", sid, exc,
+            )
+            return self._build_fresh(sid, config_path)
+        finally:
+            with self._lock:
+                done = self._pending.pop(sid, None)
+            if done is not None:
+                done.set()
+
+    def delete(self, sid: str) -> None:
+        with self._lock:
+            if sid not in self._sessions and sid not in self._known:
+                raise NotFound(f"no session {sid!r}")
+            self._sessions.pop(sid, None)
+            self._known.pop(sid, None)
+            _SESSIONS_GAUGE.set(len(self._sessions))
+        sdir = self._session_dir(sid)
+        if sdir and os.path.isdir(sdir):
+            import shutil
+
+            shutil.rmtree(sdir, ignore_errors=True)
+
+    def list(self) -> List[Dict[str, object]]:
+        with self._lock:
+            live = [s.summary() for s in self._sessions.values()]
+            cold = [
+                {"session": sid, "config": cfg, "cold": True}
+                for sid, cfg in self._known.items()
+                if sid not in self._sessions
+            ]
+        return sorted(live, key=lambda d: d["session"]) + sorted(
+            cold, key=lambda d: d["session"]
+        )
+
+    # -- durability --------------------------------------------------------
+
+    def _session_dir(self, sid: str) -> str:
+        return os.path.join(self.state_dir, sid) if self.state_dir else ""
+
+    def _checkpoint(self, session: Session) -> None:
+        """Persist the session's identity + base placement atomically
+        (durable/checkpoint.py — EINTR/rename races retried once, ENOSPC
+        loud).  No state dir = memory-only session."""
+        sdir = self._session_dir(session.sid)
+        if not sdir:
+            return
+        ck = PlanCheckpoint(
+            sdir, kind=SESSION_KIND, fingerprint=session.fingerprint
+        )
+        ck.put(
+            "meta", 0,
+            config=session.config_path,
+            sched_config=self.sched_config_path,
+            extended_resources=json.dumps(list(self.extended_resources)),
+        )
+        nodes = np.asarray(session.pc.nodes)
+        extras = _extras_rows(session.pc)
+        ck.put(
+            "base", 0,
+            nodes=nodes, reasons=np.asarray(session.pc.reasons),
+            lvm=extras["lvm"], dev=extras["dev"], gpu=extras["gpu"],
+        )
+
+    def recover(self) -> List[str]:
+        """Index every session directory under `state_dir` (the restart
+        path).  Rehydration itself is lazy — the first query against a
+        recovered sid pays the replay; indexing is just a manifest read,
+        so restart is O(sessions) metadata, not O(sessions) placements."""
+        if not self.state_dir or not os.path.isdir(self.state_dir):
+            return []
+        found = []
+        for sid in sorted(os.listdir(self.state_dir)):
+            sdir = os.path.join(self.state_dir, sid)
+            mpath = os.path.join(sdir, "manifest.json")
+            if not os.path.isfile(mpath):
+                continue
+            try:
+                with open(mpath) as f:
+                    man = json.load(f)
+                if man.get("kind") != SESSION_KIND:
+                    continue
+                ck = PlanCheckpoint(
+                    sdir, kind=SESSION_KIND,
+                    fingerprint=man.get("fingerprint", ""), resume=True,
+                )
+                meta = ck.get("meta", 0)
+                if meta is None or ck.get("base", 0) is None:
+                    raise CheckpointError(
+                        f"session {sid}: meta/base record missing"
+                    )
+                config_path = str(meta["config"])
+            except (CheckpointError, CheckpointMismatch, OSError,
+                    ValueError) as exc:
+                log.warning(
+                    "serve: skipping unrecoverable session dir %s (%s)",
+                    sdir, exc,
+                )
+                continue
+            with self._lock:
+                self._known[sid] = config_path
+            found.append(sid)
+        if found:
+            self._say(
+                f"recovered {len(found)} session(s) from {self.state_dir} "
+                "(rehydrated on first use)"
+            )
+        return found
+
+    def _rehydrate(self, sid: str, config_path: str) -> Session:
+        """Rebuild one session from its checkpoint: re-ingest, re-seed the
+        name stream, re-tensorize, replay the recorded placement into a
+        fresh engine — bit-identical carried state, zero dispatches."""
+        sdir = self._session_dir(sid)
+        if not sdir or not os.path.isdir(sdir):
+            raise NotFound(
+                f"session {sid!r} was evicted and has no checkpoint to "
+                "rehydrate from; load the snapshot again"
+            )
+        from ..workloads.expand import seed_name_hashes
+
+        cluster, apps, new_node, sched_config = self._load_problem(
+            config_path
+        )
+        fingerprint = self._fingerprint(cluster, apps, new_node)
+        try:
+            ck = PlanCheckpoint(
+                sdir, kind=SESSION_KIND, fingerprint=fingerprint,
+                resume=True,
+            )
+            rec = ck.get("base", 0)
+            if rec is None:
+                raise CheckpointError(
+                    f"session {sid}: base record missing"
+                )
+        except CheckpointMismatch as exc:
+            raise BadRequest(
+                f"session {sid!r} cannot rehydrate: {exc} (the snapshot "
+                "files changed since the checkpoint; delete and reload)"
+            ) from exc
+        with EXPAND_LOCK, span("serve.rehydrate", sid=sid):
+            seed_name_hashes(name_seed(fingerprint))
+            pc = _replay_placed_cluster(
+                cluster, apps, rec, sched_config,
+                extended_resources=self.extended_resources,
+            )
+        session = Session(
+            sid, fingerprint, config_path, cluster, apps, new_node,
+            sched_config, pc, recovered=True,
+        )
+        _RECOVERED.inc()
+        with self._lock:
+            raced = self._sessions.get(sid)
+            if raced is not None:
+                return raced
+            self._evict_for_capacity_locked()
+            self._sessions[sid] = session
+            self._known[sid] = config_path
+            _SESSIONS_GAUGE.set(len(self._sessions))
+        self._say(f"session {sid} rehydrated from checkpoint")
+        return session
+
+    # -- eviction ----------------------------------------------------------
+
+    def _evict_for_capacity_locked(self) -> None:
+        """Drop least-recently-used in-memory sessions past the cap (the
+        caller holds `_lock` and is about to insert one).  Checkpointed
+        sessions stay recoverable; memory-only ones are gone for good —
+        both count in `serve.evictions`."""
+        while len(self._sessions) >= self.max_sessions:
+            victim = min(
+                self._sessions.values(), key=lambda s: s.last_used
+            )
+            self._sessions.pop(victim.sid)
+            if not self.state_dir:
+                self._known.pop(victim.sid, None)
+            _EVICTIONS.inc()
+            log.warning(
+                "serve: evicted session %s (capacity %d); it %s",
+                victim.sid, self.max_sessions,
+                "rehydrates from checkpoint on next use"
+                if self.state_dir else "was memory-only and is gone",
+            )
+        _SESSIONS_GAUGE.set(len(self._sessions))
+
+    def evict_idle(self, keep: Sequence[str] = ()) -> int:
+        """Memory-pressure valve: drop every in-memory session except
+        `keep` (the one mid-query).  Called when a served dispatch
+        exhausted the OOM chunk-halving backoff — shedding warm state is
+        the graceful degradation; the checkpoints make it survivable.
+
+        Best-effort by design: queries still queued for an evicted
+        session keep it alive through their own references until the
+        (single) worker drains them, and the next request against it
+        rehydrates a fresh copy — so the reclaim lands once the short
+        queue empties, which is also when the 503's Retry-After tells
+        clients to come back."""
+        kept = set(keep)
+        with self._lock:
+            victims = [
+                sid for sid in self._sessions if sid not in kept
+            ]
+            for sid in victims:
+                self._sessions.pop(sid)
+                if not self.state_dir:
+                    self._known.pop(sid, None)
+                _EVICTIONS.inc()
+            _SESSIONS_GAUGE.set(len(self._sessions))
+        if victims:
+            log.warning(
+                "serve: memory pressure — evicted %d idle session(s): %s",
+                len(victims), ", ".join(victims),
+            )
+        return len(victims)

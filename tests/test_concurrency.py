@@ -1,0 +1,181 @@
+"""Concurrent `simulate()` thread-safety (ISSUE 14 satellite).
+
+The serve daemon's thread model stands on these pins: multiple threads
+driving simulations against the shared process-global state — the jit
+caches and AOT registry (engine/precompile.py), a shared shape-bucket
+registry (`RoundsEngine.bulk_shapes`), and the metrics REGISTRY
+(obs/metrics.py) — must produce placements bit-identical to serial runs
+and corrupt no counters.
+
+Pod NAMES are excluded from the bit-identity claim here, deliberately:
+generated name suffixes draw from one process-global stream
+(workloads/expand.py), so concurrent expansions interleave draws.  Names
+never feed a kernel — placements are name-independent — and the serve
+daemon serializes expansion under its request seed (batching._EXPAND_LOCK)
+precisely so SERVED answers are reproducible to the name.  The canonical
+comparison below is {node -> sorted pod base names}, suffixes stripped.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from simtpu import AppResource, ResourceTypes
+from simtpu.api import simulate
+from simtpu.obs.metrics import REGISTRY
+
+from .fixtures import make_fake_deployment, make_fake_node
+
+N_THREADS = 4
+
+
+def _problem(tag: str = ""):
+    cluster = ResourceTypes()
+    cluster.nodes = [
+        make_fake_node(f"node-{i}", "8", "16Gi") for i in range(6)
+    ]
+    apps = [
+        AppResource(
+            name=f"app{tag}",
+            resource=ResourceTypes(
+                deployments=[
+                    make_fake_deployment(f"web{tag}", "default", 9, "1", "2Gi"),
+                    make_fake_deployment(f"db{tag}", "default", 4, "2", "3Gi"),
+                ]
+            ),
+        )
+    ]
+    return cluster, apps
+
+
+def _canonical(result):
+    """{node: sorted pod BASE names} — the name-suffix-independent view.
+    A Deployment pod is named <dep>-<rs hash>-<pod hash> (both hashes
+    drawn from the process-global stream, workloads/expand.py), so the
+    base is everything before the first '-' (the fixture names carry
+    none)."""
+    return {
+        s.node["metadata"]["name"]: sorted(
+            p["metadata"]["name"].split("-", 1)[0] for p in s.pods
+        )
+        for s in result.node_status
+    }
+
+
+def _run_threads(fn, n=N_THREADS):
+    """Run fn(i) on n threads; re-raise the first worker exception."""
+    results = [None] * n
+    errors = []
+
+    def wrap(i):
+        try:
+            results[i] = fn(i)
+        except BaseException as exc:  # noqa: BLE001 — surfaced below
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=wrap, args=(i,)) for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return results
+
+
+class TestConcurrentSimulate:
+    def test_same_problem_bit_identical_vs_serial(self):
+        cluster, apps = _problem()
+        serial = _canonical(simulate(cluster, apps))
+        outs = _run_threads(lambda i: _canonical(simulate(cluster, apps)))
+        for got in outs:
+            assert got == serial
+
+    def test_distinct_problems_each_match_their_serial_run(self):
+        problems = [_problem(tag=str(i)) for i in range(N_THREADS)]
+        serial = [
+            _canonical(simulate(c, a)) for c, a in problems
+        ]
+        outs = _run_threads(
+            lambda i: _canonical(simulate(*problems[i]))
+        )
+        assert outs == serial
+
+    def test_concurrent_with_shared_aot_registry(self):
+        """precompile=True runs the AOT pipeline's background compile
+        pool under each simulation — pool threads and dispatch threads
+        hammer the signature registry together."""
+        cluster, apps = _problem(tag="aot")
+        serial = _canonical(simulate(cluster, apps, precompile=True))
+        outs = _run_threads(
+            lambda i: _canonical(simulate(cluster, apps, precompile=True))
+        )
+        for got in outs:
+            assert got == serial
+
+    def test_concurrent_bulk_engines_share_shape_registry(self):
+        """One shape-bucket registry across concurrently-placing bulk
+        engines (the PR 1 sharing the serve sessions lean on): identical
+        placement vectors vs the serial run."""
+        from simtpu.engine.rounds import RoundsEngine
+        from simtpu.faults import place_cluster
+
+        cluster, apps = _problem(tag="bulk")
+        shared: dict = {}
+
+        def factory(tz):
+            eng = RoundsEngine(tz)
+            eng.bulk_shapes = shared
+            eng.snap_shapes = True
+            return eng
+
+        base = place_cluster(cluster, apps, engine_factory=factory)
+        base_nodes = np.asarray(base.nodes)
+        outs = _run_threads(
+            lambda i: np.asarray(
+                place_cluster(cluster, apps, engine_factory=factory).nodes
+            )
+        )
+        for nodes in outs:
+            assert np.array_equal(nodes, base_nodes)
+
+
+class TestRegistryUnderConcurrency:
+    def test_counter_increments_are_exact(self):
+        c = REGISTRY.counter("test.concurrency.counter")
+        before = c.value
+        per_thread, threads = 5000, 8
+        _run_threads(
+            lambda i: [c.inc() for _ in range(per_thread)], n=threads
+        )
+        assert c.value == before + per_thread * threads
+
+    def test_histogram_counts_are_exact(self):
+        h = REGISTRY.histogram("test.concurrency.hist")
+        before = h.count
+        per_thread, threads = 2000, 8
+        _run_threads(
+            lambda i: [h.observe(float(i)) for _ in range(per_thread)],
+            n=threads,
+        )
+        assert h.count == before + per_thread * threads
+        assert h.min == 0.0 and h.max == float(threads - 1)
+
+    def test_fetch_counter_no_lost_increments(self):
+        """fetch.get is bumped from every dispatch thread; K concurrent
+        runs of a warmed problem must account for exactly K times one
+        run's fetches."""
+        cluster, apps = _problem(tag="fetch")
+        simulate(cluster, apps)  # warm every executable first
+        before = REGISTRY.snapshot()
+        simulate(cluster, apps)
+        one = REGISTRY.delta_since(before).get("fetch.get", 0)
+        assert one > 0
+        before = REGISTRY.snapshot()
+        _run_threads(lambda i: simulate(cluster, apps))
+        total = REGISTRY.delta_since(before).get("fetch.get", 0)
+        assert total == N_THREADS * one

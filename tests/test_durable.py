@@ -847,3 +847,143 @@ class TestSpecDiagnostics:
         pods = get_valid_pods_exclude_daemonset(resources)
         assert len(pods) == 2
         assert all(SOURCE_KEY not in p for p in pods)
+
+
+class TestSigterm:
+    """SIGTERM gets the same first-signal grace as ^C (ISSUE 14
+    satellite): daemons, `timeout(1)`, and CI runners send SIGTERM where
+    a human sends SIGINT — it must yield the cooperative partial (exit
+    3), not kill the process with no checkpoint and no flight bundle."""
+
+    def test_sigterm_flags_control_then_kills(self):
+        ctrl = RunControl()
+        prev_term = signal.getsignal(signal.SIGTERM)
+        prev_int = signal.getsignal(signal.SIGINT)
+        with ctrl.sigint():
+            os.kill(os.getpid(), signal.SIGTERM)
+            # delivered synchronously on the main thread: flagged, not dead
+            assert ctrl.interrupted == "SIGTERM"
+            with pytest.raises(PlanInterrupted, match="SIGTERM"):
+                ctrl.check()
+            # second delivery (either signal) = hard stop
+            with pytest.raises(KeyboardInterrupt):
+                os.kill(os.getpid(), signal.SIGTERM)
+        # BOTH handlers restored on exit
+        assert signal.getsignal(signal.SIGTERM) == prev_term
+        assert signal.getsignal(signal.SIGINT) == prev_int
+
+    def test_sigterm_mid_plan_yields_partial(self):
+        """The one-shot CLI path end to end minus the process boundary:
+        a SIGTERM delivered mid-search produces the structured partial
+        result (the same PlanInterrupted -> partial -> exit-3 flow the
+        deadline tests pin)."""
+        cluster, apps, template = _small_problem()
+        control = RunControl()
+        fired = {"n": 0}
+
+        def progress(msg):
+            fired["n"] += 1
+            if fired["n"] == 2:  # after the first candidate completed
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        with control.sigint():
+            plan = plan_capacity(
+                cluster, apps, template, control=control, progress=progress
+            )
+        assert plan.partial and not plan.success
+        assert "SIGTERM" in plan.message
+
+
+class TestCheckpointTransientRetry:
+    """Transient filesystem errors on the checkpoint write path get ONE
+    jittered retry; ENOSPC stays an immediate loud failure (ISSUE 14
+    satellite, durable/checkpoint.py `_retry_transient`)."""
+
+    @staticmethod
+    def _fail_replace(monkeypatch, errnos):
+        """Make os.replace raise OSError(errnos[i]) on call i, delegating
+        once the list is exhausted; returns the call recorder."""
+        real = os.replace
+        calls = {"n": 0}
+
+        def fake(src, dst):
+            i = calls["n"]
+            calls["n"] += 1
+            if i < len(errnos) and errnos[i] is not None:
+                raise OSError(errnos[i], os.strerror(errnos[i]), dst)
+            return real(src, dst)
+
+        monkeypatch.setattr(os, "replace", fake)
+        return calls
+
+    def test_eintr_retries_once_then_succeeds(self, tmp_path, monkeypatch):
+        import errno
+
+        ck = PlanCheckpoint(str(tmp_path / "ck"), kind="binary", fingerprint="fp")
+        calls = self._fail_replace(monkeypatch, [errno.EINTR])
+        ck.put("cand", 0, verdict=np.asarray(1))
+        # failed attempt + retry + manifest rewrite
+        assert calls["n"] == 3
+        monkeypatch.undo()
+        rd = PlanCheckpoint(
+            str(tmp_path / "ck"), kind="binary", fingerprint="fp", resume=True
+        )
+        assert int(rd.get("cand", 0)["verdict"]) == 1
+
+    def test_rename_race_enoent_retries_once(self, tmp_path, monkeypatch):
+        import errno
+
+        ck = PlanCheckpoint(str(tmp_path / "ck"), kind="binary", fingerprint="fp")
+        calls = self._fail_replace(monkeypatch, [errno.ENOENT])
+        ck.put("cand", 1, verdict=np.asarray(7))
+        assert calls["n"] == 3
+        monkeypatch.undo()
+        rd = PlanCheckpoint(
+            str(tmp_path / "ck"), kind="binary", fingerprint="fp", resume=True
+        )
+        assert int(rd.get("cand", 1)["verdict"]) == 7
+
+    def test_enospc_immediate_loud_no_retry(self, tmp_path, monkeypatch):
+        import errno
+
+        from simtpu.durable import CheckpointError
+
+        ck = PlanCheckpoint(str(tmp_path / "ck"), kind="binary", fingerprint="fp")
+        calls = self._fail_replace(
+            monkeypatch, [errno.ENOSPC] * 10
+        )
+        with pytest.raises(CheckpointError, match="[Nn]o space left"):
+            ck.put("cand", 0, verdict=np.asarray(1))
+        # exactly ONE attempt: a full disk never retries
+        assert calls["n"] == 1
+
+    def test_persistent_transient_surfaces_one_line(self, tmp_path, monkeypatch):
+        import errno
+
+        from simtpu.durable import CheckpointError
+
+        ck = PlanCheckpoint(str(tmp_path / "ck"), kind="binary", fingerprint="fp")
+        calls = self._fail_replace(
+            monkeypatch, [errno.EINTR] * 10
+        )
+        with pytest.raises(CheckpointError, match="failed twice"):
+            ck.put("cand", 0, verdict=np.asarray(1))
+        assert calls["n"] == 2  # one retry, then the loud line
+        err_line = None
+        try:
+            ck.put("cand", 0, verdict=np.asarray(1))
+        except CheckpointError as exc:
+            err_line = str(exc)
+        assert err_line is not None and "\n" not in err_line
+
+    def test_non_transient_oserror_propagates_untouched(
+        self, tmp_path, monkeypatch
+    ):
+        import errno
+
+        ck = PlanCheckpoint(str(tmp_path / "ck"), kind="binary", fingerprint="fp")
+        calls = self._fail_replace(monkeypatch, [errno.EACCES])
+        with pytest.raises(OSError) as ei:
+            ck.put("cand", 0, verdict=np.asarray(1))
+        assert ei.value.errno == errno.EACCES
+        assert calls["n"] == 1  # no retry for non-transient classes
