@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all install lint test test-all test-perf bench bench-cold bench-faults bench-layout bench-durable bench-audit bench-obs bench-explain bench-multihost bench-serve fuzz-smoke clean
+.PHONY: all install lint test test-all test-perf bench bench-cold bench-faults bench-layout bench-durable bench-audit bench-obs bench-explain bench-multihost bench-serve bench-timeline fuzz-smoke clean
 
 all: test
 
@@ -166,6 +166,26 @@ bench-serve:
 	SIMTPU_BENCH_PLAN=0 SIMTPU_BENCH_BIG=0 SIMTPU_BENCH_FAULTS=0 \
 	SIMTPU_BENCH_LAYOUT=0 SIMTPU_BENCH_DURABLE=0 SIMTPU_BENCH_AUDIT=0 \
 	SIMTPU_BENCH_OBS=0 SIMTPU_BENCH_EXPLAIN=0 $(PY) bench.py
+
+# trace-driven timeline smoke (ISSUE 15, mirrors bench-serve): a seeded
+# small-shape arrival stream (gangs, CronJob firings, node events,
+# elastic HPA jobs) replayed through simtpu/timeline, ASSERTING the
+# batched path's end state (planes, placement log, landing vectors,
+# event timestamps) is bit-identical to the serial one-event-at-a-time
+# oracle, the auditor certified both, the sim clock is monotone, and the
+# timeline.* registry counters moved — timeline_events_per_s /
+# timeline_pending_p50_s / timeline_preemptions land in the JSON line
+bench-timeline:
+	SIMTPU_BENCH_TIMELINE=1 SIMTPU_BENCH_TIMELINE_ASSERT=1 \
+	SIMTPU_BENCH_TIMELINE_NODES=16 SIMTPU_BENCH_TIMELINE_PODS=360 \
+	SIMTPU_BENCH_TIMELINE_DAYS=0.2 \
+	SIMTPU_BENCH_NODES=500 SIMTPU_BENCH_PODS=2000 \
+	SIMTPU_BENCH_SCAN_PODS=200 SIMTPU_BENCH_BASELINE_PODS=50 \
+	SIMTPU_BENCH_SMALL=0 SIMTPU_BENCH_HARD=0 SIMTPU_BENCH_MATRIX=0 \
+	SIMTPU_BENCH_PLAN=0 SIMTPU_BENCH_BIG=0 SIMTPU_BENCH_FAULTS=0 \
+	SIMTPU_BENCH_LAYOUT=0 SIMTPU_BENCH_DURABLE=0 SIMTPU_BENCH_AUDIT=0 \
+	SIMTPU_BENCH_OBS=0 SIMTPU_BENCH_EXPLAIN=0 SIMTPU_BENCH_SERVE=0 \
+	$(PY) bench.py
 
 # differential fuzz over the fixed seed corpus at small shapes, across
 # the FULL engine-config matrix — 8 forced host devices arm the

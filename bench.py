@@ -71,7 +71,12 @@ ride every run's JSON line), SIMTPU_BENCH_SERVE=1/0 to force/skip the
 long-lived service smoke (tools/serve_loadgen.py against a real `simtpu
 serve` subprocess; serve_qps/serve_coalesce_ratio/serve_p99_s in the JSON
 line; `make bench-serve` = the asserting robustness-matrix smoke with
-SIMTPU_BENCH_SERVE_ASSERT=1).
+SIMTPU_BENCH_SERVE_ASSERT=1), SIMTPU_BENCH_TIMELINE=1/0 to force/skip the
+trace-driven continuous-time replay point (simtpu/timeline: a multi-day
+seeded arrival stream on SIMTPU_BENCH_TIMELINE_NODES, default 20k;
+timeline_events_per_s / timeline_pending_p50_s / timeline_preemptions in
+the JSON line; `make bench-timeline` = the small-shape smoke asserting
+batched == serial-oracle end state with SIMTPU_BENCH_TIMELINE_ASSERT=1).
 
 Byte telemetry rides every run: `fetch_bytes` (device→host payload of one
 warm placement, next to the `fetches` round-trip count),
@@ -1145,6 +1150,96 @@ def fault_point() -> dict:
     return out
 
 
+def timeline_point() -> dict:
+    """Trace-driven continuous-time replay point (ISSUE 15 acceptance):
+    a multi-day seeded Alibaba-shaped arrival stream (synth.make_trace —
+    Poisson-ish gang arrivals, lognormal durations, CronJob firings, node
+    maintenance windows) replayed on a 20k-node cluster through
+    `simtpu/timeline`, events/s as the headline.  Env:
+    SIMTPU_BENCH_TIMELINE_NODES (default 20000), _PODS (default 100000),
+    _DAYS (default 3).  SIMTPU_BENCH_TIMELINE_ASSERT=1 (the `make
+    bench-timeline` smoke) additionally replays the stream through the
+    serial one-event-at-a-time oracle and ASSERTS the batched end state
+    is bit-identical (planes, placement log, landing vectors, event
+    timestamps), the auditor certified both, the sim clock is monotone,
+    and the `timeline.*` registry counters moved."""
+    from simtpu.engine.state import diff_state_planes
+    from simtpu.obs.metrics import REGISTRY
+    from simtpu.synth import make_trace
+    from simtpu.timeline import ReplayOptions, replay_trace, trace_from_doc
+
+    n_nodes = int(os.environ.get("SIMTPU_BENCH_TIMELINE_NODES", 20_000))
+    n_pods = int(os.environ.get("SIMTPU_BENCH_TIMELINE_PODS", 100_000))
+    days = float(os.environ.get("SIMTPU_BENCH_TIMELINE_DAYS", 3.0))
+    do_assert = os.environ.get("SIMTPU_BENCH_TIMELINE_ASSERT", "") == "1"
+    note(
+        f"timeline point: {n_nodes} nodes, ~{n_pods} pods over {days:g} "
+        f"day(s){' (asserting smoke)' if do_assert else ''}"
+    )
+    doc = make_trace(
+        n_nodes, n_pods, seed=21, days=days, mean_gang=16,
+        cron_jobs=3, elastic_frac=0.1, node_event_frac=0.02,
+        duration_mean_s=4 * 3600.0,
+    )
+    before = REGISTRY.snapshot("timeline.")
+    res = replay_trace(
+        trace_from_doc(doc, source="<bench>"),
+        ReplayOptions(speculate=True, progress=note),
+    )
+    note(
+        f"timeline: {res.events} events at "
+        f"{res.timings['events_per_s']:.1f} events/s, "
+        f"pending p50={res.pending_p50_s:.1f}s, "
+        f"preemptions={res.counts['preemptions']}, "
+        f"audit ok={bool(res.audit and res.audit['ok'])}"
+    )
+    out = {
+        "timeline_nodes": n_nodes,
+        "timeline_days": days,
+        "timeline_events": res.events,
+        "timeline_events_per_s": round(res.timings["events_per_s"], 2),
+        "timeline_pending_p50_s": round(res.pending_p50_s, 3),
+        "timeline_pending_p90_s": round(res.pending_p90_s, 3),
+        "timeline_preemptions": res.counts["preemptions"],
+        "timeline_gang_rollbacks": res.counts["gang_rollbacks"],
+        "timeline_placed_pods": int((np.asarray(res.nodes) >= 0).sum()),
+        "timeline_util_avg": round(res.util_avg, 4),
+        "timeline_audit_ok": bool(res.audit and res.audit.get("ok")),
+    }
+    if do_assert:
+        assert res.audit and res.audit["ok"], "timeline audit dirty"
+        ts = [s[0] for s in res.samples]
+        assert ts == sorted(ts), "sim clock not monotone"
+        after = REGISTRY.snapshot("timeline.")
+        moved = [
+            k for k in ("timeline.events", "timeline.arrivals",
+                        "timeline.admitted", "timeline.attempts")
+            if after.get(k, 0) > before.get(k, 0)
+        ]
+        assert len(moved) == 4, f"timeline.* counters absent: {after}"
+        note("timeline smoke: replaying the serial one-event oracle")
+        oracle = replay_trace(
+            trace_from_doc(doc, source="<bench>"),
+            ReplayOptions(serial=True),
+        )
+        assert res.event_log == oracle.event_log, "event timelines differ"
+        assert np.array_equal(res.nodes, oracle.nodes), (
+            "final landing vectors differ"
+        )
+        assert list(res.engine.placed_node) == list(
+            oracle.engine.placed_node
+        ), "placement logs differ"
+        diffs = diff_state_planes(res.end_state(), oracle.end_state())
+        assert not diffs, f"end-state planes differ: {diffs}"
+        assert oracle.audit and oracle.audit["ok"], "oracle audit dirty"
+        out["timeline_serial_events_per_s"] = round(
+            oracle.timings["events_per_s"], 2
+        )
+        out["timeline_oracle_identical"] = True
+        note("timeline smoke: batched == serial oracle, audits clean")
+    return out
+
+
 def time_plan():
     """The min-node-add plan at north-star scale: a 100k-node cluster whose
     Open-Local capacity strands ~28k LVM pods of a 1M-pod selector-free mix,
@@ -1825,6 +1920,16 @@ def main() -> int:
         except Exception as exc:  # noqa: BLE001 - report, keep the line
             note(f"serve point failed: {type(exc).__name__}: {exc}")
             record["serve_error"] = f"{type(exc).__name__}: {exc}"
+    # trace-driven timeline replay (ISSUE 15): on by default at north-star
+    # runs, SIMTPU_BENCH_TIMELINE=1 forces it at any configuration (`make
+    # bench-timeline` = the small-shape asserting smoke), =0 skips
+    timeline_env = os.environ.get("SIMTPU_BENCH_TIMELINE", "")
+    if timeline_env != "0" and (north_star or timeline_env == "1"):
+        try:
+            record.update(timeline_point())
+        except Exception as exc:  # noqa: BLE001 - report, keep the line
+            note(f"timeline point failed: {type(exc).__name__}: {exc}")
+            record["timeline_error"] = f"{type(exc).__name__}: {exc}"
     # OOM-backoff telemetry (durable/backoff.py): process-lifetime
     # counters — nonzero only when a dispatch really hit
     # RESOURCE_EXHAUSTED (or the durable point injected one)
@@ -1843,7 +1948,7 @@ def main() -> int:
         for key in (
             "plan_error", "big_point_error", "fault_error", "layout_error",
             "durable_error", "audit_error", "obs_error", "explain_error",
-            "serve_error",
+            "serve_error", "timeline_error",
         )
     ) else 0
 

@@ -907,6 +907,184 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         return 1
 
 
+def cmd_replay(args: argparse.Namespace) -> int:
+    return _with_obs(args, lambda: _cmd_replay(args))
+
+
+def _cmd_replay(args: argparse.Namespace) -> int:
+    """Trace-driven continuous-time replay (simtpu/timeline,
+    docs/timeline.md).  The timeline package imports ONLY here — every
+    other subcommand runs with the replay-off cost provably zero, the
+    same contract as `simtpu serve`."""
+    import json
+
+    from .durable.deadline import RunControl
+    from .workloads.validate import SpecError
+
+    progress_stream = sys.stderr if args.json else sys.stdout
+
+    def progress(msg: str) -> None:
+        print(f"{C.COLOR_YELLOW}{msg}{C.COLOR_RESET}", file=progress_stream)
+
+    def fail_early(exc: Exception) -> int:
+        # malformed traces die as ONE structured line (the SpecError
+        # ingest contract, docs/robustness.md) — on stderr AND under
+        # --json's "message", never a traceback
+        if args.json:
+            print(json.dumps({"success": False, "message": str(exc)}))
+        print(exc, file=sys.stderr)
+        return 1
+
+    if bool(args.trace_file) == bool(args.synth):
+        return fail_early(
+            ValueError(
+                "exactly one input required: a TRACE file argument, or "
+                "--synth (seeded generated stream; --nodes/--pods/--days)"
+            )
+        )
+    try:
+        from .timeline import (
+            ReplayOptions,
+            load_trace,
+            replay_trace,
+            trace_from_doc,
+        )
+
+        if args.synth:
+            from .synth import make_trace
+
+            progress(
+                f"synthesizing trace: {args.nodes} nodes, ~{args.pods} "
+                f"pods over {args.days:g} day(s), seed {args.seed}"
+            )
+            doc = make_trace(
+                args.nodes, args.pods, seed=args.seed, days=args.days,
+                cron_jobs=args.cron_jobs,
+                elastic_frac=args.elastic_frac,
+                node_event_frac=args.node_event_frac,
+                autoscale_pool=args.autoscale_pool,
+            )
+            trace = trace_from_doc(doc, source="<synth>")
+        else:
+            trace = load_trace(args.trace_file)
+        progress(
+            f"replaying {len(trace.jobs)} job(s) over "
+            f"{trace.horizon_s / 86400:g} day(s) on "
+            f"{len(trace.cluster.nodes)} nodes"
+            + (" [serial oracle]" if args.serial else "")
+        )
+        control = RunControl(deadline=args.deadline)
+        opts = ReplayOptions(
+            serial=args.serial,
+            preempt=not args.no_preempt,
+            retry_backoff_s=args.retry_backoff,
+            max_retries=args.max_retries,
+            extended_resources=tuple(args.extended_resources or ()),
+            audit=args.audit,
+            control=control,
+            progress=progress,
+        )
+        with control.sigint():
+            res = replay_trace(trace, opts)
+        check_ok = None
+        if args.check and not res.partial:
+            # differential self-check: the serial one-event-at-a-time
+            # oracle must reproduce the batched end state bit-identically.
+            # The oracle gets its OWN control carrying the REMAINING
+            # deadline — reusing the expired-by-now first control would
+            # report a truncated oracle as a false divergence (exit 4)
+            # instead of the documented cooperative partial (exit 3)
+            progress("--check: replaying through the serial oracle")
+            if args.synth:
+                trace2 = trace_from_doc(doc, source="<synth>")
+            else:
+                trace2 = load_trace(args.trace_file)
+            check_control = RunControl(deadline=control.remaining())
+            with check_control.sigint():
+                oracle = replay_trace(
+                    trace2,
+                    ReplayOptions(
+                        serial=True,
+                        preempt=not args.no_preempt,
+                        retry_backoff_s=args.retry_backoff,
+                        max_retries=args.max_retries,
+                        extended_resources=tuple(
+                            args.extended_resources or ()
+                        ),
+                        audit=args.audit,
+                        control=check_control,
+                        progress=progress,
+                    ),
+                )
+            if oracle.partial:
+                # the check itself was interrupted: a partial oracle
+                # proves nothing — surface the cooperative partial
+                res.partial = True
+                res.message = f"--check {oracle.message}"
+            else:
+                from .engine.state import diff_state_planes
+
+                import numpy as np
+
+                check_ok = (
+                    res.event_log == oracle.event_log
+                    and np.array_equal(res.nodes, oracle.nodes)
+                    and list(res.engine.placed_node)
+                    == list(oracle.engine.placed_node)
+                    and not diff_state_planes(
+                        res.end_state(), oracle.end_state()
+                    )
+                )
+    except SpecError as exc:
+        return fail_early(exc)
+    except (ValueError, FileNotFoundError) as exc:
+        return fail_early(exc)
+    audit_bad = res.audit is not None and not res.audit.get("ok", True)
+    if args.json:
+        doc_out = res.counters()
+        doc_out["success"] = not res.partial and not audit_bad
+        doc_out["message"] = res.message
+        doc_out["timings"] = {
+            k: round(v, 3) for k, v in res.timings.items()
+        }
+        if res.audit is not None:
+            doc_out["audit"] = res.audit
+        if check_ok is not None:
+            doc_out["check"] = check_ok
+        print(json.dumps(doc_out))
+    else:
+        from .report import timeline_report
+
+        color = C.COLOR_RED if (res.partial or audit_bad) else C.COLOR_GREEN
+        print(color, end="")
+        print(timeline_report(res))
+        print(C.COLOR_RESET, end="")
+        if check_ok is not None:
+            verdict = (
+                f"{C.COLOR_GREEN}check: batched == serial oracle "
+                f"(bit-identical){C.COLOR_RESET}"
+                if check_ok
+                else f"{C.COLOR_RED}check: batched path DIVERGED from "
+                f"the serial oracle{C.COLOR_RESET}"
+            )
+            print(verdict)
+        if res.partial:
+            print(f"{C.COLOR_RED}{res.message}{C.COLOR_RESET}")
+    if res.partial:
+        # the cooperative partial-timeline contract: the processed event
+        # prefix is a consistent simulation, exit 3 (docs/robustness.md)
+        return _flight_exit(
+            EXIT_PARTIAL, "partial timeline (deadline/SIGINT)", args
+        )
+    if audit_bad or check_ok is False:
+        return _flight_exit(
+            EXIT_AUDIT,
+            "timeline end-state audit/divergence failure",
+            args,
+        )
+    return 0
+
+
 def cmd_version(args: argparse.Namespace) -> int:
     if getattr(args, "json", False):
         # downstream consumers of the --json metrics block detect layout
@@ -1463,6 +1641,119 @@ def build_parser() -> argparse.ArgumentParser:
     _add_audit_flags(serve_p)
     _add_obs_flags(serve_p)
     serve_p.set_defaults(func=cmd_serve)
+
+    replay_p = sub.add_parser(
+        "replay",
+        help="trace-driven continuous-time simulation: gang admission, "
+        "preemption, CronJob firings, node events, autoscaler emulation",
+        description="Continuous-time replay (simtpu/timeline, "
+        "docs/timeline.md): advance one engine through a time-ordered "
+        "event stream — pod-group arrivals with durations, CronJob "
+        "firings from real spec.schedule cron expressions, node down/up "
+        "events — via the batch delta apply/undo machinery, never "
+        "re-placing from scratch.  Gang admission is all-or-nothing "
+        "(partial placements roll back), failed gangs wait in a "
+        "priority-ordered pending queue with exponential retry/backoff, "
+        "arrivals may preempt strictly-lower-priority gangs, and an "
+        "HPA/template-node-pool autoscaler emulation scales replicas "
+        "off simulated utilization.  The input is a trace file, or "
+        "--synth for a seeded generated arrival stream.  The serial "
+        "one-event-at-a-time oracle (--serial / --check) is pinned "
+        "bit-identical to the batched path; the independent auditor "
+        "certifies the end state.",
+    )
+    replay_p.add_argument(
+        "trace_file", nargs="?", default="",
+        help="trace JSON file (docs/timeline.md has the format); "
+        "mutually exclusive with --synth",
+    )
+    replay_p.add_argument(
+        "--synth", action="store_true",
+        help="generate the trace instead of reading a file "
+        "(synth.make_trace: seeded Poisson-ish arrivals, lognormal "
+        "durations, gang sizes, CronJob mix)",
+    )
+    replay_p.add_argument(
+        "--nodes", type=int, default=100, metavar="N",
+        help="--synth cluster size (default 100)",
+    )
+    replay_p.add_argument(
+        "--pods", type=int, default=2000, metavar="N",
+        help="--synth total arriving pods (default 2000)",
+    )
+    replay_p.add_argument(
+        "--days", type=float, default=1.0, metavar="D",
+        help="--synth horizon in days (default 1)",
+    )
+    replay_p.add_argument(
+        "--seed", type=int, default=0, metavar="SEED",
+        help="--synth trace seed (default 0)",
+    )
+    replay_p.add_argument(
+        "--cron-jobs", type=int, default=2, metavar="N",
+        help="--synth CronJob count (default 2)",
+    )
+    replay_p.add_argument(
+        "--elastic-frac", type=float, default=0.0, metavar="F",
+        help="--synth fraction of HPA-scalable workloads (default 0)",
+    )
+    replay_p.add_argument(
+        "--node-event-frac", type=float, default=0.0, metavar="F",
+        help="--synth fraction of nodes with a maintenance down/up "
+        "window (default 0)",
+    )
+    replay_p.add_argument(
+        "--autoscale-pool", type=int, default=0, metavar="N",
+        help="--synth pre-provisioned template-node pool the autoscaler "
+        "arms under pending demand (default 0)",
+    )
+    replay_p.add_argument(
+        "--serial", action="store_true",
+        help="replay through the serial one-event-at-a-time oracle "
+        "(one pod per dispatch, dense carry, from-log state rebuilds) "
+        "instead of the batched path — the pinning baseline",
+    )
+    replay_p.add_argument(
+        "--check", action="store_true",
+        help="after the batched replay, re-replay through the serial "
+        "oracle and verify the end state is bit-identical (a divergence "
+        f"exits {EXIT_AUDIT})",
+    )
+    replay_p.add_argument(
+        "--no-preempt", action="store_true",
+        help="disable preemption on gang arrival (failed arrivals only "
+        "wait in the pending queue)",
+    )
+    replay_p.add_argument(
+        "--retry-backoff", type=float, default=30.0, metavar="SECONDS",
+        help="pending-queue retry backoff base; attempt k waits "
+        "base*2^(k-1) (default 30)",
+    )
+    replay_p.add_argument(
+        "--max-retries", type=int, default=8, metavar="N",
+        help="admission attempts per job before the remainder is "
+        "dropped (default 8)",
+    )
+    replay_p.add_argument(
+        "-e", "--extended-resources", nargs="*",
+        choices=["open-local", "gpu"],
+        help="extended resources to model (open-local, gpu)",
+    )
+    replay_p.add_argument(
+        "--deadline", type=float, default=None, metavar="SECONDS",
+        help="wall-clock budget; on expiry (or first ^C/SIGTERM) the "
+        "replay stops cooperatively at an event boundary and exits "
+        f"{EXIT_PARTIAL} with the consistent partial timeline",
+    )
+    replay_p.add_argument(
+        "--json", action="store_true",
+        help="print machine-readable replay counters (events, "
+        "events_per_s, pending_p50_s, preemptions, audit verdict) "
+        "instead of the report tables",
+    )
+    _add_audit_flags(replay_p)
+    _add_obs_flags(replay_p)
+    replay_p.set_defaults(func=cmd_replay)
 
     ver_p = sub.add_parser("version", help="print version")
     ver_p.add_argument(

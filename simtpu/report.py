@@ -343,6 +343,86 @@ def explain_report(doc: dict) -> str:
     return "\n".join(out)
 
 
+def timeline_report(res, buckets: int = 12) -> str:
+    """The `simtpu replay` tables (`timeline.replay.TimelineResult`):
+    a bucketed utilization/pending time series, the admission/preemption
+    tally, and the pending-time distribution — the continuous-time
+    answers the one-shot report cannot give (docs/timeline.md)."""
+
+    def dur(seconds: float) -> str:
+        seconds = float(seconds)
+        if seconds >= 5400:
+            return f"{seconds / 3600:.1f}h"
+        if seconds >= 120:
+            return f"{seconds / 60:.1f}m"
+        return f"{seconds:.0f}s"
+
+    out = ["Timeline"]
+    samples = res.samples
+    if samples:
+        # bucket the per-event samples into ~`buckets` rows, reporting
+        # each bucket's LAST state (a level, not a flow) and peak pending
+        step = max(len(samples) // buckets, 1)
+        rows = []
+        for b0 in range(0, len(samples), step):
+            chunk = samples[b0: b0 + step]
+            t, util, placed, _pending = chunk[-1]
+            peak_pending = max(s[3] for s in chunk)
+            rows.append(
+                [dur(t), f"{util * 100:.1f}%", str(placed),
+                 str(peak_pending)]
+            )
+        out.append(
+            render_table(
+                ["Sim Clock", "Utilization", "Placed Pods", "Peak Pending"],
+                rows,
+                merge_col0=False,
+            )
+        )
+    c = res.counts
+    out.append("\nAdmission")
+    out.append(
+        render_table(
+            ["Counter", "Value"],
+            [
+                ["events", str(res.events)],
+                ["arrivals (cron fires)",
+                 f"{c['arrivals']} ({c['cron_fires']})"],
+                ["gang admissions", str(c["admitted"])],
+                ["gang rollbacks (all-or-nothing)",
+                 str(c["gang_rollbacks"])],
+                ["retries / dropped pods",
+                 f"{c['retries']} / {c['dropped_pods']}"],
+                ["preemptions (pods)",
+                 f"{c['preemptions']} ({c['preempted_pods']})"],
+                ["departures", str(c["departures"])],
+                ["node down / up", f"{c['node_down']} / {c['node_up']}"],
+                ["HPA scale up / down pods",
+                 f"{c['scale_up_pods']} / {c['scale_down_pods']}"],
+                ["pool nodes armed / disarmed",
+                 f"{c['pool_up']} / {c['pool_down']}"],
+            ],
+            merge_col0=False,
+        )
+    )
+    if res.pending_s:
+        out.append("\nPending Time")
+        out.append(
+            render_table(
+                ["P50", "P90", "Max", "Still Pending At End"],
+                [[dur(res.pending_p50_s), dur(res.pending_p90_s),
+                  dur(max(res.pending_s)), str(res.still_pending)]],
+                merge_col0=False,
+            )
+        )
+    rate = res.timings.get("events_per_s", 0.0)
+    out.append(
+        f"{res.events} event(s) replayed ({rate:.1f} events/s"
+        + (", PARTIAL — interrupted)" if res.partial else ")")
+    )
+    return "\n".join(out)
+
+
 def contain_local_storage(extended: Sequence[str]) -> bool:
     return "open-local" in extended
 

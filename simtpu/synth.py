@@ -336,3 +336,183 @@ def synth_apps(
         d += 1
     apps.append(AppResource(name="synthetic", resource=resources))
     return apps
+
+
+def make_trace(
+    n_nodes: int,
+    n_pods: int,
+    seed: int = 0,
+    days: float = 1.0,
+    zones: int = 8,
+    mean_gang: int = 8,
+    duration_mean_s: float = 3600.0,
+    duration_sigma: float = 0.8,
+    priority_classes: Tuple[int, ...] = (0, 10, 100),
+    priority_weights: Tuple[float, ...] = (0.7, 0.2, 0.1),
+    cron_jobs: int = 2,
+    elastic_frac: float = 0.0,
+    node_event_frac: float = 0.0,
+    forever_frac: float = 0.05,
+    autoscale_pool: int = 0,
+    autoscale_interval_s: float = 1800.0,
+    autoscale_target_util: float = 0.6,
+    selector_frac: float = 0.1,
+    anti_affinity_frac: float = 0.15,
+) -> dict:
+    """A seeded Alibaba-shaped arrival trace for `simtpu replay`
+    (timeline/events.py `trace_from_doc` consumes the returned document;
+    `json.dumps` of it is a valid trace file).
+
+    Shape: Poisson-ish gang arrivals (exponential inter-arrival gaps)
+    over a `days`-long horizon, lognormal service durations, geometric-ish
+    gang sizes around `mean_gang`, a priority-class mix, `cron_jobs`
+    CronJob entries firing real cron schedules, and (opt-in) elastic
+    HPA-scalable workloads, node maintenance windows, and a template-node
+    autoscaler pool.
+
+    Determinism: every choice derives from `seed` via one Generator, and
+    optional features draw ONLY when enabled (the same append-only RNG
+    discipline as `synth_cluster`'s rack labels) — enabling a new knob
+    never perturbs the arrival stream an existing seed already pinned.
+    Workload constraint mixes stay soft (preferred anti-affinity, node
+    selectors): admission pressure comes from capacity, which keeps the
+    end-state audit exact under out-of-order admissions
+    (docs/timeline.md §determinism).
+    """
+    rng = np.random.default_rng(seed)
+    horizon = float(days) * 86400.0
+    jobs = []
+    t = 0.0
+    made = 0
+    est_gangs = max(n_pods // max(mean_gang, 1), 1)
+    mean_gap = horizon * 0.8 / est_gangs
+    j = 0
+    while made < n_pods:
+        t += float(rng.exponential(mean_gap))
+        if t >= horizon:
+            break
+        size = int(min(1 + rng.geometric(1.0 / max(mean_gang, 1)),
+                       4 * mean_gang, n_pods - made))
+        dur = float(rng.lognormal(np.log(duration_mean_s), duration_sigma))
+        prio = int(rng.choice(priority_classes, p=priority_weights))
+        kw = {}
+        if rng.random() < selector_frac:
+            kw["node_selector"] = {
+                "topology.kubernetes.io/zone": f"zone-{int(rng.integers(zones))}"
+            }
+        if rng.random() < anti_affinity_frac:
+            kw["anti_affinity_topo"] = "kubernetes.io/hostname"
+        dep = make_deployment(
+            f"tj-{j:05d}",
+            size,
+            int(rng.choice([250, 500, 1000, 2000])),
+            int(rng.choice([256, 512, 1024, 4096])),
+            priority=prio,
+            **kw,
+        )
+        job = {
+            "name": f"tj-{j:05d}",
+            "t_s": round(t, 3),
+            "priority": prio,
+            "workload": dep,
+        }
+        if rng.random() >= forever_frac:
+            job["duration_s"] = round(max(dur, 60.0), 3)
+        # draw only when enabled: pre-existing seeds' streams (and the
+        # replays pinned to them) are unchanged when the knob is off
+        if elastic_frac and rng.random() < elastic_frac:
+            lo = max(1, size // 2)
+            hi = min(2 * size, 4 * mean_gang)
+            usage = [
+                [0.0, round(float(rng.uniform(0.3, 0.5)), 3)],
+                [round(horizon * 0.3, 3), round(float(rng.uniform(0.7, 0.95)), 3)],
+                [round(horizon * 0.7, 3), round(float(rng.uniform(0.35, 0.6)), 3)],
+            ]
+            job["elastic"] = {"min": lo, "max": hi, "usage": usage}
+        jobs.append(job)
+        made += size
+        j += 1
+
+    crons = []
+    for c in range(int(cron_jobs)):
+        expr = str(rng.choice(
+            ["*/15 * * * *", "0 * * * *", "30 */2 * * *", "0 */6 * * *"]
+        ))
+        completions = int(rng.integers(1, max(mean_gang // 2, 2)))
+        cj = {
+            "apiVersion": "batch/v1",
+            "kind": "CronJob",
+            "metadata": {"name": f"cron-{c:03d}", "namespace": "bench"},
+            "spec": {
+                "schedule": expr,
+                "jobTemplate": {
+                    "spec": {
+                        "completions": completions,
+                        "template": {
+                            "spec": {
+                                "containers": [
+                                    {
+                                        "name": "c",
+                                        "image": "app",
+                                        "resources": {
+                                            "requests": {
+                                                "cpu": f"{int(rng.choice([250, 500]))}m",
+                                                "memory": f"{int(rng.choice([256, 512]))}Mi",
+                                            }
+                                        },
+                                    }
+                                ]
+                            }
+                        },
+                    }
+                },
+            },
+        }
+        crons.append(
+            {
+                "cron_job": cj,
+                "duration_s": round(float(rng.uniform(300.0, 1200.0)), 3),
+                "priority": 0,
+            }
+        )
+
+    node_events = []
+    if node_event_frac:
+        k = max(1, int(node_event_frac * n_nodes))
+        victims = rng.choice(n_nodes, size=min(k, n_nodes), replace=False)
+        for v in sorted(int(x) for x in victims):
+            t_down = float(rng.uniform(0.1, 0.7)) * horizon
+            window = float(rng.lognormal(np.log(3600.0), 0.5))
+            name = f"node-{v:06d}"
+            node_events.append(
+                {"t_s": round(t_down, 3), "down": [name]}
+            )
+            t_up = t_down + max(window, 300.0)
+            if t_up < horizon:
+                node_events.append({"t_s": round(t_up, 3), "up": [name]})
+
+    doc = {
+        "version": 1,
+        "seed": int(seed),
+        "horizon_s": horizon,
+        "cluster": {
+            "synth": {"n_nodes": int(n_nodes), "seed": int(seed),
+                      "zones": int(zones)}
+        },
+        "jobs": jobs,
+        "cron_jobs": crons,
+        "node_events": node_events,
+    }
+    if autoscale_pool:
+        doc["autoscale"] = {
+            "interval_s": float(autoscale_interval_s),
+            "target_util": float(autoscale_target_util),
+            "pool": int(autoscale_pool),
+            "node": make_node(
+                "timeline-pool-template",
+                32000,
+                128,
+                labels={"topology.kubernetes.io/zone": "zone-0"},
+            ),
+        }
+    return doc

@@ -1,0 +1,37 @@
+"""`simtpu replay` — trace-driven continuous-time simulation engine.
+
+Event model and trace loading live in `timeline/events.py`, the replay
+loop (gang admission, pending queue, preemption, node events, the serial
+oracle) in `timeline/replay.py`, the HPA/pool autoscaler emulation in
+`timeline/autoscale.py`.  See docs/timeline.md.
+"""
+
+from .events import (
+    AutoscaleSpec,
+    NodeEvent,
+    TRACE_VERSION,
+    Trace,
+    TraceJob,
+    load_trace,
+    trace_from_doc,
+)
+from .replay import (
+    ReplayOptions,
+    TIMELINE_KEYS,
+    TimelineResult,
+    replay_trace,
+)
+
+__all__ = [
+    "AutoscaleSpec",
+    "NodeEvent",
+    "ReplayOptions",
+    "TIMELINE_KEYS",
+    "TRACE_VERSION",
+    "TimelineResult",
+    "Trace",
+    "TraceJob",
+    "load_trace",
+    "replay_trace",
+    "trace_from_doc",
+]

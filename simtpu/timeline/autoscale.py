@@ -1,0 +1,139 @@
+"""HPA + cluster-pool autoscaler emulation for the replay loop.
+
+Two scalers run on the periodic `EVT_AUTOSCALE` tick (interval from the
+trace's `autoscale` block):
+
+**HPA replica scaling.**  Every active elastic job carries a simulated
+utilization signal — `usage` is the fraction of each replica's REQUEST
+the replica actually consumes, a scalar or a `[[t_s, frac], ...]` step
+function (diurnal shapes) — and the controller applies the standard HPA
+formula `desired = ceil(current * usage / target_util)` clamped into
+`[min, max]`.  Scale-ups admit reserve rows (the elastic expansion
+pre-tensorized `max` replicas, so the vocabulary never grows mid-replay)
+per-replica best-effort; scale-downs evict the youngest replicas through
+the delta undo, releasing capacity for the pending queue.
+
+**Template-node pool.**  `autoscale.pool` pre-provisions that many
+clones of `autoscale.node` at tensorize time, DISABLED via the engine's
+`node_valid` lever (the faults mask).  The tick arms one pool node per
+interval while admission demand is visibly starved (a non-empty pending
+queue), and disarms the highest empty pool node when utilization sits
+below half the HPA target — capacity-planner-shaped grow/shrink without
+ever re-tensorizing (growing the node axis would invalidate the carried
+state; docs/timeline.md states the trade).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def usage_at(elastic: dict, t: float) -> float:
+    """The job's simulated utilization-of-request at sim time `t`
+    (scalar, or the last step of a `[[t_s, frac], ...]` breakpoint
+    list at or before `t`; before the first breakpoint the first
+    value holds)."""
+    usage = elastic.get("usage", 0.6)
+    if isinstance(usage, (int, float)):
+        return float(usage)
+    if not usage:
+        return 0.6
+    out = float(usage[0][1])
+    for t_b, frac in usage:
+        if float(t_b) <= t:
+            out = float(frac)
+        else:
+            break
+    return out
+
+
+def desired_replicas(current: int, usage: float, target: float,
+                     lo: int, hi: int) -> int:
+    """The HPA formula: ceil(current * usage / target), clamped."""
+    if current <= 0:
+        current = max(lo, 1)
+    want = math.ceil(current * usage / max(target, 1e-9))
+    return max(lo, min(int(want), hi))
+
+
+def autoscale_tick(rt, auto, t: float) -> bool:
+    """One autoscaler evaluation on the replay runtime `rt`
+    (timeline/replay.py `_Replay`).  Returns True when capacity was
+    released (scale-down or pool-up), so the event loop runs its
+    end-of-timestamp pending retry pass."""
+    rt._bump("autoscale_checks")
+    released = False
+
+    # -- HPA replica scaling over the active elastic jobs ----------------
+    for st in rt.jobs:
+        if st.job.elastic is None or st.status not in ("active", "pending"):
+            continue
+        el = st.job.elastic
+        current = st.placed_count
+        if current <= 0:
+            continue
+        want = desired_replicas(
+            current, usage_at(el, t), auto.target_util, el["min"], el["max"]
+        )
+        want = min(want, len(st.rows))
+        if want > st.want:
+            st.want = want
+            placed = rt._try_admit_elastic(st, t)
+            rt._bump("scale_up_pods", placed)
+            if st.needs > 0 and st.status == "active":
+                # the missing replicas wait like any pending job
+                st.status = "pending"
+        elif want < current:
+            # evict the youngest replicas (highest rows) via the delta
+            # undo; scale-to-zero is out of scope, so one replica stays
+            want = max(want, 1)
+            drop = current - want
+            if drop <= 0:
+                continue
+            placed_rows = st.rows[st.placed]
+            victims = placed_rows[-drop:]
+            entries = np.flatnonzero(
+                (rt.log_jid == st.jid) & np.isin(rt.log_row, victims)
+            )
+            # partial eviction of a run that stays alive: the job's
+            # scheduled departure must remain valid (bump_epoch=False —
+            # a bumped epoch would make the surviving replicas immortal)
+            rt._evict_job(st, entries, bump_epoch=False)
+            st.want = want
+            rt._bump("scale_down_pods", int(drop))
+            released = True
+            if st.status == "pending" and st.needs <= 0:
+                st.status = "active"
+
+    # -- template-node pool ----------------------------------------------
+    if rt.pool_rows:
+        pending = sum(
+            st.needs
+            for st in rt.jobs
+            if st.status == "pending" and st.needs > 0
+        )
+        disabled = [i for i in rt.pool_rows if not rt.valid[i]]
+        if pending > 0 and disabled:
+            # arm ONE node per tick: grow at the autoscaler's cadence,
+            # the way real cluster autoscalers rate-limit scale-out
+            rt.valid[disabled[0]] = True
+            rt.eng.node_valid = rt.valid.copy()
+            rt._bump("pool_up")
+            released = True
+        elif pending == 0:
+            cap = float(rt.alloc_cpu[rt.valid].sum())
+            util = rt.used_cpu / cap if cap > 0 else 0.0
+            if util < auto.target_util * 0.5:
+                enabled = [i for i in rt.pool_rows if rt.valid[i]]
+                if enabled:
+                    log_nodes = np.asarray(rt.eng.placed_node, np.int64)
+                    empty = [
+                        i for i in enabled if not (log_nodes == i).any()
+                    ]
+                    if empty:
+                        rt.valid[empty[-1]] = False
+                        rt.eng.node_valid = rt.valid.copy()
+                        rt._bump("pool_down")
+    return released

@@ -1,0 +1,419 @@
+"""Trace model + event stream for `simtpu replay` (docs/timeline.md).
+
+A TRACE is the replay engine's input: a cluster, a time-ordered stream of
+workload arrivals (each with a duration and a priority), CronJob objects
+whose real `spec.schedule` cron expressions generate firings, and node
+up/down events from the faults scenario model's vocabulary.  Traces load
+from a JSON file (`load_trace`) or assemble in memory
+(`synth.make_trace` → `trace_from_doc`); malformed input raises the same
+one-line `SpecError` diagnostics as manifest ingest, carrying the
+offending event index (and the source line for syntax errors).
+
+Determinism contract (the serial-oracle pinning rests on it):
+- events sort by `(t, rank, seq)` where rank orders kinds within one
+  timestamp — departures first (capacity settles), then node up, node
+  down, arrivals, retries, autoscaler checks — and `seq` is the stable
+  input order;
+- cron firings enumerate through the SHARED parser
+  (`workloads/cron.py`), epoch-anchored UTC, so the static expansion
+  path and the replay agree on what a schedule means;
+- pod-name suffixes draw from a stream seeded off the trace seed
+  (`expand.seed_name_hashes`), so two replays of one trace expand
+  identical pods.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .. import constants as C
+from ..core.objects import ResourceTypes, name_of
+from ..workloads.cron import cron_job_schedule, cron_job_suspended, fire_times
+from ..workloads.expand import (
+    generate_job_from_cron_job,
+    make_valid_pod_by_pod,
+    make_valid_pods_by_deployment,
+    make_valid_pods_by_job,
+    make_valid_pods_by_replica_set,
+    make_valid_pods_by_replication_controller,
+    make_valid_pods_by_stateful_set,
+    spec_context,
+)
+from ..workloads.validate import SpecError
+
+#: trace document version `load_trace` accepts
+TRACE_VERSION = 1
+
+# -- event ranks: the within-timestamp processing order ----------------------
+# Capacity-releasing events settle before capacity-consuming ones at the
+# same instant; pending-queue retries run at the END of each timestamp
+# (after every event at that t), which is what makes the batched path's
+# same-timestamp departure coalescing semantics-identical to the serial
+# oracle's one-at-a-time processing.
+EVT_DEPART = 0
+EVT_NODE_UP = 1
+EVT_NODE_DOWN = 2
+EVT_ARRIVE = 3
+EVT_RETRY = 4
+EVT_AUTOSCALE = 5
+
+RANK_NAMES = {
+    EVT_DEPART: "depart",
+    EVT_NODE_UP: "node_up",
+    EVT_NODE_DOWN: "node_down",
+    EVT_ARRIVE: "arrive",
+    EVT_RETRY: "retry",
+    EVT_AUTOSCALE: "autoscale",
+}
+
+#: workload-kind → pod expander, the trace-side mirror of
+#: `expand.get_valid_pods_exclude_daemonset`'s table (DaemonSets are
+#: cluster-shaped, not arrival-shaped, and deliberately absent)
+_EXPANDERS = {
+    "Pod": lambda w: [make_valid_pod_by_pod(w)],
+    C.KIND_DEPLOYMENT: make_valid_pods_by_deployment,
+    C.KIND_RS: make_valid_pods_by_replica_set,
+    C.KIND_RC: make_valid_pods_by_replication_controller,
+    C.KIND_STS: make_valid_pods_by_stateful_set,
+    C.KIND_JOB: make_valid_pods_by_job,
+}
+
+
+@dataclass
+class TraceJob:
+    """One arriving workload: a gang (all-or-nothing) or an elastic
+    (per-replica, HPA-scalable) pod group."""
+
+    seq: int  # stable arrival order (tie-break within a timestamp)
+    name: str
+    t_s: float  # arrival time, seconds of sim clock
+    duration_s: Optional[float]  # None = runs forever once admitted
+    workload: dict  # Deployment / Job / ... manifest (single workload)
+    priority: int = 0
+    gang: bool = True
+    #: {"min": int, "max": int, "usage": float | [[t_s, frac], ...]} —
+    #: HPA-scalable; elastic jobs are per-replica (gang=False enforced)
+    elastic: Optional[dict] = None
+    source: str = ""  # provenance for diagnostics ("jobs[3]", "cron ...")
+
+
+@dataclass
+class NodeEvent:
+    t_s: float
+    kind: str  # "down" | "up"
+    nodes: List[str]  # node names (the faults scenario vocabulary)
+
+
+@dataclass
+class AutoscaleSpec:
+    """HPA + cluster-pool emulation knobs (timeline/autoscale.py)."""
+
+    interval_s: float = 300.0
+    target_util: float = 0.6  # HPA target utilization of requests
+    pool: int = 0  # pre-provisioned template nodes the pool scaler arms
+    node: Optional[dict] = None  # pool node template (required when pool>0)
+
+
+@dataclass
+class Trace:
+    cluster: ResourceTypes
+    jobs: List[TraceJob]
+    node_events: List[NodeEvent] = field(default_factory=list)
+    horizon_s: float = 86400.0
+    seed: int = 0
+    autoscale: Optional[AutoscaleSpec] = None
+    source: str = "<in-memory>"
+
+
+def _want(doc: dict, key: str, types, where: str, default="__required__"):
+    """One validated field of a trace document — SpecError names the
+    offending entry (`where` is e.g. `jobs[3]`) and the field."""
+    if key not in doc:
+        if default != "__required__":
+            return default
+        raise SpecError("missing required field", field=f"{where}.{key}")
+    val = doc[key]
+    if types is not None and not isinstance(val, types):
+        raise SpecError(
+            f"expected {'/'.join(t.__name__ for t in types)}, "
+            f"got {type(val).__name__}",
+            field=f"{where}.{key}",
+        )
+    return val
+
+
+def _number(doc, key, where, default="__required__", minimum=None):
+    v = _want(doc, key, (int, float), where, default)
+    if v is not None and minimum is not None and v < minimum:
+        raise SpecError(f"must be >= {minimum}", field=f"{where}.{key}")
+    return v
+
+
+def trace_from_doc(doc: dict, source: str = "<in-memory>") -> Trace:
+    """Validate one trace document into a `Trace`, expanding CronJob
+    firings into dated arrival jobs through the shared cron parser."""
+    if not isinstance(doc, dict):
+        raise SpecError("trace document must be a JSON object", source=source)
+    try:
+        version = _want(doc, "version", (int,), "trace", TRACE_VERSION)
+        if version != TRACE_VERSION:
+            raise SpecError(
+                f"unsupported trace version {version} "
+                f"(this build reads {TRACE_VERSION})",
+                field="trace.version",
+            )
+        horizon = _number(doc, "horizon_s", "trace", 86400.0, minimum=1.0)
+        seed = int(_number(doc, "seed", "trace", 0))
+        # cron firings instantiate Job objects below, whose generated
+        # name suffixes draw from the expansion name stream — seed it
+        # here so two parses of one trace (the batched run and the
+        # serial oracle) produce byte-identical firing workloads
+        from ..workloads.expand import seed_name_hashes
+
+        seed_name_hashes(0x7ACE_C0DE ^ seed)
+        cluster = _cluster_from(doc.get("cluster"), source)
+
+        jobs: List[TraceJob] = []
+        for i, jd in enumerate(_want(doc, "jobs", (list,), "trace", [])):
+            where = f"jobs[{i}]"
+            if not isinstance(jd, dict):
+                raise SpecError("event must be an object", field=where)
+            jobs.append(_job_from(jd, len(jobs), where))
+
+        for i, cd in enumerate(_want(doc, "cron_jobs", (list,), "trace", [])):
+            where = f"cron_jobs[{i}]"
+            if not isinstance(cd, dict):
+                raise SpecError("entry must be an object", field=where)
+            jobs.extend(_cron_arrivals(cd, horizon, len(jobs), where))
+
+        node_events: List[NodeEvent] = []
+        for i, nd in enumerate(
+            _want(doc, "node_events", (list,), "trace", [])
+        ):
+            where = f"node_events[{i}]"
+            if not isinstance(nd, dict):
+                raise SpecError("event must be an object", field=where)
+            t = float(_number(nd, "t_s", where, minimum=0.0))
+            down = _want(nd, "down", (list,), where, None)
+            up = _want(nd, "up", (list,), where, None)
+            if (down is None) == (up is None):
+                raise SpecError(
+                    "exactly one of 'down'/'up' (a node-name list) required",
+                    field=where,
+                )
+            kind = "down" if down is not None else "up"
+            names = [str(x) for x in (down if down is not None else up)]
+            if not names:
+                raise SpecError("empty node list", field=f"{where}.{kind}")
+            node_events.append(NodeEvent(t_s=t, kind=kind, nodes=names))
+
+        autoscale = None
+        ad = _want(doc, "autoscale", (dict,), "trace", None)
+        if ad is not None:
+            autoscale = AutoscaleSpec(
+                interval_s=float(
+                    _number(ad, "interval_s", "autoscale", 300.0, minimum=1.0)
+                ),
+                target_util=float(
+                    _number(ad, "target_util", "autoscale", 0.6, minimum=0.01)
+                ),
+                pool=int(_number(ad, "pool", "autoscale", 0, minimum=0)),
+                node=_want(ad, "node", (dict,), "autoscale", None),
+            )
+            if autoscale.pool and autoscale.node is None:
+                raise SpecError(
+                    "autoscale.pool > 0 requires autoscale.node "
+                    "(the template the pool nodes clone)",
+                    field="autoscale.pool",
+                )
+    except SpecError as exc:
+        raise exc.attach(source=source)
+    return Trace(
+        cluster=cluster,
+        jobs=jobs,
+        node_events=node_events,
+        horizon_s=float(horizon),
+        seed=seed,
+        autoscale=autoscale,
+        source=source,
+    )
+
+
+def _cluster_from(cd, source: str) -> ResourceTypes:
+    if not isinstance(cd, dict):
+        raise SpecError(
+            "trace.cluster required: {'nodes': [...]} or "
+            "{'synth': {n_nodes, seed, ...}}",
+            field="trace.cluster",
+        )
+    if "synth" in cd:
+        from ..synth import synth_cluster
+
+        params = cd["synth"]
+        if not isinstance(params, dict) or "n_nodes" not in params:
+            raise SpecError(
+                "cluster.synth must be an object with n_nodes",
+                field="trace.cluster.synth",
+            )
+        try:
+            return synth_cluster(**{str(k): v for k, v in params.items()})
+        except TypeError as exc:
+            raise SpecError(str(exc), field="trace.cluster.synth")
+    nodes = cd.get("nodes")
+    if not isinstance(nodes, list) or not nodes:
+        raise SpecError(
+            "cluster.nodes must be a non-empty node list",
+            field="trace.cluster.nodes",
+        )
+    res = ResourceTypes()
+    res.nodes = list(nodes)
+    scs = cd.get("storage_classes")
+    if scs:
+        res.storage_classes = list(scs)
+    return res
+
+
+def _job_from(jd: dict, seq: int, where: str) -> TraceJob:
+    workload = _want(jd, "workload", (dict,), where)
+    kind = workload.get("kind")
+    if kind not in _EXPANDERS:
+        raise SpecError(
+            f"unsupported workload kind {kind!r} "
+            f"(one of {sorted(_EXPANDERS)})",
+            field=f"{where}.workload.kind",
+        )
+    t = float(_number(jd, "t_s", where, minimum=0.0))
+    dur = _number(jd, "duration_s", where, None)
+    if dur is not None:
+        dur = float(dur)
+        if dur <= 0:
+            raise SpecError("must be > 0 (omit for forever)",
+                            field=f"{where}.duration_s")
+    gang = bool(_want(jd, "gang", (bool,), where, True))
+    elastic = _want(jd, "elastic", (dict,), where, None)
+    if elastic is not None:
+        if gang and "gang" in jd:
+            raise SpecError(
+                "elastic jobs are per-replica (gang admission and HPA "
+                "scaling are mutually exclusive)",
+                field=f"{where}.gang",
+            )
+        gang = False
+        lo = int(_number(elastic, "min", f"{where}.elastic", 1, minimum=0))
+        hi = int(_number(elastic, "max", f"{where}.elastic", minimum=1))
+        if hi < max(lo, 1):
+            raise SpecError("max < min", field=f"{where}.elastic.max")
+        usage = elastic.get("usage", 0.6)
+        if not isinstance(usage, (int, float, list)):
+            raise SpecError(
+                "usage must be a fraction or [[t_s, fraction], ...]",
+                field=f"{where}.elastic.usage",
+            )
+        elastic = {"min": lo, "max": hi, "usage": usage}
+    return TraceJob(
+        seq=seq,
+        name=str(jd.get("name") or name_of(workload) or f"job-{seq}"),
+        t_s=t,
+        duration_s=dur,
+        workload=workload,
+        priority=int(_number(jd, "priority", where, 0)),
+        gang=gang,
+        elastic=elastic,
+        source=where,
+    )
+
+
+def _cron_arrivals(
+    cd: dict, horizon: float, seq0: int, where: str
+) -> List[TraceJob]:
+    """CronJob entry → one arrival job per firing of its real
+    `spec.schedule` within `[0, horizon]` (shared parser; suspend and
+    startingDeadlineSeconds honored; deadline-late fires admit at 0)."""
+    cj = _want(cd, "cron_job", (dict,), where)
+    if (cj.get("kind") or "CronJob") != C.KIND_CRON_JOB:
+        raise SpecError(
+            f"cron_job entry must be a CronJob, got {cj.get('kind')!r}",
+            field=f"{where}.cron_job.kind",
+        )
+    dur = _number(cd, "duration_s", where, None)
+    if dur is not None and float(dur) <= 0:
+        raise SpecError("must be > 0 (omit for forever)",
+                        field=f"{where}.duration_s")
+    prio = int(_number(cd, "priority", where, 0))
+    with spec_context(C.KIND_CRON_JOB, cj):
+        if cron_job_suspended(cj):
+            return []
+        sched = cron_job_schedule(cj)
+    deadline = (cj.get("spec") or {}).get("startingDeadlineSeconds")
+    fires = fire_times(
+        sched, 0.0, float(horizon),
+        starting_deadline_s=float(deadline) if deadline is not None else None,
+    )
+    out = []
+    for k, fire in enumerate(fires):
+        with spec_context(C.KIND_CRON_JOB, cj):
+            job = generate_job_from_cron_job(cj)
+        out.append(
+            TraceJob(
+                seq=seq0 + k,
+                name=name_of(job),
+                # a deadline-late fire (< 0 on the sim clock) admits at
+                # the window start, mirroring the controller's catch-up
+                t_s=max(float(fire), 0.0),
+                duration_s=float(dur) if dur is not None else None,
+                workload=job,
+                priority=prio,
+                gang=True,
+                elastic=None,
+                source=f"{where}@{fire:g}s",
+            )
+        )
+    return out
+
+
+def load_trace(path: str) -> Trace:
+    """Parse + validate one trace file.  Syntax errors carry the source
+    line; semantic errors carry the offending event index — both as ONE
+    actionable `SpecError` line (docs/robustness.md)."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as exc:
+        raise SpecError(f"cannot read trace file: {exc}", source=path)
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError as exc:
+        raise SpecError(
+            f"malformed JSON: {exc.msg}", source=f"{path}:{exc.lineno}"
+        )
+    return trace_from_doc(doc, source=path)
+
+
+def expand_job_pods(job: TraceJob) -> List[dict]:
+    """The pods one arriving job schedules, through the SAME expansion
+    path as static ingest (`workloads/expand.py`); elastic jobs expand
+    their `max` replicas (rows beyond the initial target are the HPA
+    scale-up reserve)."""
+    workload = job.workload
+    if job.elastic is not None:
+        workload = dict(workload)
+        workload["spec"] = dict(workload.get("spec") or {})
+        field_name = "completions" if workload.get("kind") == C.KIND_JOB else "replicas"
+        workload["spec"][field_name] = int(job.elastic["max"])
+    with spec_context(workload.get("kind", "workload"), workload):
+        return _EXPANDERS[workload["kind"]](workload)
+
+
+def initial_replicas(job: TraceJob) -> int:
+    """The replica count an arrival initially asks for (elastic jobs:
+    spec replicas clamped into [min, max])."""
+    spec = job.workload.get("spec") or {}
+    want = spec.get("completions" if job.workload.get("kind") == C.KIND_JOB
+                    else "replicas")
+    want = 1 if want is None else int(want)
+    if job.elastic is not None:
+        want = max(job.elastic["min"], min(want, job.elastic["max"]))
+        want = max(want, 1)
+    return want

@@ -1,0 +1,798 @@
+"""Continuous-time, event-driven replay over the carried engine state.
+
+The loop advances a single `Engine` through a time-ordered event stream
+(timeline/events.py) WITHOUT re-placing from scratch: admissions are
+ordinary `Engine.place` dispatches over the pre-tensorized batch (the
+wavefront drafts a whole gang in one call), departures and evictions are
+signed placement-log deltas through `engine/state.py`'s batch apply/undo
+(`Engine.remove_placements` / `restore_placements`) — the same primitive
+PR 4's drain/requeue rides — so the carried state rolls forward
+incrementally across thousands of events.
+
+On top of the loop:
+- **gang admission** — all-or-nothing: a gang whose pods do not ALL
+  place rolls its partial placement back (the wavefront's
+  verify-and-rollback discipline at admission granularity); no emitted
+  state ever shows a partial gang;
+- **priority pending queue** — failed gangs wait with exponential
+  retry/backoff and are re-attempted (priority-descending, arrival-order
+  tie-break) at the end of any timestamp that released capacity
+  (departure, node up, preemption, scale-down);
+- **preemption on arrival** — an arriving gang may evict strictly
+  lower-priority gangs (lowest priority first, youngest first); evicted
+  gangs requeue, and a preemption that still cannot admit restores every
+  victim bit-identically via the delta undo;
+- **autoscaler emulation** (timeline/autoscale.py) — periodic HPA
+  replica scaling off simulated utilization plus a pre-provisioned
+  template-node pool armed through the same node_valid lever.
+
+Determinism: events process in `(t, rank, seq)` order; same-timestamp
+capacity changes settle before the end-of-timestamp retry pass (the rule
+that makes the batched path's same-`t` departure coalescing
+semantics-identical to the serial oracle).  `options.serial` is that
+oracle: one event at a time, one pod per dispatch, wavefront off, dense
+carry, state rebuilt from the placement log before every dispatch — the
+batched path is pinned bit-identical against it (tests/test_timeline.py).
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.tensorize import Tensorizer, slice_batch
+from ..durable.deadline import PlanInterrupted, RunControl
+from ..engine.scan import Engine
+from ..engine.state import build_state
+from ..obs.metrics import REGISTRY
+from ..obs.trace import instant, span
+from ..workloads.expand import make_valid_node_by_node, seed_name_hashes
+from ..workloads.validate import SpecError
+from .events import (
+    EVT_ARRIVE,
+    EVT_AUTOSCALE,
+    EVT_DEPART,
+    EVT_NODE_DOWN,
+    EVT_NODE_UP,
+    EVT_RETRY,
+    RANK_NAMES,
+    Trace,
+    expand_job_pods,
+    initial_replicas,
+)
+
+#: admissions at time t schedule their departure at t + max(duration, this)
+#: so a zero-duration job still departs strictly later than it arrived
+#: (the event loop groups strictly by timestamp)
+_MIN_DURATION_S = 1e-6
+
+#: exported next to the instruments (obs/metrics.py `family` helper):
+#: the timeline counter family the CLI/bench read
+TIMELINE_KEYS = (
+    "events", "arrivals", "departures", "admitted", "attempts",
+    "gang_rollbacks", "retries", "preemptions", "preempted_pods",
+    "node_down", "node_up", "cron_fires", "dropped_pods",
+    "autoscale_checks", "scale_up_pods", "scale_down_pods",
+    "pool_up", "pool_down",
+)
+
+
+@dataclass
+class ReplayOptions:
+    """Knobs of one replay run."""
+
+    serial: bool = False  # the one-event/one-pod-at-a-time oracle
+    speculate: Optional[bool] = None  # wavefront (None = env default)
+    compact: Optional[bool] = None  # compact carried state (None = default)
+    preempt: bool = True  # preemption on gang arrival
+    retry_backoff_s: float = 30.0  # base of the exponential backoff
+    max_retries: int = 8  # per job; exhaustion drops the remainder
+    extended_resources: tuple = ()
+    sched_config: object = None
+    audit: Optional[bool] = None  # end-state certification (None = env)
+    control: Optional[RunControl] = None  # deadline/SIGINT token
+    progress: Optional[Callable[[str], None]] = None
+
+
+@dataclass
+class _JobState:
+    jid: int
+    job: object  # TraceJob
+    rows: np.ndarray  # all batch rows (elastic: max replicas)
+    want: int  # current replica target (rows[:want] desired)
+    placed: np.ndarray  # [len(rows)] bool
+    status: str = "waiting"  # waiting|pending|active|departed|dropped
+    arrive_t: float = 0.0
+    attempts: int = 0
+    admit_seq: int = -1  # monotone admission order (preemption tie-break)
+    epoch: int = 0  # bumps on every eviction; stale departures skip
+    full_at: Optional[float] = None  # first fully-placed instant
+
+    @property
+    def placed_count(self) -> int:
+        return int(self.placed.sum())
+
+    @property
+    def needs(self) -> int:
+        return self.want - self.placed_count
+
+
+class TimelineResult:
+    """Outcome of one replay: counters, the utilization/pending/preemption
+    time series, the end-state handles the pinning tests and the auditor
+    consume, and the partial-result contract fields."""
+
+    def __init__(self):
+        self.events = 0
+        self.event_log: List[Tuple[float, str, str]] = []
+        self.samples: List[Tuple[float, float, int, int]] = []
+        self.pending_s: List[float] = []
+        self.counts = {k: 0 for k in TIMELINE_KEYS}
+        self.nodes: Optional[np.ndarray] = None  # [P] final landing (-1)
+        self.tensors = None
+        self.batch = None
+        self.engine: Optional[Engine] = None
+        self.node_valid: Optional[np.ndarray] = None
+        self.audit: Optional[dict] = None
+        self.partial = False
+        self.message = ""
+        self.still_pending = 0  # jobs not fully placed at the end
+        self.timings = {}
+
+    def end_state(self):
+        """Dense end-of-replay SchedState (rebuilding from the log when
+        the carry is dirty — the oracle leaves it so by design)."""
+        eng = self.engine
+        tensors = self.tensors
+        if (
+            eng.last_state is not None
+            and not eng._state_dirty
+            and eng._last_vocab == eng.state_vocab(tensors)
+        ):
+            return eng.carried_state()
+        r = tensors.alloc.shape[1]
+        return build_state(
+            tensors,
+            np.asarray(eng.placed_group, np.int32),
+            np.asarray(eng.placed_node, np.int32),
+            eng.log_req_matrix(r),
+            eng.ext_log,
+        )
+
+    @property
+    def pending_p50_s(self) -> float:
+        if not self.pending_s:
+            return 0.0
+        return float(np.percentile(np.asarray(self.pending_s), 50))
+
+    @property
+    def pending_p90_s(self) -> float:
+        if not self.pending_s:
+            return 0.0
+        return float(np.percentile(np.asarray(self.pending_s), 90))
+
+    @property
+    def util_avg(self) -> float:
+        if not self.samples:
+            return 0.0
+        return float(np.mean([s[1] for s in self.samples]))
+
+    def counters(self) -> dict:
+        """Machine-readable summary (CLI --json, bench)."""
+        out = dict(self.counts)
+        out.update(
+            events=self.events,
+            placed_pods=int((np.asarray(self.nodes) >= 0).sum())
+            if self.nodes is not None
+            else 0,
+            still_pending=self.still_pending,
+            pending_p50_s=round(self.pending_p50_s, 3),
+            pending_p90_s=round(self.pending_p90_s, 3),
+            util_avg=round(self.util_avg, 4),
+            partial=self.partial,
+            events_per_s=round(self.timings.get("events_per_s", 0.0), 2),
+        )
+        return out
+
+
+def _no_progress(msg: str) -> None:
+    pass
+
+
+def replay_trace(trace: Trace, options: Optional[ReplayOptions] = None) -> TimelineResult:
+    """Replay one trace; see the module docstring for semantics."""
+    options = options or ReplayOptions()
+    rt = _Replay(trace, options)
+    return rt.run()
+
+
+class _Replay:
+    def __init__(self, trace: Trace, opts: ReplayOptions):
+        self.trace = trace
+        self.opts = opts
+        self.serial = bool(opts.serial)
+        self._progress = opts.progress or _no_progress
+        self.control = opts.control or RunControl()
+        self._build_problem()
+        self._build_heap()
+
+    # -- problem assembly --------------------------------------------------
+
+    def _build_problem(self) -> None:
+        trace = self.trace
+        # deterministic pod-name stream per trace: two replays (batched
+        # and oracle) expand byte-identical pods
+        seed_name_hashes(0x7133_1177 ^ int(trace.seed))
+        pods_all: List[dict] = []
+        self.jobs: List[_JobState] = []
+        for job in sorted(trace.jobs, key=lambda j: j.seq):
+            pods = expand_job_pods(job)
+            if not pods:
+                continue
+            rows = np.arange(len(pods_all), len(pods_all) + len(pods),
+                             dtype=np.int64)
+            pods_all.extend(pods)
+            want = min(initial_replicas(job), len(pods))
+            self.jobs.append(
+                _JobState(
+                    jid=len(self.jobs),
+                    job=job,
+                    rows=rows,
+                    want=want,
+                    placed=np.zeros(len(pods), bool),
+                )
+            )
+        cluster = trace.cluster
+        nodes = list(cluster.nodes)
+        if not nodes:
+            raise SpecError("trace cluster has no nodes",
+                            source=trace.source, field="trace.cluster")
+        self.n_base = len(nodes)
+        self.pool_rows: List[int] = []
+        auto = trace.autoscale
+        if auto is not None and auto.pool:
+            for i in range(auto.pool):
+                nodes.append(
+                    make_valid_node_by_node(auto.node, f"timeline-pool-{i:04d}")
+                )
+                self.pool_rows.append(self.n_base + i)
+        self.tz = Tensorizer(
+            nodes,
+            self.opts.extended_resources,
+            getattr(cluster, "storage_classes", ()) or (),
+            getattr(cluster, "services", ()) or (),
+        )
+        self.batch = self.tz.add_pods(pods_all)
+        self.tensors = self.tz.freeze()
+        self.node_idx = {
+            str(node.get("metadata", {}).get("name", "")): i
+            for i, node in enumerate(nodes)
+        }
+        for ev in trace.node_events:
+            unknown = [n for n in ev.nodes if n not in self.node_idx]
+            if unknown:
+                raise SpecError(
+                    f"unknown node(s) {unknown} (not in the trace cluster)",
+                    source=trace.source,
+                    field=f"node_events@{ev.t_s:g}s",
+                )
+        eng = Engine(self.tz)
+        eng.sched_config = self.opts.sched_config
+        if self.serial:
+            eng.speculate = False
+            eng.compact = False
+        else:
+            if self.opts.speculate is not None:
+                eng.speculate = bool(self.opts.speculate)
+            if self.opts.compact is not None:
+                eng.compact = bool(self.opts.compact)
+        n = self.tensors.alloc.shape[0]
+        self.valid = np.ones(n, bool)
+        if self.pool_rows:
+            self.valid[self.pool_rows] = False  # pool arms via node_up
+        eng.node_valid = self.valid.copy()
+        self.eng = eng
+        # log mirrors: job id + batch row per engine log entry (the engine
+        # log is the single source of placement truth; these map entries
+        # back to jobs for departures/drains)
+        self.log_jid = np.zeros(0, np.int64)
+        self.log_row = np.zeros(0, np.int64)
+        self.nodes_full = np.full(len(pods_all), -1, np.int64)
+        # utilization bookkeeping (requested cpu vs valid allocatable)
+        names = list(getattr(self.tensors, "resource_names", ()) or ())
+        self.cpu_idx = names.index("cpu") if "cpu" in names else 0
+        self.alloc_cpu = np.asarray(self.tensors.alloc[:, self.cpu_idx],
+                                    np.float64)
+        self.req_cpu = np.asarray(self.batch.req[:, self.cpu_idx], np.float64)
+        self.used_cpu = 0.0
+        self.res = TimelineResult()
+        self.res.tensors = self.tensors
+        self.res.batch = self.batch
+        self.res.engine = eng
+        self._admit_seq = 0
+
+    def _build_heap(self) -> None:
+        self.heap: List[tuple] = []
+        self._seq = 0
+        for st in self.jobs:
+            self._push(st.job.t_s, EVT_ARRIVE, st.jid)
+            if str(st.job.source).startswith("cron_jobs["):
+                self._bump("cron_fires")
+        for ev in self.trace.node_events:
+            self._push(
+                ev.t_s,
+                EVT_NODE_DOWN if ev.kind == "down" else EVT_NODE_UP,
+                ev,
+            )
+        auto = self.trace.autoscale
+        if auto is not None:
+            t = auto.interval_s
+            while t <= self.trace.horizon_s:
+                self._push(t, EVT_AUTOSCALE, None)
+                t += auto.interval_s
+
+    def _push(self, t: float, rank: int, payload) -> None:
+        heapq.heappush(self.heap, (float(t), rank, self._seq, payload))
+        self._seq += 1
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        self.res.counts[key] += n
+        REGISTRY.counter(f"timeline.{key}").inc(n)
+
+    # -- engine plumbing ---------------------------------------------------
+
+    def _place_rows(self, rows: np.ndarray, jid: int) -> np.ndarray:
+        """Place `rows` through the engine, appending the log mirrors for
+        the rows that landed.  The oracle dispatches one pod at a time
+        with a from-log state rebuild before each dispatch; the batched
+        path places the whole run in one call over the delta-advanced
+        carry (wavefront-draftable — same group, contiguous)."""
+        rows = np.asarray(rows, np.int64)
+        if self.serial:
+            out = np.empty(len(rows), np.int64)
+            for k in range(len(rows)):
+                self.eng._state_dirty = True  # force the from-log rebuild
+                got, _, _ = self.eng.place(
+                    slice_batch(self.batch, rows[k: k + 1])
+                )
+                out[k] = int(np.asarray(got)[0])
+        else:
+            got, _, _ = self.eng.place(slice_batch(self.batch, rows))
+            out = np.asarray(got, np.int64)
+        ok = rows[out >= 0]
+        if len(ok):
+            self.log_jid = np.concatenate(
+                [self.log_jid, np.full(len(ok), jid, np.int64)]
+            )
+            self.log_row = np.concatenate([self.log_row, ok])
+            self.used_cpu += float(self.req_cpu[ok].sum())
+        return out
+
+    def _remove_entries(self, indices: np.ndarray) -> dict:
+        """Remove engine log entries (delta undo inside), keeping the
+        mirrors and derived bookkeeping in lockstep."""
+        idx = np.asarray(sorted(int(i) for i in indices), np.int64)
+        rows = self.log_row[idx]
+        saved = self.eng.remove_placements([int(i) for i in idx])
+        keep = np.ones(len(self.log_jid), bool)
+        keep[idx] = False
+        removed = (idx, self.log_jid[idx].copy(), rows.copy())
+        self.log_jid = self.log_jid[keep]
+        self.log_row = self.log_row[keep]
+        self.nodes_full[rows] = -1
+        self.used_cpu -= float(self.req_cpu[rows].sum())
+        for jid in np.unique(removed[1]):
+            st = self.jobs[int(jid)]
+            gone = rows[removed[1] == jid]
+            pos = np.searchsorted(st.rows, gone)
+            st.placed[pos] = False
+        return {"saved": saved, "mirror": removed}
+
+    def _restore_entries(self, token: dict) -> None:
+        """Bit-identical inverse of `_remove_entries` (the preemption
+        trial's rollback): delta re-apply plus mirror re-insertion."""
+        saved = token["saved"]
+        idx, jids, rows = token["mirror"]
+        self.eng.restore_placements(saved)
+        jid_list = list(self.log_jid)
+        row_list = list(self.log_row)
+        for i, j, r in zip(idx, jids, rows):
+            jid_list.insert(int(i), int(j))
+            row_list.insert(int(i), int(r))
+        self.log_jid = np.asarray(jid_list, np.int64)
+        self.log_row = np.asarray(row_list, np.int64)
+        for (_, entry), r in zip(
+            zip(saved["indices"], saved["entries"]), rows
+        ):
+            self.nodes_full[r] = entry[1]
+        self.used_cpu += float(self.req_cpu[rows].sum())
+        for jid in np.unique(jids):
+            st = self.jobs[int(jid)]
+            back = rows[jids == jid]
+            pos = np.searchsorted(st.rows, back)
+            st.placed[pos] = True
+
+    def _evict_job(
+        self,
+        st: _JobState,
+        entries: Optional[np.ndarray] = None,
+        bump_epoch: bool = True,
+    ) -> dict:
+        """Evict a job's (subset of) placements.  `entries` are log
+        indices (default: every entry of the job).  `bump_epoch` marks
+        the job's scheduled departure stale — right for evictions that
+        end the current run (the re-admission schedules a fresh one),
+        wrong for partial evictions that leave the run alive (HPA
+        scale-down), which pass False."""
+        if entries is None:
+            entries = np.flatnonzero(self.log_jid == st.jid)
+        token = self._remove_entries(entries)
+        if bump_epoch:
+            st.epoch += 1  # any scheduled departure for the old run is stale
+        return token
+
+    # -- admission ---------------------------------------------------------
+
+    def _mark_admitted(self, st: _JobState, t: float) -> None:
+        st.status = "active"
+        st.admit_seq = self._admit_seq
+        self._admit_seq += 1
+        if st.full_at is None:
+            st.full_at = t
+            self.res.pending_s.append(t - st.arrive_t)
+            REGISTRY.histogram("timeline.pending_s").observe(t - st.arrive_t)
+            dur = st.job.duration_s
+            if dur is not None:
+                self._push(
+                    t + max(float(dur), _MIN_DURATION_S), EVT_DEPART,
+                    (st.jid, st.epoch),
+                )
+        self._bump("admitted")
+
+    def _try_admit_gang(self, st: _JobState, t: float) -> bool:
+        """All-or-nothing: place the gang, roll back any partial."""
+        rows = st.rows[: st.want]
+        log_base = len(self.eng.placed_node)
+        self._bump("attempts")
+        with span("timeline.admit", job=st.job.name, pods=int(len(rows))):
+            nodes = self._place_rows(rows, st.jid)
+        if bool((nodes >= 0).all()):
+            self.nodes_full[rows] = nodes
+            st.placed[: st.want] = True
+            self._mark_admitted(st, t)
+            return True
+        placed_ct = len(self.eng.placed_node) - log_base
+        if placed_ct:
+            # the partial gang never escapes this frame: undo the tail
+            self._bump("gang_rollbacks")
+            self._remove_entries(
+                np.arange(log_base, log_base + placed_ct, dtype=np.int64)
+            )
+        return False
+
+    def _try_admit_elastic(self, st: _JobState, t: float) -> int:
+        """Per-replica best effort: place the missing rows, keep what
+        lands.  Returns the newly-placed count."""
+        missing = st.rows[: st.want][~st.placed[: st.want]]
+        if not len(missing):
+            return 0
+        self._bump("attempts")
+        with span("timeline.admit", job=st.job.name, pods=int(len(missing))):
+            nodes = self._place_rows(missing, st.jid)
+        ok = nodes >= 0
+        landed = missing[ok]
+        if len(landed):
+            self.nodes_full[landed] = nodes[ok]
+            pos = np.searchsorted(st.rows, landed)
+            st.placed[pos] = True
+        if st.placed[: st.want].all():
+            self._mark_admitted(st, t)
+        return int(len(landed))
+
+    def _preempt_admit(self, st: _JobState, t: float) -> bool:
+        """Evict strictly-lower-priority gangs (lowest priority first,
+        youngest first) until the arrival admits; restore every victim
+        via the delta undo when it never does."""
+        evicted: List[Tuple[_JobState, dict]] = []
+        admitted = False
+        while True:
+            cands = [
+                v
+                for v in self.jobs
+                if v.status == "active" and v.jid != st.jid
+                and v.job.priority < st.job.priority
+            ]
+            if not cands:
+                break
+            cands.sort(key=lambda v: (v.job.priority, -v.admit_seq))
+            victim = cands[0]
+            token = self._evict_job(victim)
+            victim.status = "evicting"
+            evicted.append((victim, token))
+            if self._try_admit_gang(st, t):
+                admitted = True
+                break
+        if not admitted:
+            for victim, token in reversed(evicted):
+                self._restore_entries(token)
+                # the victim never actually left: un-stale its scheduled
+                # departure by undoing the eviction's epoch bump (a
+                # restored run IS the old run)
+                victim.epoch -= 1
+                victim.status = "active"
+            return False
+        self._bump("preemptions", len(evicted))
+        for victim, token in evicted:
+            pods = len(token["mirror"][0])
+            self._bump("preempted_pods", pods)
+            instant("timeline.preempt", victim=victim.job.name, pods=pods)
+            victim.status = "pending"
+            victim.full_at = None  # waits again; pending clock restarts
+            victim.arrive_t = t
+            self._push(t, EVT_RETRY, victim.jid)
+        return True
+
+    def _admit(self, st: _JobState, t: float, allow_preempt: bool) -> bool:
+        """One admission opportunity; True when nothing remains pending."""
+        if st.job.gang:
+            if self._try_admit_gang(st, t):
+                return True
+            if allow_preempt and self.opts.preempt:
+                if self._preempt_admit(st, t):
+                    return True
+            return False
+        self._try_admit_elastic(st, t)
+        return st.needs <= 0
+
+    def _schedule_retry(self, st: _JobState, t: float) -> None:
+        st.attempts += 1
+        if st.attempts >= self.opts.max_retries:
+            dropped = st.needs if not st.job.gang else st.want
+            if st.job.gang:
+                st.status = "dropped"
+            else:
+                # give up on the still-missing replicas only
+                st.want = st.placed_count
+                if st.want and st.full_at is None:
+                    self._mark_admitted(st, t)
+            self._bump("dropped_pods", int(dropped))
+            return
+        backoff = self.opts.retry_backoff_s * (2.0 ** (st.attempts - 1))
+        self._push(t + backoff, EVT_RETRY, st.jid)
+
+    def _retry_pending(self, t: float) -> None:
+        """End-of-timestamp pass after released capacity: re-attempt every
+        waiting job, priority-descending (arrival order breaking ties).
+        Failures keep their scheduled backoff retries — this pass never
+        burns an attempt."""
+        pend = [
+            st
+            for st in self.jobs
+            if st.status == "pending" and st.needs > 0
+        ]
+        pend.sort(key=lambda s: (-s.job.priority, s.jid))
+        for st in pend:
+            self._admit(st, t, allow_preempt=False)
+
+    # -- event handlers ----------------------------------------------------
+
+    def _handle_arrive(self, jid: int, t: float) -> None:
+        st = self.jobs[jid]
+        st.status = "pending"
+        st.arrive_t = t
+        self._bump("arrivals")
+        if not self._admit(st, t, allow_preempt=True):
+            self._schedule_retry(st, t)
+
+    def _handle_retry(self, jid: int, t: float) -> None:
+        st = self.jobs[jid]
+        if st.status != "pending" or st.needs <= 0:
+            return  # stale: admitted/departed/dropped meanwhile
+        self._bump("retries")
+        if not self._admit(st, t, allow_preempt=True):
+            self._schedule_retry(st, t)
+
+    def _handle_departs(self, departs: List[tuple], t: float) -> bool:
+        """Process every departure at this timestamp.  The batched path
+        coalesces them into ONE delta batch; the oracle removes job by
+        job — bit-identical by the delta machinery's exactness."""
+        live: List[_JobState] = []
+        for jid, epoch in departs:
+            st = self.jobs[jid]
+            if st.status == "active" and st.epoch == epoch:
+                live.append(st)
+            elif st.status == "pending" and st.epoch == epoch:
+                # departed while waiting: it leaves the queue
+                st.status = "departed"
+                self._bump("departures")
+        if not live:
+            return False
+        with span("timeline.drain", jobs=int(len(live))):
+            if self.serial:
+                for st in live:
+                    self._evict_job(st)
+            else:
+                jids = np.asarray([st.jid for st in live])
+                entries = np.flatnonzero(np.isin(self.log_jid, jids))
+                self._remove_entries(entries)
+                for st in live:
+                    st.epoch += 1
+        for st in live:
+            st.status = "departed"
+            st.want = 0
+            self._bump("departures")
+        return True
+
+    def _handle_node_event(self, ev, t: float, down: bool) -> bool:
+        idxs = np.asarray([self.node_idx[n] for n in ev.nodes], np.int64)
+        self._bump("node_down" if down else "node_up")
+        if not down:
+            self.valid[idxs] = True
+            self.eng.node_valid = self.valid.copy()
+            return True  # capacity released
+        self.valid[idxs] = False
+        self.eng.node_valid = self.valid.copy()
+        # drain: gangs lose the whole gang (all-or-nothing holds under
+        # failure too); elastic jobs lose only the dead replicas
+        dead = np.zeros(self.tensors.alloc.shape[0], bool)
+        dead[idxs] = True
+        affected = np.flatnonzero(dead[np.asarray(self.eng.placed_node,
+                                                  np.int64)])
+        if not len(affected):
+            return False
+        jids = np.unique(self.log_jid[affected])
+        with span("timeline.drain", jobs=int(len(jids)), node_down=True):
+            for jid in jids:
+                st = self.jobs[int(jid)]
+                if st.job.gang:
+                    self._evict_job(st)  # whole gang
+                else:
+                    entries = np.flatnonzero(
+                        (self.log_jid == jid)
+                        & dead[np.asarray(self.eng.placed_node, np.int64)]
+                    )
+                    self._evict_job(st, entries)
+                st.status = "pending"
+                st.full_at = None
+                st.arrive_t = t
+                self._push(t, EVT_RETRY, int(jid))
+        return False  # capacity shrank; the retries ride their own events
+
+    def _sample(self, t: float) -> None:
+        cap = float(self.alloc_cpu[self.valid].sum())
+        util = self.used_cpu / cap if cap > 0 else 0.0
+        placed = len(self.eng.placed_node)
+        pending = sum(
+            st.needs for st in self.jobs
+            if st.status in ("pending", "active") and st.needs > 0
+        )
+        self.res.samples.append((t, util, placed, pending))
+        REGISTRY.gauge("timeline.sim_clock_s").set(t)
+        REGISTRY.gauge("timeline.util").set(round(util, 4))
+
+    # -- the loop ----------------------------------------------------------
+
+    def run(self) -> TimelineResult:
+        res = self.res
+        t0 = time.perf_counter()
+        try:
+            with span("timeline.replay", jobs=int(len(self.jobs)),
+                      events=int(len(self.heap))):
+                self._loop()
+        except PlanInterrupted as exc:
+            res.partial = True
+            res.message = (
+                f"replay interrupted ({exc.reason}): "
+                f"{res.events} event(s) processed, sim clock at "
+                f"{res.samples[-1][0] if res.samples else 0.0:g}s"
+            )
+        wall = time.perf_counter() - t0
+        res.timings["wall_s"] = wall
+        res.timings["events_per_s"] = res.events / wall if wall > 0 else 0.0
+        res.nodes = self.nodes_full.copy()
+        res.node_valid = self.valid.copy()
+        res.still_pending = sum(
+            1 for st in self.jobs
+            if st.status == "pending" and st.needs > 0
+        )
+        self._audit(res)
+        return res
+
+    def _loop(self) -> None:
+        auto = self.trace.autoscale
+        while self.heap:
+            self.control.check()  # deadline/SIGINT: cooperative partial
+            t = self.heap[0][0]
+            if t > self.trace.horizon_s:
+                break
+            released = False
+            departs: List[tuple] = []
+            while self.heap and self.heap[0][0] == t:
+                _, rank, _, payload = heapq.heappop(self.heap)
+                self.res.events += 1
+                self._bump("events")
+                if rank == EVT_DEPART:
+                    departs.append(payload)
+                    self.res.event_log.append(
+                        (t, "depart", self.jobs[payload[0]].job.name)
+                    )
+                    continue
+                if departs:
+                    # capacity settles before anything else at this t
+                    released |= self._handle_departs(departs, t)
+                    departs = []
+                if rank == EVT_ARRIVE:
+                    self.res.event_log.append(
+                        (t, "arrive", self.jobs[payload].job.name)
+                    )
+                    self._handle_arrive(payload, t)
+                elif rank == EVT_RETRY:
+                    self.res.event_log.append(
+                        (t, "retry", self.jobs[payload].job.name)
+                    )
+                    self._handle_retry(payload, t)
+                elif rank in (EVT_NODE_DOWN, EVT_NODE_UP):
+                    self.res.event_log.append(
+                        (t, RANK_NAMES[rank], ",".join(payload.nodes))
+                    )
+                    released |= self._handle_node_event(
+                        payload, t, down=(rank == EVT_NODE_DOWN)
+                    )
+                elif rank == EVT_AUTOSCALE:
+                    from .autoscale import autoscale_tick
+
+                    self.res.event_log.append((t, "autoscale", ""))
+                    with span("timeline.autoscale"):
+                        released |= autoscale_tick(self, auto, t)
+            if departs:
+                released |= self._handle_departs(departs, t)
+            if released:
+                self._retry_pending(t)
+            self._sample(t)
+
+    # -- end-state certification ------------------------------------------
+
+    def _audit(self, res: TimelineResult) -> None:
+        from ..audit.checker import audit_enabled
+
+        on = audit_enabled() if self.opts.audit is None else self.opts.audit
+        if not on:
+            return
+        from ..audit.checker import audit_placement
+
+        ext = {
+            "lvm_alloc": np.zeros(
+                (len(self.nodes_full), self.tensors.ext.vg_cap.shape[1])
+            ),
+            "dev_take": np.zeros(
+                (len(self.nodes_full), self.tensors.ext.sdev_cap.shape[1]),
+                bool,
+            ),
+            "gpu_shares": np.zeros(
+                (len(self.nodes_full),
+                 self.tensors.ext.gpu_dev_total.shape[1])
+            ),
+        }
+        if len(self.log_row):
+            rows = self.log_row
+            ext["lvm_alloc"][rows] = np.asarray(self.eng.ext_log["vg_alloc"])
+            ext["dev_take"][rows] = np.asarray(self.eng.ext_log["sdev_take"])
+            ext["gpu_shares"][rows] = np.asarray(
+                self.eng.ext_log["gpu_shares"]
+            )
+        report = audit_placement(
+            self.tensors,
+            self.batch,
+            res.nodes,
+            ext=ext,
+            node_valid=self.valid,
+        )
+        res.audit = report.counters()
+        if not report.ok:
+            self._progress(
+                f"timeline audit FAILED: {report.summary()}"
+            )

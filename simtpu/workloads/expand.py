@@ -255,6 +255,19 @@ def generate_job_from_cron_job(cronjob: dict) -> dict:
 
 
 def make_valid_pods_by_cron_job(cronjob: dict) -> List[dict]:
+    """Static CronJob expansion: one Job instance, UNLESS the CronJob is
+    suspended (`spec.suspend: true` — the controller creates no Jobs while
+    set, so the static snapshot must not schedule one either; the old
+    behavior emitted a Job regardless, ISSUE 15 satellite).  The schedule
+    is validated through the shared cron parser (`workloads/cron.py`) —
+    the same grammar the timeline's firing generator walks, so a spec the
+    static path accepts can never blow up mid-replay."""
+    from .cron import cron_job_schedule, cron_job_suspended
+
+    if (cronjob.get("spec") or {}).get("schedule") is not None:
+        cron_job_schedule(cronjob)  # SpecError (one line) on malformed
+    if cron_job_suspended(cronjob):
+        return []
     return make_valid_pods_by_job(generate_job_from_cron_job(cronjob))
 
 

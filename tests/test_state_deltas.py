@@ -163,3 +163,166 @@ class TestApplyUndoRoundTrip:
             assert np.array_equal(
                 np.asarray(getattr(out, name)), np.asarray(getattr(base, name))
             ), name
+
+
+class TestInterleavedApplyUndo:
+    """Out-of-stack-order apply/undo (ISSUE 15): departures in a timeline
+    undo event i after later events j > i applied — the delta-advanced
+    carry must stay bit-identical to a state REBUILT from the equivalent
+    log, for the dense carry and the compact (domain-tabular) carry."""
+
+    def _packed(self, placed, entries, sign):
+        tensors = placed.tensors
+        ext = tensors.ext
+        return pack_delta_entries(
+            entries,
+            tensors.alloc.shape[1],
+            ext.vg_cap.shape[1],
+            ext.sdev_cap.shape[1],
+            ext.gpu_dev_total.shape[1],
+            sign,
+        )
+
+    def _rebuilt(self, placed, keep_mask):
+        """build_state over the placement log restricted to `keep_mask`
+        entries — the from-scratch oracle of any delta sequence whose net
+        effect removes the masked-out entries."""
+        import numpy as np
+
+        from simtpu.engine.state import build_state
+
+        eng = placed.engine
+        tensors = placed.tensors
+        keep = np.flatnonzero(keep_mask)
+        r = tensors.alloc.shape[1]
+        req = eng.log_req_matrix(r)[keep]
+        ext = {
+            k: [eng.ext_log[k][int(i)] for i in keep] for k in eng.ext_log
+        }
+        return build_state(
+            tensors,
+            np.asarray(eng.placed_group, np.int32)[keep],
+            np.asarray(eng.placed_node, np.int32)[keep],
+            req,
+            ext,
+        )
+
+    def _assert_states_equal(self, got, want, label):
+        import numpy as np
+
+        for name in want._fields:
+            g = np.asarray(getattr(got, name))
+            w = np.asarray(getattr(want, name))
+            assert g.dtype == w.dtype, (label, name)
+            assert np.array_equal(g, w), (
+                f"{label}: plane {name} not bit-identical "
+                f"(max delta "
+                f"{np.max(np.abs(g.astype(np.float64) - w.astype(np.float64)))})"
+            )
+
+    def test_undo_i_after_apply_j_matches_rebuild(self, placed):
+        """apply -A, apply -B (disjoint, B after A), undo +A — the state
+        must equal a rebuild from the log minus B, dense AND compact."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from simtpu.engine.state import (
+            compact_spec,
+            compress_state,
+            node_dom_small_for,
+        )
+
+        eng = placed.engine
+        tensors = placed.tensors
+        statics = statics_from(tensors, eng.sched_config)
+        m = len(eng.placed_node)
+        a_idx = list(range(0, m, 4))          # "event i"
+        b_idx = list(range(1, m, 4))          # "event j > i", disjoint
+        assert len(a_idx) >= 4 and len(b_idx) >= 4
+        base = eng.carried_state()
+        state = jax.tree_util.tree_map(jnp.copy, base)
+        state = apply_placement_deltas(
+            statics, state, self._packed(placed, _entries_of(eng, a_idx), -1.0)
+        )
+        state = apply_placement_deltas(
+            statics, state, self._packed(placed, _entries_of(eng, b_idx), -1.0)
+        )
+        # out-of-stack-order undo: A comes back while B stays evicted
+        state = apply_placement_deltas(
+            statics, state, self._packed(placed, _entries_of(eng, a_idx), +1.0)
+        )
+        keep = np.ones(m, bool)
+        keep[b_idx] = False
+        want = self._rebuilt(placed, keep)
+        self._assert_states_equal(state, want, "dense carry")
+        spec = compact_spec(tensors)
+        if spec.enabled:
+            nds = node_dom_small_for(tensors, tensors.alloc.shape[0])
+            got_c = compress_state(spec.dev, state)
+            want_c = compress_state(spec.dev, want)
+            self._assert_states_equal(got_c, want_c, "compact carry")
+            # and the compact round trip loses nothing: the delta-advanced
+            # state is still in the domain-constant class compression
+            # assumes (what the timeline's carried compact state rides on)
+            from simtpu.engine.state import expand_state
+
+            back = expand_state(spec.dev, got_c, nds)
+            self._assert_states_equal(back, want, "compact round trip")
+
+    def test_full_out_of_order_round_trip(self, placed):
+        """apply -A, apply -B, undo +A, undo +B returns to base
+        bit-identically (the stack-order test's interleaved sibling)."""
+        import jax
+        import jax.numpy as jnp
+
+        eng = placed.engine
+        tensors = placed.tensors
+        statics = statics_from(tensors, eng.sched_config)
+        m = len(eng.placed_node)
+        a_idx = list(range(0, m, 3))
+        b_idx = list(range(1, m, 3))
+        base = eng.carried_state()
+        state = jax.tree_util.tree_map(jnp.copy, base)
+        for idx, sign in ((a_idx, -1.0), (b_idx, -1.0),
+                          (a_idx, +1.0), (b_idx, +1.0)):
+            state = apply_placement_deltas(
+                statics, state, self._packed(placed, _entries_of(eng, idx), sign)
+            )
+        self._assert_states_equal(state, base, "out-of-order round trip")
+
+    def test_interleaved_apply_after_undo(self, placed):
+        """undo (depart) then APPLY the same entries again (a re-admission
+        landing on identical nodes) interleaved with another departure —
+        the timeline's node-down/requeue shape — equals the rebuild."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        eng = placed.engine
+        tensors = placed.tensors
+        statics = statics_from(tensors, eng.sched_config)
+        m = len(eng.placed_node)
+        a_idx = list(range(0, m, 5))
+        b_idx = list(range(2, m, 5))
+        base = eng.carried_state()
+        state = jax.tree_util.tree_map(jnp.copy, base)
+        state = apply_placement_deltas(
+            statics, state, self._packed(placed, _entries_of(eng, a_idx), -1.0)
+        )
+        state = apply_placement_deltas(
+            statics, state, self._packed(placed, _entries_of(eng, b_idx), -1.0)
+        )
+        state = apply_placement_deltas(
+            statics, state, self._packed(placed, _entries_of(eng, a_idx), +1.0)
+        )
+        state = apply_placement_deltas(
+            statics, state, self._packed(placed, _entries_of(eng, b_idx), +1.0)
+        )
+        state = apply_placement_deltas(
+            statics, state, self._packed(placed, _entries_of(eng, b_idx), -1.0)
+        )
+        keep = np.ones(m, bool)
+        keep[b_idx] = False
+        want = self._rebuilt(placed, keep)
+        self._assert_states_equal(state, want, "re-admission interleave")

@@ -366,3 +366,113 @@ class TestUpstreamValidationRules:
         res.deployments = [dep]
         with pytest.raises(ValidationError):
             get_valid_pods_exclude_daemonset(res)
+
+
+class TestCronJob:
+    """The shared cron parser (workloads/cron.py) + the static expansion's
+    suspend/schedule fidelity (ISSUE 15 satellite)."""
+
+    def _cron(self, schedule="*/15 * * * *", suspend=None, deadline=None):
+        from .fixtures import make_fake_cron_job
+
+        cj = make_fake_cron_job("tick", "ns", 1, "100m", "128Mi")
+        cj["spec"]["schedule"] = schedule
+        if suspend is not None:
+            cj["spec"]["suspend"] = suspend
+        if deadline is not None:
+            cj["spec"]["startingDeadlineSeconds"] = deadline
+        return cj
+
+    def test_static_expansion_emits_one_job(self):
+        from simtpu.workloads.expand import make_valid_pods_by_cron_job
+
+        pods = make_valid_pods_by_cron_job(self._cron())
+        assert len(pods) == 1
+        kinds = annotations_of(pods[0])[C.ANNO_WORKLOAD_KIND]
+        assert kinds == C.KIND_JOB
+
+    def test_suspend_true_expands_to_nothing(self):
+        """spec.suspend: true — the controller creates no Jobs while set;
+        the static snapshot previously emitted one regardless."""
+        from simtpu.workloads.expand import make_valid_pods_by_cron_job
+
+        assert make_valid_pods_by_cron_job(self._cron(suspend=True)) == []
+        # explicit false behaves like absent
+        assert len(make_valid_pods_by_cron_job(self._cron(suspend=False))) == 1
+
+    def test_malformed_schedule_is_one_line_spec_error(self):
+        from simtpu.core.objects import ResourceTypes
+        from simtpu.workloads.validate import SpecError
+
+        res = ResourceTypes()
+        res.cron_jobs = [self._cron(schedule="every 5 minutes")]
+        with pytest.raises(SpecError) as exc:
+            get_valid_pods_exclude_daemonset(res)
+        msg = str(exc.value)
+        assert "spec.schedule" in msg and "ns/tick" in msg
+        assert "\n" not in msg
+
+    @pytest.mark.parametrize(
+        "expr",
+        ["* * * *", "61 * * * *", "* 24 * * *", "*/0 * * * *",
+         "5-1 * * * *", "a * * * *", ""],
+    )
+    def test_parser_rejects_bad_fields(self, expr):
+        from simtpu.workloads.cron import parse_schedule
+        from simtpu.workloads.validate import SpecError
+
+        with pytest.raises(SpecError):
+            parse_schedule(expr)
+
+    def test_parser_fire_enumeration(self):
+        from simtpu.workloads.cron import fire_times, parse_schedule
+
+        # */15: four fires per hour, strictly-after-start semantics
+        sched = parse_schedule("*/15 * * * *")
+        fires = fire_times(sched, 0.0, 3600.0)
+        assert fires == [900.0, 1800.0, 2700.0, 3600.0]
+        # lists + ranges + steps
+        sched = parse_schedule("5,35 1-3/2 * * *")
+        fires = fire_times(sched, 0.0, 86400.0)
+        assert fires == [
+            1 * 3600 + 5 * 60, 1 * 3600 + 35 * 60,
+            3 * 3600 + 5 * 60, 3 * 3600 + 35 * 60,
+        ]
+        # macros resolve through the same grammar
+        assert fire_times(parse_schedule("@hourly"), 0.0, 7200.0) == [
+            3600.0, 7200.0,
+        ]
+
+    def test_parser_dom_dow_or_rule(self):
+        """Classic cron: when BOTH day fields are restricted, either
+        matching fires.  Epoch day 0 (1970-01-01) is a Thursday."""
+        from simtpu.workloads.cron import fire_times, parse_schedule
+
+        # dom=2 OR dow=thu; window covers Thu Jan 1 .. Fri Jan 2
+        sched = parse_schedule("0 0 2 * thu")
+        fires = fire_times(sched, -1.0, 2 * 86400.0)
+        assert fires == [0.0, 86400.0]  # Thu (dow) and the 2nd (dom)
+        # dow restricted alone: Sundays only (Jan 4 1970)
+        sched = parse_schedule("0 12 * * 0")
+        fires = fire_times(sched, 0.0, 7 * 86400.0)
+        assert fires == [3 * 86400 + 12 * 3600.0]
+
+    def test_starting_deadline_window(self):
+        """startingDeadlineSeconds reaches back before the window start:
+        a fire missed by less than the deadline still surfaces (at its
+        original schedule time), one missed by more does not."""
+        from simtpu.workloads.cron import fire_times, parse_schedule
+
+        sched = parse_schedule("0 * * * *")  # hourly on the hour
+        # window opens 30 min past an hourly fire
+        start = 3600.0 + 1800.0
+        got = fire_times(sched, start, start + 3600.0, starting_deadline_s=2700.0)
+        assert got[0] == 3600.0  # missed 30 min ago, within the 45-min deadline
+        got = fire_times(sched, start, start + 3600.0, starting_deadline_s=600.0)
+        assert got[0] == 7200.0  # 10-min deadline: the missed fire is gone
+
+    def test_impossible_schedule_has_no_fires(self):
+        from simtpu.workloads.cron import parse_schedule
+
+        sched = parse_schedule("0 0 31 2 *")  # Feb 31st never exists
+        assert sched.next_fire(0.0, limit_days=900) is None
