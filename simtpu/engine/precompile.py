@@ -284,7 +284,7 @@ def _plan_scan_jobs(
         _sliced_statics_fields,
         flatten_wave_segments,
         plan_scan_chunks,
-        wave_pod_mask,
+        wave_eligibility,
         wave_static_spec,
     )
 
@@ -295,7 +295,7 @@ def _plan_scan_jobs(
     name, fn, tail = engine._aot_scan(flags)
     wave_ok = None
     if getattr(engine, "speculate", False):
-        wave_ok = wave_pod_mask(
+        wave_ok = wave_eligibility(
             pods if pods_rows is None else pods_rows, groups, tensors
         )
     for c0, c1, gs_p, rows_p, waves in plan_scan_chunks(
@@ -330,7 +330,8 @@ def _plan_scan_jobs(
             seg = _pods_sds(pods, _pow2_up(b - a))
             if kind == "wave":
                 w_name, w_fn, w_tail = engine._aot_wave(
-                    flags, wave_static_spec(tensors, w_mode[0], w_mode[1])
+                    flags,
+                    wave_static_spec(tensors, w_mode[0], w_mode[1], w_mode[2]),
                 )
                 pipe.submit(w_name, w_tail, w_fn, (eff, state_c, seg))
             else:

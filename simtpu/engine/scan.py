@@ -56,12 +56,16 @@ from .state import (
     SchedState,
     add_rows,
     apply_placement_deltas,
+    apply_placement_deltas_compact,
     build_state,
+    compact_delta_spec,
     compact_enabled,
     compact_spec,
     compress_state,
+    delta_direct_enabled,
     expand_state,
     interpod_term_index,
+    node_dom_for,
     node_dom_small_for,
     pack_delta_entries,
     state_nbytes,
@@ -148,7 +152,10 @@ def fetch_outputs(tree):
 # at least one divergence.  Backing store: registry counters
 # `wavefront.*` (ISSUE 8; read via `obs.metrics.family("wavefront",
 # WAVE_KEYS)` — the legacy `wave_counts()` alias view is gone).
-WAVE_KEYS = ("wavefronts", "pods", "accepted", "rollbacks", "rollback_pods")
+WAVE_KEYS = (
+    "wavefronts", "pods", "accepted", "rollbacks", "rollback_pods",
+    "draft_hard",
+)
 _WAVE = {k: REGISTRY.counter(f"wavefront.{k}") for k in WAVE_KEYS}
 
 
@@ -159,6 +166,29 @@ def wave_enabled() -> bool:
     import os
 
     return os.environ.get("SIMTPU_WAVEFRONT", "1") != "0"
+
+
+def wave_heavy_enabled() -> bool:
+    """SIMTPU_WAVE_HEAVY=0 restricts wavefront drafting back to LEAN pods
+    (no storage/GPU demand, no ports/volume groups).  1/unset drafts the
+    heavy families too, through the hard verifier's per-step stage
+    recomputes — placements are bit-identical either way; the switch
+    exists for A/B measurement."""
+    import os
+
+    return os.environ.get("SIMTPU_WAVE_HEAVY", "1") != "0"
+
+
+def fused_cascade_enabled() -> bool:
+    """SIMTPU_FUSED_CASCADE=0 compiles the per-step filter/score cascade
+    with one lax.cond per skippable stage (the pre-round-16 form); 1/unset
+    merges adjacent same-shape conds into single wider branches so each
+    serial step issues fewer kernels.  Placements are bit-identical either
+    way (every skip constant equals the skipped kernel's degenerate
+    output); the switch exists for A/B measurement."""
+    import os
+
+    return os.environ.get("SIMTPU_FUSED_CASCADE", "1") != "0"
 
 
 REASON_TEXT = {
@@ -475,6 +505,12 @@ class StepFlags(NamedTuple):
     # (neither ≤ DOM_SMALL domains nor unique-per-node); False removes the
     # [Tc, D] scatter/gather pair from the bulk round entirely
     dom_fallback: bool = True
+    # merge adjacent same-shape lax.cond stages of the filter/score cascade
+    # into single wider branches (fewer dispatches per serial step); the
+    # merged form is bit-identical — every skip constant equals the skipped
+    # kernel's degenerate output, so evaluating a dormant term inside a
+    # taken branch reproduces the constant exactly
+    fused: bool = True
 
 
 def flags_from(tensors: ClusterTensors, batch_ext: dict) -> StepFlags:
@@ -511,6 +547,7 @@ def flags_from(tensors: ClusterTensors, batch_ext: dict) -> StepFlags:
         node_pref=bool(tensors.node_pref_score.any()),
         taint_pref=bool(tensors.taint_intolerable.any()),
         static_score=bool(tensors.static_score.any() or tensors.avoid_pen.any()),
+        fused=fused_cascade_enabled(),
     )
 
 
@@ -595,11 +632,15 @@ def score_pod(
     if f.taint_pref:
         score += w_[5] * taint_toleration_score(statics.taint_intol[g], m_all)
     n = statics.alloc.shape[0]
+    # the three count-plane terms below are each individually skippable per
+    # pod (lax.cond) — collected as (weight index, live predicate, live fn,
+    # skip-constant fn) so the fused cascade can merge them into ONE cond
+    soft_terms = []
     if (f.interpod_pref or f.interpod_req) and t_cap:
-        # per-pod skip (lax.cond): a pod whose group carries no interpod
-        # terms gets raw 0 → maxabs-normalized 0 — identical constants
-        # without streaming the [Tc, N] own planes
-        def _ipa_term(_):
+        # per-pod skip: a pod whose group carries no interpod terms gets
+        # raw 0 → maxabs-normalized 0 — identical constants without
+        # streaming the [Tc, N] own planes
+        def _ipa_term():
             # [Tc] rows in the compacted own planes; -1 (non-interpod/pad)
             # gathers as zeros through the one-hot matmul
             ip_eff = jnp.where(tvalid, statics.ip_of[tsafe], -1)
@@ -625,30 +666,54 @@ def score_pod(
             | jnp.any(statics.a_anti_req[g])
             | jnp.any(statics.s_match[g] & (ip_eff_s >= 0))
         )
-        score += w_[6] * jax.lax.cond(
-            has_ip, _ipa_term, lambda _: jnp.zeros(n, score.dtype), None
+        soft_terms.append(
+            (6, has_ip, _ipa_term, lambda: jnp.zeros(n, score.dtype))
         )
-    # PodTopologySpread soft constraints, registry weight 2 by default
     if f.spread_soft and t_cap:
+        # PodTopologySpread soft constraints, registry weight 2 by default:
         # zero soft terms → raw 0 → the inverse-min-max degenerates to the
         # constant MAX_NODE_SCORE; skip the [Tc, N] stream for such pods
-        score += w_[7] * jax.lax.cond(
+        soft_terms.append((
+            7,
             jnp.any(statics.spread_soft[g] > 0),
-            lambda _: topology_spread_score(cnt_sub, statics.spread_soft[g], m_all),
-            lambda _: jnp.full(n, MAX_NODE_SCORE, score.dtype),
-            None,
-        )
-    # SelectorSpread (default workload/service spreading, weight 1)
+            lambda: topology_spread_score(cnt_sub, statics.spread_soft[g], m_all),
+            lambda: jnp.full(n, MAX_NODE_SCORE, score.dtype),
+        ))
     if f.selector_spread and t_cap:
+        # SelectorSpread (default workload/service spreading, weight 1):
         # zero ss terms → max counts 0 → constant MAX_NODE_SCORE
-        score += w_[8] * jax.lax.cond(
+        soft_terms.append((
+            8,
             jnp.any(statics.ss_host[g]) | jnp.any(statics.ss_zone[g]),
-            lambda _: selector_spread_score(
+            lambda: selector_spread_score(
                 cnt_sub, statics.ss_host[g], statics.ss_zone[g], m_all
             ),
-            lambda _: jnp.full(n, MAX_NODE_SCORE, score.dtype),
+            lambda: jnp.full(n, MAX_NODE_SCORE, score.dtype),
+        ))
+    if f.fused and len(soft_terms) > 1:
+        # one cond for every count-plane term: a dormant term evaluated in
+        # the live branch reproduces its skip constant exactly (see the
+        # per-term notes above), so the merge is bit-identical while
+        # dispatching one branch pair instead of three
+        any_live = soft_terms[0][1]
+        for _, pred, _, _ in soft_terms[1:]:
+            any_live = any_live | pred
+        vals = jax.lax.cond(
+            any_live,
+            lambda _: tuple(fn() for _, _, fn, _ in soft_terms),
+            lambda _: tuple(fn() for _, _, _, fn in soft_terms),
             None,
         )
+        for (wi, _, _, _), val in zip(soft_terms, vals):
+            score += w_[wi] * val
+    else:
+        for wi, pred, live, skip in soft_terms:
+            score += w_[wi] * jax.lax.cond(
+                pred,
+                lambda _, fn=live: fn(),
+                lambda _, fn=skip: fn(),
+                None,
+            )
     # ImageLocality + NodePreferAvoidPods (static per group)
     if f.static_score:
         score += w_[9] * statics.static_score[g] + w_[11] * statics.avoid_pen[g]
@@ -732,30 +797,68 @@ def filter_and_score(
     if f.storage:
         needs_storage = jnp.any(lvm_size > 0) | jnp.any(dev_size > 0)
 
-        def _storage_plan(_):
-            lvm_ok, lvm_alloc = lvm_plan(
-                state.vg_free, statics.vg_name_id, lvm_size, lvm_vg
-            )
-            dev_ok, dev_take, dev_tight = device_plan(
-                state.sdev_free,
-                statics.sdev_cap,
-                statics.sdev_media,
-                dev_size,
-                dev_media,
-            )
-            return statics.has_storage & lvm_ok & dev_ok, lvm_alloc, dev_take, dev_tight
+        if f.fused:
+            # fused form: plan + the raw Open-Local score share ONE branch
+            # pair.  The skip branch's raw 0 min-max-normalizes to exactly
+            # 0 — the split form's separate score-skip constant — so the
+            # later storage term needs no second cond
+            def _storage_plan(_):
+                lvm_ok, lvm_alloc = lvm_plan(
+                    state.vg_free, statics.vg_name_id, lvm_size, lvm_vg
+                )
+                dev_ok, dev_take, dev_tight = device_plan(
+                    state.sdev_free,
+                    statics.sdev_cap,
+                    statics.sdev_media,
+                    dev_size,
+                    dev_media,
+                )
+                raw = open_local_score(
+                    lvm_alloc,
+                    statics.vg_cap,
+                    dev_tight,
+                    jnp.sum(lvm_size > 0),
+                    jnp.sum(dev_size > 0),
+                )
+                return statics.has_storage & lvm_ok & dev_ok, lvm_alloc, dev_take, raw
 
-        def _storage_skip(_):
-            return (
-                jnp.ones(n, bool),
-                jnp.zeros_like(statics.vg_cap),
-                jnp.zeros(statics.sdev_cap.shape, bool),
-                jnp.zeros(n, statics.vg_cap.dtype),
-            )
+            def _storage_skip(_):
+                return (
+                    jnp.ones(n, bool),
+                    jnp.zeros_like(statics.vg_cap),
+                    jnp.zeros(statics.sdev_cap.shape, bool),
+                    jnp.zeros(n, statics.vg_cap.dtype),
+                )
 
-        storage_ok, lvm_alloc, dev_take, dev_tight = jax.lax.cond(
-            needs_storage, _storage_plan, _storage_skip, None
-        )
+            storage_ok, lvm_alloc, dev_take, storage_raw = jax.lax.cond(
+                needs_storage, _storage_plan, _storage_skip, None
+            )
+        else:
+
+            def _storage_plan(_):
+                lvm_ok, lvm_alloc = lvm_plan(
+                    state.vg_free, statics.vg_name_id, lvm_size, lvm_vg
+                )
+                dev_ok, dev_take, dev_tight = device_plan(
+                    state.sdev_free,
+                    statics.sdev_cap,
+                    statics.sdev_media,
+                    dev_size,
+                    dev_media,
+                )
+                return statics.has_storage & lvm_ok & dev_ok, lvm_alloc, dev_take, dev_tight
+
+            def _storage_skip(_):
+                return (
+                    jnp.ones(n, bool),
+                    jnp.zeros_like(statics.vg_cap),
+                    jnp.zeros(statics.sdev_cap.shape, bool),
+                    jnp.zeros(n, statics.vg_cap.dtype),
+                )
+
+            storage_ok, lvm_alloc, dev_take, dev_tight = jax.lax.cond(
+                needs_storage, _storage_plan, _storage_skip, None
+            )
         m_storage = m_bind & storage_ok
     else:
         lvm_alloc = jnp.zeros_like(statics.vg_cap)
@@ -786,25 +889,23 @@ def filter_and_score(
         gpu_shares = jnp.zeros_like(state.gpu_free)
 
     # PodTopologySpread hard constraints (filtering.go); eligible-domain
-    # minimum taken over nodes passing the pod's static filters
-    m_spread = m_gpu
-    if f.spread_hard and t_cap:
-        # maxSkew 0 = inactive on every term → all-True; per-pod skip of
-        # the [Tc, N] streams (lax.cond)
-        m_spread = m_gpu & jax.lax.cond(
-            jnp.any(statics.spread_hard[g] > 0),
-            lambda _: topology_spread_filter(
-                cnt_sub, valid_sub, statics.spread_hard[g], m_static
-            ),
-            lambda _: jnp.ones(n, bool),
-            None,
-        )
+    # minimum taken over nodes passing the pod's static filters.
+    # maxSkew 0 = inactive on every term → all-True; per-pod skip of
+    # the [Tc, N] streams (lax.cond)
+    sh_active = f.spread_hard and t_cap
+    ir_active = f.interpod_req and t_cap
+    if sh_active:
+        has_spread = jnp.any(statics.spread_hard[g] > 0)
 
-    m_all = m_spread
-    if f.interpod_req and t_cap:
+        def _spread_filter():
+            return topology_spread_filter(
+                cnt_sub, valid_sub, statics.spread_hard[g], m_static
+            )
+
+    if ir_active:
         ip_eff = jnp.where(tvalid, statics.ip_of[tsafe], -1)
 
-        def _ip_filter(_):
+        def _ip_filter():
             return interpod_filter(
                 cnt_sub,
                 take_rows(state.cnt_own_anti, ip_eff),
@@ -823,9 +924,36 @@ def filter_and_score(
             | jnp.any(statics.a_anti_req[g])
             | jnp.any(statics.s_match[g] & tvalid & (ip_eff >= 0))
         )
-        m_all = m_spread & jax.lax.cond(
-            touches_ip, _ip_filter, lambda _: jnp.ones(n, bool), None
+
+    if f.fused and sh_active and ir_active:
+        # fused form: one branch pair for both [Tc, N]-streaming filters.
+        # A dormant filter evaluated in the live branch is all-True (zero
+        # maxSkew / no touching terms), matching its skip constant exactly
+        spread_m, ip_m = jax.lax.cond(
+            has_spread | touches_ip,
+            lambda _: (_spread_filter(), _ip_filter()),
+            lambda _: (jnp.ones(n, bool), jnp.ones(n, bool)),
+            None,
         )
+        m_spread = m_gpu & spread_m
+        m_all = m_spread & ip_m
+    else:
+        m_spread = m_gpu
+        if sh_active:
+            m_spread = m_gpu & jax.lax.cond(
+                has_spread,
+                lambda _: _spread_filter(),
+                lambda _: jnp.ones(n, bool),
+                None,
+            )
+        m_all = m_spread
+        if ir_active:
+            m_all = m_spread & jax.lax.cond(
+                touches_ip,
+                lambda _: _ip_filter(),
+                lambda _: jnp.ones(n, bool),
+                None,
+            )
     feasible = jnp.any(m_all)
 
     # the Open-Local term is computed outside score_pod so the storage-free
@@ -834,24 +962,34 @@ def filter_and_score(
     score = score_pod(statics, state, g, req, m_all, flags)
     storage_term = 0.0
     if f.storage:
-        # zero claims → open_local_score is all-zero → the normalized term
-        # is exactly 0 everywhere; skip the [N, V] streams for such pods
-        def _storage_term(_):
-            storage_raw = open_local_score(
-                lvm_alloc,
-                statics.vg_cap,
-                dev_tight,
-                jnp.sum(lvm_size > 0),
-                jnp.sum(dev_size > 0),
+        if f.fused:
+            # the fused storage cond already produced the raw score (0 for
+            # storage-free pods, which min-max-normalizes to exactly 0 —
+            # the split form's skip constant); only the cheap [N] normalize
+            # remains outside the branch
+            storage_term = statics.score_w[10] * minmax_normalize(
+                storage_raw, m_all
             )
-            return statics.score_w[10] * minmax_normalize(storage_raw, m_all)
+        else:
+            # zero claims → open_local_score is all-zero → the normalized
+            # term is exactly 0 everywhere; skip the [N, V] streams for
+            # such pods
+            def _storage_term(_):
+                storage_raw = open_local_score(
+                    lvm_alloc,
+                    statics.vg_cap,
+                    dev_tight,
+                    jnp.sum(lvm_size > 0),
+                    jnp.sum(dev_size > 0),
+                )
+                return statics.score_w[10] * minmax_normalize(storage_raw, m_all)
 
-        storage_term = jax.lax.cond(
-            needs_storage,
-            _storage_term,
-            lambda _: jnp.zeros(n, statics.vg_cap.dtype),
-            None,
-        )
+            storage_term = jax.lax.cond(
+                needs_storage,
+                _storage_term,
+                lambda _: jnp.zeros(n, statics.vg_cap.dtype),
+                None,
+            )
 
     return StepEval(
         m_static=m_static,
@@ -1225,7 +1363,7 @@ def run_scan_chunked(
     t = int(tensors.n_terms)
     g_total = len(tensors.groups)
     wave_ok = (
-        wave_pod_mask(pods, groups, tensors) if wave_call is not None else None
+        wave_eligibility(pods, groups, tensors) if wave_call is not None else None
     )
     plan = list(
         plan_scan_chunks(groups, tensors, flags, chunk, row_budget, wave_ok)
@@ -1354,8 +1492,12 @@ def run_scan_chunked(
                 with span("scan.wave", pods=int(b - a)):
                     state, outs, accepts = wave_call(
                         eff_statics, state, seg, flags,
-                        wave_static_spec(tensors, w_mode[0], w_mode[1]),
+                        wave_static_spec(
+                            tensors, w_mode[0], w_mode[1], w_mode[2]
+                        ),
                     )
+                if w_mode[0]:
+                    _WAVE["draft_hard"].inc(int(b - a))
             else:
                 with span("scan.chunk", pods=int(b - a)):
                     state, outs = call(eff_statics, state, seg, flags)
@@ -1452,6 +1594,15 @@ def run_scan_chunked(
 #: general scan; mirrors RoundsEngine.MIN_RUN's reasoning)
 _WAVE_MIN = 8
 
+# heavy-drafting stage bits (wave_eligibility / the hard verifier's per-step
+# recomputes): a run whose pods carry any of these families is still
+# draftable — the hard verifier re-evaluates exactly the flagged stages per
+# step instead of relying on the lean run-constant hoists
+WAVE_HEAVY_PORTS = 1  # group requests host ports
+WAVE_HEAVY_VOLS = 2  # group has volume conflicts / attach limits
+WAVE_HEAVY_STORAGE = 4  # pod demands Open-Local LVM / device storage
+WAVE_HEAVY_GPU = 8  # pod demands GPU shares
+
 
 def wave_group_mask(tensors) -> np.ndarray:
     """[G] bool — groups whose pods can ride a wavefront: no host-port and
@@ -1476,11 +1627,16 @@ def wave_group_mask(tensors) -> np.ndarray:
 
 
 def wave_pod_mask(pods, groups: np.ndarray, tensors) -> np.ndarray:
-    """[P] bool — pods eligible for wavefront placement: lean (no
-    storage/GPU demand), unpinned, unforced, and of a wavefront-eligible
-    group.  Pure host-side numpy over the pod tuple
-    (`build_pod_arrays` layout)."""
+    """[P] bool — pods eligible for wavefront placement.  With heavy
+    drafting on (the default) only pinned/forced pods are excluded: the
+    hard verifier recomputes the storage/GPU/ports/volume stages per step,
+    so those families draft too.  With SIMTPU_WAVE_HEAVY=0 the pre-round-16
+    LEAN restriction applies: no storage/GPU demand, unpinned, unforced,
+    and of a port/volume-free group.  Pure host-side numpy over the pod
+    tuple (`build_pod_arrays` layout)."""
     ok = (np.asarray(pods[2]) == -1) & ~np.asarray(pods[3])
+    if wave_heavy_enabled():
+        return ok
     lvm = np.asarray(pods[4])
     if lvm.size:
         ok &= lvm.max(axis=1) <= 0
@@ -1490,6 +1646,53 @@ def wave_pod_mask(pods, groups: np.ndarray, tensors) -> np.ndarray:
     ok &= np.asarray(pods[8]) <= 0
     ok &= wave_group_mask(tensors)[groups]
     return ok
+
+
+def wave_group_heavy(tensors) -> np.ndarray:
+    """[G] int16 — per-group heavy stage bits (WAVE_HEAVY_PORTS / _VOLS):
+    which group-level constraint families the hard verifier must recompute
+    per step for a run of this group.  Memoized on the tensors object."""
+    cached = getattr(tensors, "_wave_heavy_cache", None)
+    if cached is not None:
+        return cached
+    g_n = len(tensors.groups)
+    bits = np.zeros(g_n, np.int16)
+    if tensors.n_ports:
+        bits |= np.where(
+            tensors.ports.any(axis=1), WAVE_HEAVY_PORTS, 0
+        ).astype(np.int16)
+    if tensors.n_vols:
+        vol = (
+            tensors.vol_rw.any(axis=1)
+            | tensors.vol_ro.any(axis=1)
+            | tensors.vol_att.any(axis=1)
+        )
+        bits |= np.where(vol, WAVE_HEAVY_VOLS, 0).astype(np.int16)
+    object.__setattr__(tensors, "_wave_heavy_cache", bits)
+    return bits
+
+
+def wave_eligibility(pods, groups: np.ndarray, tensors) -> np.ndarray:
+    """[P] int16 — -1 for wavefront-ineligible pods, else the heavy stage
+    bits the verifier needs for that pod (0 = pure LEAN).  The planner
+    breaks runs on value changes, so a run is homogeneous in both group and
+    heavy bits."""
+    ok = wave_pod_mask(pods, groups, tensors)
+    bits = np.zeros(len(ok), np.int16)
+    if wave_heavy_enabled():
+        bits = wave_group_heavy(tensors)[groups].astype(np.int16)
+        stor = np.zeros(len(ok), bool)
+        lvm = np.asarray(pods[4])
+        if lvm.size:
+            stor |= lvm.max(axis=1) > 0
+        dev = np.asarray(pods[6])
+        if dev.size:
+            stor |= dev.max(axis=1) > 0
+        bits = bits | np.where(stor, WAVE_HEAVY_STORAGE, 0).astype(np.int16)
+        bits = bits | np.where(
+            np.asarray(pods[8]) > 0, WAVE_HEAVY_GPU, 0
+        ).astype(np.int16)
+    return np.where(ok, bits, -1).astype(np.int16)
 
 
 def _wave_group_hard(tensors) -> np.ndarray:
@@ -1534,12 +1737,19 @@ def _plan_waves(
     groups: np.ndarray, wave_ok: np.ndarray, c0: int, c1: int,
     hard_g: np.ndarray, pref_g: np.ndarray, use_topo: bool, use_ip: bool,
 ):
-    """Maximal same-group runs of wavefront-eligible pods within [c0, c1),
-    length >= _WAVE_MIN, as absolute (a, b, hard, pref) entries."""
+    """Maximal same-group, same-eligibility runs of wavefront-eligible pods
+    within [c0, c1), length >= _WAVE_MIN, as absolute
+    (a, b, hard, pref, heavy) entries.  `wave_ok` is wave_eligibility's
+    int16 coding (-1 ineligible, else heavy bits); a bool mask (True/False
+    → 1/0 under comparison) keeps the pre-round-16 LEAN behaviour.  Any
+    heavy bit forces the hard verifier — the per-step stage recomputes live
+    there."""
     g = groups[c0:c1]
     if g.shape[0] == 0:
         return []
-    ok = wave_ok[c0:c1]
+    ok = np.asarray(wave_ok[c0:c1])
+    if ok.dtype == bool:
+        ok = np.where(ok, 0, -1).astype(np.int16)
     brk = np.flatnonzero((g[1:] != g[:-1]) | (ok[1:] != ok[:-1])) + 1
     starts = np.concatenate([[0], brk])
     ends = np.concatenate([brk, [len(g)]])
@@ -1547,28 +1757,29 @@ def _plan_waves(
         (
             int(c0 + a),
             int(c0 + b),
-            use_topo and bool(hard_g[g[a]]),
+            (use_topo and bool(hard_g[g[a]])) or int(ok[a]) != 0,
             use_ip and bool(pref_g[g[a]]),
+            int(ok[a]),
         )
         for a, b in zip(starts, ends)
-        if ok[a] and b - a >= _WAVE_MIN
+        if ok[a] >= 0 and b - a >= _WAVE_MIN
     ]
 
 
 def flatten_wave_segments(c0: int, c1: int, waves):
     """One chunk's dispatch order: ('scan'|'wave', a, b, mode) segments,
     wavefront runs interleaved with the general-scan remainders in pod
-    order (mode = (hard, pref) for waves, None for scan).  The SINGLE
+    order (mode = (hard, pref, heavy) for waves, None for scan).  The SINGLE
     source of the per-chunk dispatch sequence — run_scan_chunked executes
     it and the AOT enumerator (engine/precompile.py) walks the same list,
     so the precompiled signatures can never drift from the dispatched
     ones."""
     segs = []
     pos = c0
-    for wa, wb, w_hard, w_pref in waves:
+    for wa, wb, w_hard, w_pref, w_heavy in waves:
         if wa > pos:
             segs.append(("scan", pos, wa, None))
-        segs.append(("wave", wa, wb, (w_hard, w_pref)))
+        segs.append(("wave", wa, wb, (w_hard, w_pref, w_heavy)))
         pos = wb
     if pos < c1:
         segs.append(("scan", pos, c1, None))
@@ -1582,6 +1793,7 @@ def wavefront_scan(
     flags: StepFlags = StepFlags(),
     hard: bool = False,
     pref: bool = False,
+    heavy: int = 0,
     key_kinds=None,
     n_domains: int = 1,
 ):
@@ -1615,11 +1827,24 @@ def wavefront_scan(
       placement — the verifier recomputes the full filter cascade per step
       over the group's [Tc, N] slices, exactly like the general step.
 
-    `n_domains` (static) sizes the post-scan domain histogram."""
+    `n_domains` (static) sizes the post-scan domain histogram.
+
+    `heavy` (static bits, WAVE_HEAVY_*) marks the constraint families the
+    run carries that the lean hoists cannot cover: the planner forces
+    hard=True for any heavy run, and the hard verifier re-evaluates exactly
+    the flagged stages per step (ports / volume+attach masks against the
+    carried occupancy planes, the Open-Local storage planner, the GPU-share
+    planner) — the same kernels, flag gating, and skip-branch structure as
+    `filter_and_score`, so drafted placements stay bit-identical."""
     g_arr, req_arr, pin_arr, forced_arr = pods[0], pods[1], pods[2], pods[3]
     f = flags
     n = statics.alloc.shape[0]
     g = g_arr[0]
+    heavy = int(heavy)
+    heavy_ports = bool(heavy & WAVE_HEAVY_PORTS) and f.ports
+    heavy_vols = bool(heavy & WAVE_HEAVY_VOLS) and (f.vols or f.attach)
+    heavy_storage = bool(heavy & WAVE_HEAVY_STORAGE) and f.storage
+    heavy_gpu = bool(heavy & WAVE_HEAVY_GPU) and f.gpu
     use_topo = (
         f.spread_hard or f.spread_soft or f.selector_spread
         or f.interpod_req or f.interpod_pref
@@ -1646,9 +1871,12 @@ def wavefront_scan(
     # while it places; a lean pod's storage and GPU planners reduce to
     # their skip branches (all-true masks, zero plans).  Boolean AND is
     # exact, so pre-folding the constant stages is mask-identical.
+    # heavy stages opt OUT of the hoist (their occupancy planes move while
+    # the run places — the hard verifier recomputes them per step against
+    # the carried planes); the all-true placeholder keeps the folds inert
     ports_ok = (
         ports_conflict_free(state.ports_used, statics.ports_req[g])
-        if f.ports
+        if f.ports and not heavy_ports
         else jnp.ones(n, bool)
     )
     vol_ok = (
@@ -1656,7 +1884,7 @@ def wavefront_scan(
             state.vols_any, state.vols_rw,
             statics.vol_rw_req[g], statics.vol_ro_req[g],
         )
-        if f.vols
+        if f.vols and not heavy_vols
         else jnp.ones(n, bool)
     )
     att_ok = (
@@ -1664,11 +1892,20 @@ def wavefront_scan(
             state.vols_any, statics.vol_att_req[g],
             statics.vol_class_mask, statics.attach_limits,
         )
-        if f.attach
+        if f.attach and not heavy_vols
         else jnp.ones(n, bool)
     )
+    vol_mask_g = statics.vol_mask[g]
     m_ports = m_static & ports_ok
-    post_res = vol_ok & att_ok & statics.vol_mask[g]  # m_res -> m_bind fold
+    post_res = vol_ok & att_ok & vol_mask_g  # m_res -> m_bind fold
+    # heavy group rows (run-constant: same group throughout the run)
+    if heavy_ports:
+        want_ports = statics.ports_req[g]
+    if heavy_vols:
+        v_rw_g = statics.vol_rw_req[g]
+        v_ro_g = statics.vol_ro_req[g]
+        v_att_g = statics.vol_att_req[g]
+        v_present_g = v_rw_g | v_ro_g | v_att_g
     # identical specs ⇒ NodeResourcesFit and the two free-dependent score
     # terms change ONLY at the node the previous placement touched: both
     # are carried whole and row-updated per step (the kernels are row-
@@ -1745,9 +1982,14 @@ def wavefront_scan(
         fscore = fscore.at[safe].set(jnp.where(placed, frow[0], fscore[safe]))
         return m_fit, fscore, prev_fit, fit_row
 
-    if hard:
-        new_state, nodes, reasons = _wave_verify_hard(
-            statics, state, (req_arr, pin_arr, forced_arr), f,
+    if hard or heavy:
+        xs = [req_arr, pin_arr, forced_arr]
+        if heavy_storage:
+            xs += [pods[4], pods[5], pods[6], pods[7]]
+        if heavy_gpu:
+            xs += [pods[8], pods[9], pods[10]]
+        new_state, nodes, reasons, hextras = _wave_verify_hard(
+            statics, state, tuple(xs), f,
             locals(),
         )
     else:
@@ -1755,14 +1997,26 @@ def wavefront_scan(
             statics, state, (req_arr, pin_arr, forced_arr), f,
             locals(), pref, key_kinds, n_domains,
         )
+        hextras = {}
 
     w_pods = nodes.shape[0]
+    # heavy runs report real per-pod extended-resource plans (the hard
+    # verifier's per-step planners); lean runs report the exact zeros the
+    # general step's skip branches emit
     outs = (
         nodes,
         reasons,
-        jnp.zeros((w_pods, statics.vg_cap.shape[1]), statics.vg_cap.dtype),
-        jnp.zeros((w_pods, state.sdev_free.shape[1]), bool),
-        jnp.zeros((w_pods, state.gpu_free.shape[1]), state.gpu_free.dtype),
+        hextras.get(
+            "lvm",
+            jnp.zeros((w_pods, statics.vg_cap.shape[1]), statics.vg_cap.dtype),
+        ),
+        hextras.get(
+            "dev", jnp.zeros((w_pods, state.sdev_free.shape[1]), bool)
+        ),
+        hextras.get(
+            "gpu",
+            jnp.zeros((w_pods, state.gpu_free.shape[1]), state.gpu_free.dtype),
+        ),
     )
     # the speculative wavefront placement is the state_0 answer — what one
     # batched step would assign every pod of the identical-spec run; the
@@ -1774,7 +2028,15 @@ def wavefront_scan(
 def _wave_verify_hard(statics, state, xs, f, env):
     """The hard-mode verifier: full per-step recompute of the group's
     [Tc, N] filter/score slices (quota/affinity domains move domain-wide
-    per placement).  `env` carries wavefront_scan's hoists."""
+    per placement).  `env` carries wavefront_scan's hoists.
+
+    Heavy stage bits (env['heavy_*']) additionally carry the matching
+    occupancy planes (ports_used / vols / vg_free / sdev_free / gpu_free)
+    through the scan and re-evaluate exactly those cascade stages per step
+    — the same kernels and skip-branch structure as `filter_and_score`, so
+    storage/GPU/ports/volume runs place bit-identically to the serial
+    scan.  Returns (state, nodes, reasons, extras) with extras holding the
+    per-pod heavy resource plans ('lvm'/'dev'/'gpu') when carried."""
     (m_static, m_ports, post_res, simon_raw, node_pref_g, taint_g, sscore_g,
      avoid_g, m_fit0, fscore0, w_, alloc, fail_from, free_rows_update) = (
         env["m_static"], env["m_ports"], env["post_res"], env["simon_raw"],
@@ -1784,6 +2046,18 @@ def _wave_verify_hard(statics, state, xs, f, env):
     )
     t_cap = env["t_cap"]
     carry_ip = env["carry_ip"]
+    n = env["n"]
+    vol_ok, att_ok, vol_mask_g = env["vol_ok"], env["att_ok"], env["vol_mask_g"]
+    heavy_ports = env["heavy_ports"]
+    heavy_vols = env["heavy_vols"]
+    heavy_storage = env["heavy_storage"]
+    heavy_gpu = env["heavy_gpu"]
+    if heavy_ports:
+        want_ports = env["want_ports"]
+    if heavy_vols:
+        v_rw_g, v_ro_g, v_att_g, v_present_g = (
+            env["v_rw_g"], env["v_ro_g"], env["v_att_g"], env["v_present_g"]
+        )
     if t_cap:
         (terms_g, tvalid, tsafe, dom_sub, valid_sub, ip_eff, s_match_g,
          a_aff_g, a_anti_g, w_aff_g, w_anti_g, spread_hard_g, spread_soft_g,
@@ -1798,7 +2072,19 @@ def _wave_verify_hard(statics, state, xs, f, env):
         own0 = env["own0"]
 
     def vstep(carry, x):
-        req, pin, forced = x
+        it_x = iter(x)
+        req = next(it_x)
+        pin = next(it_x)
+        forced = next(it_x)
+        if heavy_storage:
+            lvm_size = next(it_x)
+            lvm_vg = next(it_x)
+            dev_size = next(it_x)
+            dev_media = next(it_x)
+        if heavy_gpu:
+            gpu_mem = next(it_x)
+            gpu_count = next(it_x)
+            gpu_preset = next(it_x)
         it = iter(carry)
         free = next(it)
         m_fit = next(it)
@@ -1810,15 +2096,113 @@ def _wave_verify_hard(statics, state, xs, f, env):
             own_anti, own_aff, w_own_a, w_own_n = (
                 next(it), next(it), next(it), next(it)
             )
+        if heavy_ports:
+            ports_used = next(it)
+        if heavy_vols:
+            vols_any = next(it)
+            if f.vols:
+                vols_rw = next(it)
+        if heavy_storage:
+            vg_free = next(it)
+            sdev_free = next(it)
+        if heavy_gpu:
+            gpu_free = next(it)
         # filter cascade — same stage structure (and flag gating) as
-        # filter_and_score, on the hoisted run-constant masks
-        m_res = m_ports & m_fit
-        m_bind = m_res & post_res
-        m_spread = m_bind
+        # filter_and_score: hoisted run-constant masks for the lean stages,
+        # per-step kernel recomputes against the carried occupancy planes
+        # for the heavy ones (boolean AND is associative, so folding the
+        # constant factors early is mask-identical)
+        mp = (
+            m_ports & ports_conflict_free(ports_used, want_ports)
+            if heavy_ports
+            else m_ports
+        )
+        m_res = mp & m_fit
+        vc = (
+            volume_conflict_free(vols_any, vols_rw, v_rw_g, v_ro_g)
+            if heavy_vols and f.vols
+            else vol_ok
+        )
+        al = (
+            attach_limits_ok(
+                vols_any, v_att_g,
+                statics.vol_class_mask, statics.attach_limits,
+            )
+            if heavy_vols and f.attach
+            else att_ok
+        )
+        m_vol = m_res & vc
+        m_att = m_vol & al
+        m_bind = m_att & vol_mask_g
+        if heavy_storage:
+            needs_storage = jnp.any(lvm_size > 0) | jnp.any(dev_size > 0)
+
+            # fused plan+raw branch pair — bit-identical to the general
+            # step's split conds (the skip raw 0 normalizes to exactly 0)
+            def _storage_plan(_):
+                lvm_ok, lvm_alloc = lvm_plan(
+                    vg_free, statics.vg_name_id, lvm_size, lvm_vg
+                )
+                dev_ok, dev_take, dev_tight = device_plan(
+                    sdev_free,
+                    statics.sdev_cap,
+                    statics.sdev_media,
+                    dev_size,
+                    dev_media,
+                )
+                raw = open_local_score(
+                    lvm_alloc,
+                    statics.vg_cap,
+                    dev_tight,
+                    jnp.sum(lvm_size > 0),
+                    jnp.sum(dev_size > 0),
+                )
+                return (
+                    statics.has_storage & lvm_ok & dev_ok,
+                    lvm_alloc, dev_take, raw,
+                )
+
+            def _storage_skip(_):
+                return (
+                    jnp.ones(n, bool),
+                    jnp.zeros_like(statics.vg_cap),
+                    jnp.zeros(statics.sdev_cap.shape, bool),
+                    jnp.zeros(n, statics.vg_cap.dtype),
+                )
+
+            storage_ok, lvm_alloc, dev_take, storage_raw = jax.lax.cond(
+                needs_storage, _storage_plan, _storage_skip, None
+            )
+            m_storage = m_bind & storage_ok
+        else:
+            m_storage = m_bind
+        if heavy_gpu:
+            is_gpu_pod = gpu_mem > 0
+
+            def _gpu_plan(_):
+                return gpu_plan(
+                    gpu_free,
+                    statics.gpu_dev_exists,
+                    statics.gpu_total,
+                    gpu_mem,
+                    gpu_count,
+                    gpu_preset,
+                )
+
+            def _gpu_skip(_):
+                return jnp.ones(n, bool), jnp.zeros_like(gpu_free)
+
+            gpu_ok, gpu_shares = jax.lax.cond(
+                is_gpu_pod, _gpu_plan, _gpu_skip, None
+            )
+            m_gpu = m_storage & gpu_ok
+        else:
+            m_gpu = m_storage
+        m_spread = m_gpu
         if f.spread_hard and t_cap:
             # unconditional kernel == the general step's lax.cond: with no
             # active skew terms every node passes (active = max_skew > 0)
-            m_spread = m_bind & topology_spread_filter(
+            m_spread = m_gpu & topology_spread_filter(
                 cnt_sub, valid_sub, spread_hard_g, m_static
             )
         m_all = m_spread
@@ -1852,17 +2236,45 @@ def _wave_verify_hard(statics, state, xs, f, env):
         if f.static_score:
             score += w_[9] * sscore_g + w_[11] * avoid_g
         score = jnp.where(m_all, score, -jnp.inf)
+        if heavy_storage:
+            # StepEval.score adds the Open-Local term after the -inf mask;
+            # identical accumulation position keeps the argmax bit-exact
+            score = score + w_[10] * minmax_normalize(storage_raw, m_all)
 
         chosen = jnp.where(forced, pin, jnp.argmax(score).astype(jnp.int32))
         placed = jnp.where(
             forced, (pin >= 0) & statics.node_valid[jnp.clip(pin, 0)], feasible
         )
-        fail = jax.lax.cond(
-            placed | forced,
-            lambda _: jnp.int32(OK),
-            lambda _: fail_from(m_res, m_spread),
-            None,
-        )
+        if heavy_ports or heavy_vols or heavy_storage or heavy_gpu:
+            # the lean fail_from's substituted identities no longer hold —
+            # walk StepEval.fail_code's reversed cascade on the per-step
+            # stage masks directly
+            def _fail_walk(_):
+                fl = jnp.int32(FAIL_INTERPOD)
+                for mask, code in (
+                    (m_spread, FAIL_SPREAD),
+                    (m_gpu, FAIL_GPU),
+                    (m_storage, FAIL_STORAGE),
+                    (m_bind, FAIL_VOLUME_BIND),
+                    (m_att, FAIL_ATTACH),
+                    (m_vol, FAIL_VOLUME),
+                    (m_res, FAIL_RESOURCES),
+                    (mp, FAIL_PORTS),
+                    (m_static, FAIL_STATIC),
+                ):
+                    fl = jnp.where(jnp.any(mask), fl, code)
+                return fl
+
+            fail = jax.lax.cond(
+                placed | forced, lambda _: jnp.int32(OK), _fail_walk, None
+            )
+        else:
+            fail = jax.lax.cond(
+                placed | forced,
+                lambda _: jnp.int32(OK),
+                lambda _: fail_from(m_res, m_spread),
+                None,
+            )
         reason = jnp.where(
             placed, OK, jnp.where(forced, FAIL_NO_NODE, fail)
         ).astype(jnp.int32)
@@ -1874,6 +2286,19 @@ def _wave_verify_hard(statics, state, xs, f, env):
         m_fit, fscore, _, _ = free_rows_update(
             free, m_fit, fscore, safe, req, placed
         )
+        if heavy_ports:
+            ports_used = ports_used.at[safe].add(want_ports * w)
+        if heavy_vols:
+            vols_any = vols_any.at[safe].add(v_present_g * w)
+            if f.vols:
+                vols_rw = vols_rw.at[safe].add(v_rw_g * w)
+        if heavy_storage:
+            vg_free = vg_free.at[safe].add(-lvm_alloc[safe] * w)
+            sdev_free = sdev_free.at[safe].set(
+                sdev_free[safe] & ~(dev_take[safe] & placed)
+            )
+        if heavy_gpu:
+            gpu_free = gpu_free.at[safe].add(-gpu_shares[safe] * gpu_mem * w)
         out_carry = [free, m_fit, fscore]
         if t_cap:
             dom_chosen = dom_sub[:, safe]
@@ -1895,15 +2320,50 @@ def _wave_verify_hard(statics, state, xs, f, env):
                 w_own_a = w_own_a + w_aff_g[:, None] * inc
                 w_own_n = w_own_n + w_anti_g[:, None] * inc
             out_carry += [own_anti, own_aff, w_own_a, w_own_n]
+        if heavy_ports:
+            out_carry.append(ports_used)
+        if heavy_vols:
+            out_carry.append(vols_any)
+            if f.vols:
+                out_carry.append(vols_rw)
+        if heavy_storage:
+            out_carry += [vg_free, sdev_free]
+        if heavy_gpu:
+            out_carry.append(gpu_free)
         out_node = jnp.where(placed, chosen, -1)
-        return tuple(out_carry), (out_node, reason)
+        out = (out_node, reason)
+        # per-pod extended-resource plans — schedule_step's output triplet
+        # entries for the carried heavy families
+        if heavy_storage:
+            out += (lvm_alloc[safe] * w, dev_take[safe] & placed)
+        if heavy_gpu:
+            out += (gpu_shares[safe] * w,)
+        return tuple(out_carry), out
 
     carry0 = [state.free, m_fit0, fscore0]
     if t_cap:
         carry0 += [cnt_sub0, ct0]
     if carry_ip:
         carry0 += list(own0)
-    carry_f, (nodes, reasons) = jax.lax.scan(vstep, tuple(carry0), xs)
+    if heavy_ports:
+        carry0.append(state.ports_used)
+    if heavy_vols:
+        carry0.append(state.vols_any)
+        if f.vols:
+            carry0.append(state.vols_rw)
+    if heavy_storage:
+        carry0 += [state.vg_free, state.sdev_free]
+    if heavy_gpu:
+        carry0.append(state.gpu_free)
+    carry_f, ys = jax.lax.scan(vstep, tuple(carry0), xs)
+    nodes, reasons = ys[0], ys[1]
+    extra_ys = list(ys[2:])
+    hextras = {}
+    if heavy_storage:
+        hextras["lvm"] = extra_ys.pop(0)
+        hextras["dev"] = extra_ys.pop(0)
+    if heavy_gpu:
+        hextras["gpu"] = extra_ys.pop(0)
 
     # fold the reduced carry back into the full state.  The count-row
     # deltas are small integers (counts / integer preference weights), so
@@ -1936,7 +2396,20 @@ def _wave_verify_hard(statics, state, xs, f, env):
             updates["w_own_anti_pref"] = add_rows(
                 state.w_own_anti_pref, ip_eff, own_f[3] - own0[3]
             )
-    return state._replace(**updates), nodes, reasons
+    # heavy occupancy planes were updated in place through the carry —
+    # the final carried values ARE the new planes
+    if heavy_ports:
+        updates["ports_used"] = next(it)
+    if heavy_vols:
+        updates["vols_any"] = next(it)
+        if f.vols:
+            updates["vols_rw"] = next(it)
+    if heavy_storage:
+        updates["vg_free"] = next(it)
+        updates["sdev_free"] = next(it)
+    if heavy_gpu:
+        updates["gpu_free"] = next(it)
+    return state._replace(**updates), nodes, reasons, hextras
 
 
 def _wave_verify_lean(statics, state, xs, f, env, pref, key_kinds, n_domains):
@@ -2310,7 +2783,7 @@ def _wave_verify_lean(statics, state, xs, f, env, pref, key_kinds, n_domains):
     return state._replace(**updates), nodes, reasons
 
 
-@partial(jax.jit, static_argnums=(3, 4, 5, 6, 7), donate_argnums=(1,))
+@partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8), donate_argnums=(1,))
 def _run_wavefront(
     statics: StaticArrays,
     state: SchedState,
@@ -2318,12 +2791,13 @@ def _run_wavefront(
     flags: StepFlags = StepFlags(),
     hard: bool = False,
     pref: bool = False,
+    heavy: int = 0,
     key_kinds=None,
     n_domains: int = 1,
 ):
     count_trace("wave")
     return wavefront_scan(
-        statics, state, pods, flags, hard, pref, key_kinds, n_domains
+        statics, state, pods, flags, hard, pref, heavy, key_kinds, n_domains
     )
 
 
@@ -2333,16 +2807,17 @@ def default_wave_call(statics, state, seg, flags, spec):
     return _run_wavefront(statics, state, seg, flags, *spec)
 
 
-def wave_static_spec(tensors, hard: bool, pref: bool) -> tuple:
+def wave_static_spec(tensors, hard: bool, pref: bool, heavy: int = 0) -> tuple:
     """The static specialization tail of one wavefront dispatch:
-    (hard, pref, key_kinds, n_domains).  key_kinds is the per-topology-key
-    reduction kind tuple when every key supports the tabular carry (kinds
-    1/2), else None (generic carried raws)."""
+    (hard, pref, heavy, key_kinds, n_domains).  key_kinds is the
+    per-topology-key reduction kind tuple when every key supports the
+    tabular carry (kinds 1/2), else None (generic carried raws); `heavy`
+    is the run's WAVE_HEAVY_* stage-recompute bits (0 = pure lean)."""
     kinds = tensors.key_kind
     key_kinds = None
     if kinds is not None and kinds.shape[0] and bool((kinds != 0).all()):
         key_kinds = tuple(int(x) for x in kinds)
-    return hard, pref, key_kinds, max(int(tensors.n_domains), 1)
+    return hard, pref, int(heavy), key_kinds, max(int(tensors.n_domains), 1)
 
 
 # Batch apply/undo of placement deltas lives in engine/state.py
@@ -2480,10 +2955,16 @@ class Engine:
     def _expand_call(self, spec_dev, cstate, nds):
         return expand_state(spec_dev, cstate, nds)
 
+    def _delta_direct_call(self, statics, dspec, ndom, nds, cstate, entries):
+        return apply_placement_deltas_compact(
+            statics, dspec, ndom, nds, cstate, entries
+        )
+
     def _expand_carry(self, tensors, cstate: CompactState) -> SchedState:
         """Dense view of a compact carry (padded node_dom_small follows the
         carry's own node axis — sharded carries stay shard-padded)."""
         spec = compact_spec(tensors)
+        REGISTRY.counter("state.expand").inc()
         return self._expand_call(
             spec.dev, cstate, node_dom_small_for(tensors, cstate.free.shape[0])
         )
@@ -2492,11 +2973,11 @@ class Engine:
         """Compress (when active) and gauge the carry place() stores."""
         dense_bytes = sum(state_nbytes(final_state).values())
         spec = self._active_compact_spec(tensors)
-        stored = (
-            final_state
-            if spec is None
-            else self._compress_call(spec.dev, final_state)
-        )
+        if spec is None:
+            stored = final_state
+        else:
+            REGISTRY.counter("state.compress").inc()
+            stored = self._compress_call(spec.dev, final_state)
         update_state_gauge(stored, dense_bytes)
         return stored
 
@@ -2700,6 +3181,29 @@ class Engine:
         )
         statics = statics_from(tensors, self.sched_config)
         state = self.last_state
+        if isinstance(state, CompactState) and delta_direct_enabled():
+            # direct compact-delta apply: scatter the packed deltas straight
+            # into the compact carry (per-domain histogram adds for kind-1
+            # term rows, dense row updates for kind-0/2) — no
+            # expand→apply→recompress round-trip.  Exact under the same
+            # domain-constancy invariant compression relies on.  The apply
+            # is non-donating (plan/incremental shares compact snapshots
+            # across probe engines), so a failure leaves the carry intact —
+            # but mirror the dirty guard anyway: a half-applied log is
+            # unrepresentable, a dirty flag is cheap.
+            n_carry = state.free.shape[0]
+            self._state_dirty = True
+            self.last_state = self._delta_direct_call(
+                statics,
+                compact_delta_spec(tensors),
+                node_dom_for(tensors, n_carry),
+                node_dom_small_for(tensors, n_carry),
+                state,
+                packed,
+            )
+            self._state_dirty = False
+            REGISTRY.counter("state.delta_direct").inc()
+            return
         if isinstance(state, CompactState):
             state = self._expand_carry(tensors, state)
         # a DENSE carry is donated to the delta dispatch below (the compact

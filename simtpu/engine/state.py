@@ -712,6 +712,213 @@ compress_state = jax.jit(_compress_state_fn)
 expand_state = jax.jit(_expand_state_fn)
 
 
+# -- direct compact-delta apply ----------------------------------------------
+#
+# Preemption's evict/restore pairs, timeline departure batches and fault
+# drains replay small packed delta batches against the carried state.  With
+# a compact carry the naive route is expand ([T, N] floats) → dense delta
+# scan → recompress — three full-plane passes to move a handful of counts.
+# The delta is instead applied STRAIGHT to the compact form: kind-1 term
+# rows are domain-constant, so the dense update (add w on every node of the
+# chosen node's domain) collapses to ONE histogram bucket add at
+# [row, node_dom_small[key, node]]; dense (kind 0/2) rows and the continuous
+# planes take the same per-row updates placement_delta_step issues, routed
+# through the inverse row maps below.  Exact under the domain-constancy
+# invariant compression already relies on, and exact in integer arithmetic:
+# every count delta is an integer-valued f32, so accumulating in COUNT_DTYPE
+# equals the dense f32 accumulate + truncating compress cast (pinned
+# bit-identical against the expand→apply→recompress route by
+# tests/test_state_deltas.py / tests/test_compact.py).
+#
+# SIMTPU_DELTA_DIRECT=0 falls the engines back to the round-trip route —
+# placements and carries are bit-identical either way; the switch exists for
+# A/B measurement (`make bench-scan`).
+
+
+def delta_direct_enabled() -> bool:
+    """Default for the engines' compact delta dispatch: SIMTPU_DELTA_DIRECT=0
+    re-routes compact preemption deltas through expand→apply→recompress
+    (1/unset = direct scatter)."""
+    return os.environ.get("SIMTPU_DELTA_DIRECT", "1") != "0"
+
+
+def node_dom_for(tensors, n: int) -> jnp.ndarray:
+    """tensors.node_dom as a device array whose node axis is padded to `n`
+    with -1 (absent), the full-domain-id companion of node_dom_small_for —
+    the direct delta path gathers both maps at the chosen node, and sharded
+    engines hand it a shard-padded carry width.  Memoized per width."""
+    cache = getattr(tensors, "_ndom_pad_cache", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(tensors, "_ndom_pad_cache", cache)
+    got = cache.get(n)
+    if got is None:
+        ndom = np.asarray(tensors.node_dom, np.int32)
+        if not ndom.shape[0]:
+            ndom = np.full((1, ndom.shape[1]), -1, np.int32)
+        pad = n - ndom.shape[1]
+        if pad:
+            ndom = np.pad(ndom, ((0, 0), (0, pad)), constant_values=-1)
+        got = cache[n] = jnp.asarray(ndom)
+    return got
+
+
+class CompactDeltaSpec(NamedTuple):
+    """Inverse row maps for scattering deltas into a CompactState: term axis
+    → carried-plane row (or -1 when the term has no row on that plane).
+    Device-resident, constant per tensors (memoized)."""
+
+    t_tab_of: jnp.ndarray  # [T] → row in cm_tab, -1 if dense
+    t_dense_of: jnp.ndarray  # [T] → row in cm_dense, -1 if tabular
+    ip_tab_of: jnp.ndarray  # [Ti] → row in the *_tab interpod planes
+    ip_dense_of: jnp.ndarray  # [Ti] → row in the *_dense interpod planes
+
+
+def compact_delta_spec(tensors) -> CompactDeltaSpec:
+    """The (memoized) inverse of compact_spec's row partition — built once
+    host-side from the same t_tab/t_dense/ip_tab/ip_dense orderings so
+    scatter targets agree with compression's row layout by construction."""
+    cached = getattr(tensors, "_compact_delta_spec_cache", None)
+    if cached is not None:
+        return cached
+    dev = compact_spec(tensors).dev
+    t = int(tensors.n_terms)
+
+    def inverse(ids, size):
+        ids = np.asarray(ids, np.int32)
+        of = np.full(size, -1, np.int32)
+        of[ids] = np.arange(len(ids), dtype=np.int32)
+        return jnp.asarray(of)
+
+    ti = int(len(np.asarray(dev.ip_tab)) + len(np.asarray(dev.ip_dense)))
+    spec = CompactDeltaSpec(
+        t_tab_of=inverse(dev.t_tab, t),
+        t_dense_of=inverse(dev.t_dense, t),
+        ip_tab_of=inverse(dev.ip_tab, ti),
+        ip_dense_of=inverse(dev.ip_dense, ti),
+    )
+    object.__setattr__(tensors, "_compact_delta_spec_cache", spec)
+    return spec
+
+
+def _scatter_rows_add(plane, rows, delta):
+    """plane.at[rows].add(delta) with -1 rows masked to no-ops, casting the
+    integer-valued f32 delta to the plane's dtype (exact below 2^24)."""
+    return plane.at[jnp.clip(rows, 0)].add(
+        jnp.where((rows >= 0)[:, None], delta, 0.0).astype(plane.dtype)
+    )
+
+
+def compact_delta_step(statics, dspec, ndom, nds, cstate: CompactState, entry):
+    """placement_delta_step retargeted at the compact carry: identical
+    continuous-plane updates (cast to the narrowed dtypes), topology counts
+    as single-bucket histogram adds for tabular rows and [Rd, N]-row
+    scatters for dense rows.  `ndom`/`nds` are the node_dom / node_dom_small
+    maps at the CARRY's node width (shard-padded when the engine pads)."""
+    g, node, w, req, vg_alloc, sdev_take, gpu_vec = entry
+    safe = jnp.clip(node, 0)
+    cd = COUNT_DTYPE
+    updates = {"free": cstate.free.at[safe].add(-req * w)}
+    if cstate.ports_used.shape[1]:
+        updates["ports_used"] = cstate.ports_used.at[safe].add(
+            (statics.ports_req[g] * w).astype(cd)
+        )
+    if cstate.vols_any.shape[1]:
+        v_rw = statics.vol_rw_req[g]
+        v_present = v_rw | statics.vol_ro_req[g] | statics.vol_att_req[g]
+        updates["vols_any"] = cstate.vols_any.at[safe].add(
+            (v_present * w).astype(cd)
+        )
+        updates["vols_rw"] = cstate.vols_rw.at[safe].add((v_rw * w).astype(cd))
+    if cstate.vg_free.shape[1]:
+        updates["vg_free"] = cstate.vg_free.at[safe].add(-vg_alloc * w)
+    if cstate.sdev_free.shape[1]:
+        row = cstate.sdev_free[safe]
+        row = jnp.where(w > 0, row & ~sdev_take, row | sdev_take)
+        updates["sdev_free"] = cstate.sdev_free.at[safe].set(row)
+    if cstate.gpu_free.shape[1]:
+        updates["gpu_free"] = cstate.gpu_free.at[safe].add(-gpu_vec * w)
+    t_cap = statics.g_terms.shape[1]
+    if t_cap:
+        terms_g = statics.g_terms[g]
+        tvalid = terms_g >= 0
+        tsafe = jnp.clip(terms_g, 0)
+        keys = jnp.clip(jnp.where(tvalid, statics.term_topo[tsafe], 0), 0)
+        # domain of the chosen node under each term's key, in both the full
+        # (node_dom) and small (node_dom_small) numbering — they agree on
+        # validity, and the small id IS the histogram bucket
+        dom_ch = jnp.where(tvalid, ndom[keys, safe], -1)
+        ds_ch = jnp.where(tvalid, nds[keys, safe], -1)
+        valid_ch = dom_ch >= 0
+        s_val = statics.s_match[g] * jnp.where(valid_ch, w, 0.0)
+        updates["cnt_total"] = cstate.cnt_total.at[tsafe].add(s_val.astype(cd))
+        # tabular rows: the dense update adds the same value on every node
+        # of the chosen domain, and compression gathers one representative —
+        # so the whole row update is one bucket add at the small domain id
+        t_row = jnp.where(tvalid, dspec.t_tab_of[tsafe], -1)
+        tab_ok = (t_row >= 0) & (ds_ch >= 0) & valid_ch
+        updates["cm_tab"] = cstate.cm_tab.at[
+            jnp.clip(t_row, 0), jnp.clip(ds_ch, 0)
+        ].add(jnp.where(tab_ok, s_val, 0.0).astype(cd))
+        ip_eff = jnp.where(tvalid, statics.ip_of[tsafe], -1)
+        wv = jnp.where(valid_ch, w, 0.0)
+        ip_vals = (
+            ("oa_tab", "oa_dense", statics.a_anti_req[g].astype(jnp.float32)),
+            ("of_tab", "of_dense", statics.a_aff_req[g].astype(jnp.float32)),
+            ("wa_tab", "wa_dense", statics.w_aff_pref[g]),
+            ("wn_tab", "wn_dense", statics.w_anti_pref[g]),
+        )
+        if cstate.oa_tab.shape[0]:
+            ip_row = jnp.where(
+                ip_eff >= 0, dspec.ip_tab_of[jnp.clip(ip_eff, 0)], -1
+            )
+            ipt_ok = (ip_row >= 0) & (ds_ch >= 0) & valid_ch
+            for tabf, _, vals in ip_vals:
+                updates[tabf] = getattr(cstate, tabf).at[
+                    jnp.clip(ip_row, 0), jnp.clip(ds_ch, 0)
+                ].add(jnp.where(ipt_ok, vals * wv, 0.0).astype(cd))
+        if cstate.cm_dense.shape[0] or cstate.oa_dense.shape[0]:
+            # dense (kind 0/2) rows keep the per-node same-domain compare —
+            # exactly placement_delta_step's, routed to the carried rows
+            dom_sub = ndom[keys]  # [Tc, Ncarry]
+            same = (
+                (dom_sub >= 0)
+                & tvalid[:, None]
+                & (dom_sub == dom_ch[:, None])
+                & valid_ch[:, None]
+            )
+            inc = jnp.where(same, w, 0.0)
+            if cstate.cm_dense.shape[0]:
+                d_row = jnp.where(tvalid, dspec.t_dense_of[tsafe], -1)
+                updates["cm_dense"] = _scatter_rows_add(
+                    cstate.cm_dense, d_row, statics.s_match[g][:, None] * inc
+                )
+            if cstate.oa_dense.shape[0]:
+                ipd_row = jnp.where(
+                    ip_eff >= 0, dspec.ip_dense_of[jnp.clip(ip_eff, 0)], -1
+                )
+                for _, densef, vals in ip_vals:
+                    updates[densef] = _scatter_rows_add(
+                        getattr(cstate, densef), ipd_row, vals[:, None] * inc
+                    )
+    return cstate._replace(**updates), ()
+
+
+def _apply_placement_deltas_compact_fn(statics, dspec, ndom, nds, cstate, entries):
+    cstate, _ = jax.lax.scan(
+        partial(compact_delta_step, statics, dspec, ndom, nds), cstate, entries
+    )
+    return cstate
+
+
+# NON-donating, like compress/expand above: the compact carry is routinely
+# shared (the incremental planner hands one snapshot to every probe engine,
+# the fault sweep reads the engine's carry without owning it) — donating it
+# here would invalidate those aliases.  The copy is of the SMALL form, still
+# a large net win over the dense round-trip.
+apply_placement_deltas_compact = jax.jit(_apply_placement_deltas_compact_fn)
+
+
 def ensure_dense(state, tensors):
     """The dense SchedState view of a FREE-STANDING carried state
     (expanding a CompactState through the memoized spec; dense states

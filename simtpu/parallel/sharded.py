@@ -37,6 +37,7 @@ from ..engine.scan import (
 )
 from ..engine.state import (
     CompactState,
+    _apply_placement_deltas_compact_fn,
     _compress_state_fn,
     _expand_state_fn,
 )
@@ -256,7 +257,7 @@ def build_sharded_wavefront(mesh: Mesh, flags: StepFlags, spec: tuple):
     """Compile the speculative wavefront call (scan.wavefront_scan — the
     verify-and-rollback batcher for same-group lean runs) with the node
     axis laid out over `mesh`.  `spec` is scan.wave_static_spec's
-    (hard, pref, key_kinds, n_domains) specialization tail.  Placements
+    (hard, pref, heavy, key_kinds, n_domains) specialization tail.  Placements
     stay bit-identical to the unsharded wavefront (dead-node padding is
     unselectable and the reduced carries shard with the node axis)."""
     st_spec = statics_sharding(mesh)
@@ -338,6 +339,22 @@ class _MeshMixin:
             ),
         )
         return fn(spec_dev, cstate, nds)
+
+    def _delta_direct_call(self, statics, dspec, ndom, nds, cstate, entries):
+        # mesh-compiled direct compact-delta apply: outputs keep the
+        # carried compact layout between batches.  The step reads no
+        # node-axis statics field (only group/term-axis rows), so pairing
+        # the unpadded statics with a shard-padded carry is safe — the
+        # explicit ndom/nds maps are built at carry width.  Non-donating,
+        # like the base call (shared compact snapshots).
+        fn = _cached_jit(
+            ("delta_direct", self.mesh),
+            lambda: jax.jit(
+                _apply_placement_deltas_compact_fn,
+                out_shardings=compact_state_sharding(self.mesh),
+            ),
+        )
+        return fn(statics, dspec, ndom, nds, cstate, entries)
 
     def _precompile_shapes(self, statics_sds, state_sds):
         """Shard-padded executable signatures for the precompiler: the

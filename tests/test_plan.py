@@ -560,3 +560,70 @@ class TestBinarySearchCapNonMonotone:
         assert "falling back" not in capsys.readouterr().err
         assert binary.success and linear.success
         assert binary.nodes_added == linear.nodes_added == 3
+
+
+class TestEngineBlockRound16:
+    """ADVICE r5 #1 residue (ISSUE 16): the round-16 A/B switches — heavy
+    wavefront drafting, the fused filter/score cascade, and the direct
+    compact-delta apply — are recorded in the --json engine block next to
+    the auto engine selection, so scripted consumers can detect every
+    non-reference-exact fast path from the JSON alone."""
+
+    def _plan(self):
+        from simtpu.plan import capacity as cap
+        from simtpu.synth import make_node, synth_apps, synth_cluster
+
+        cluster = synth_cluster(6, seed=63, zones=3, taint_frac=0.0)
+        apps = synth_apps(
+            120, seed=64, zones=3, pods_per_deployment=40,
+            selector_frac=0.0, toleration_frac=0.0, spread_frac=0.2,
+        )
+        template = make_node(
+            "tmpl", 64000, 256,
+            {"kubernetes.io/hostname": "tmpl",
+             "topology.kubernetes.io/zone": "zone-plan"},
+        )
+        applier = cap.Applier.__new__(cap.Applier)
+        applier.opts = cap.ApplierOptions(search="incremental", precompile=False)
+        applier.load_apps = lambda: list(apps)
+        applier.load_cluster = lambda: cluster
+        applier.load_new_node = lambda: template
+        return applier.run()
+
+    def test_round16_switches_recorded_in_json(self):
+        import json
+
+        from simtpu.cli import _plan_json
+
+        plan = self._plan()
+        assert plan.success, plan.message
+        doc = json.loads(_plan_json(plan))
+        eng = doc["engine"]
+        # the auto-selection record rides alongside the new switches
+        assert {"search", "auto_search", "auto_bulk"} <= set(eng)
+        assert eng["auto_search"] is False  # explicit search= above
+        # round-16 switches: booleans mirroring the env A/B levers
+        assert eng["wave_heavy"] is True
+        assert eng["fused_cascade"] is True
+        dd = eng["delta_direct"]
+        assert dd["enabled"] is True
+        for key in ("applied", "expand", "compress"):
+            assert isinstance(dd[key], int) and dd[key] >= 0
+        # the wavefront family carries the new hard-drafting counter
+        assert "draft_hard" in eng["wavefront"]
+        assert eng["wavefront"]["draft_hard"] >= 0
+
+    def test_switch_state_follows_env(self, monkeypatch):
+        import json
+
+        from simtpu.cli import _plan_json
+
+        monkeypatch.setenv("SIMTPU_WAVE_HEAVY", "0")
+        monkeypatch.setenv("SIMTPU_FUSED_CASCADE", "0")
+        monkeypatch.setenv("SIMTPU_DELTA_DIRECT", "0")
+        doc = json.loads(_plan_json(self._plan()))
+        eng = doc["engine"]
+        assert eng["wave_heavy"] is False
+        assert eng["fused_cascade"] is False
+        assert eng["delta_direct"]["enabled"] is False
+        assert eng["delta_direct"]["applied"] == 0

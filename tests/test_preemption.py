@@ -522,3 +522,89 @@ def test_wave_cap_abort_tags_failures_distinctly(caplog):
         ResourceTypes(nodes=[node], pods=fillers + [vip])
     )
     assert not result2.unscheduled_pods
+
+
+def test_preemption_under_compact_rides_direct_delta(monkeypatch):
+    """ISSUE 16 tentpole: with a compact carry, the batched eviction delta
+    and every restore of a rejected wave ride the DIRECT compact apply —
+    the expand -> apply -> recompress round trip never runs on the hot
+    path (state.delta_direct > 0, state.expand/compress unchanged during
+    the replay), and the full simulation outcome (placements, evictions,
+    unscheduled set) is bit-identical to the SIMTPU_DELTA_DIRECT=0 path."""
+    from simtpu.core.objects import AppResource
+    from simtpu.obs.metrics import REGISTRY
+    from simtpu.synth import make_deployment, make_node
+    from simtpu.workloads.expand import seed_name_hashes
+
+    def run():
+        n = 24
+        cluster = ResourceTypes()
+        cluster.nodes = [
+            make_node(
+                f"node-{i:06d}",
+                4000,
+                16,
+                {
+                    "topology.kubernetes.io/zone": f"zone-{i % 4}",
+                    "kubernetes.io/hostname": f"node-{i:06d}",
+                },
+            )
+            for i in range(n)
+        ]
+        # zone spread gives the problem tabular topology terms, so the
+        # carry compresses; the capacity squeeze forces real preemptions
+        low = make_deployment(
+            "low", n * 4, 1000, 512, priority=10,
+            spread_topo="topology.kubernetes.io/zone",
+        )
+        high = make_deployment(
+            "high", 16, 2000, 1024, priority=1000,
+            spread_topo="topology.kubernetes.io/zone",
+        )
+        res_low = ResourceTypes()
+        res_low.deployments = [low]
+        res_high = ResourceTypes()
+        res_high.deployments = [high]
+        apps = [
+            AppResource(name="low", resource=res_low),
+            AppResource(name="high", resource=res_high),
+        ]
+        seed_name_hashes(3)
+        before = REGISTRY.snapshot()
+        out = simulate(cluster, apps, bulk=True)
+        after = REGISTRY.snapshot()
+        delta = {
+            k: after.get(k, 0) - before.get(k, 0)
+            for k in ("state.delta_direct", "state.expand", "state.compress")
+        }
+        placements = tuple(sorted(_placements(out).items()))
+        evicted = tuple(
+            sorted(p.pod["metadata"]["name"] for p in out.preempted_pods)
+        )
+        unsched = tuple(
+            sorted(p["metadata"]["name"] for p in out.unscheduled_pods)
+        )
+        return delta, placements, evicted, unsched
+
+    monkeypatch.setenv("SIMTPU_DELTA_DIRECT", "1")
+    d_direct, p_direct, e_direct, u_direct = run()
+    assert e_direct, "scenario produced no preemptions — not exercising the path"
+    assert d_direct["state.delta_direct"] > 0, d_direct
+
+    monkeypatch.setenv("SIMTPU_DELTA_DIRECT", "0")
+    d_ab, p_ab, e_ab, u_ab = run()
+    assert d_ab["state.delta_direct"] == 0, d_ab
+    # the placement dispatches themselves still expand/compress once per
+    # round (the kernels run dense) — identically on both paths; every
+    # EXTRA round trip in the A/B run is a delta replay the direct path
+    # eliminated.  (tests/test_state_deltas.py pins the exact zero around
+    # remove/restore in isolation.)
+    extra = d_ab["state.expand"] - d_direct["state.expand"]
+    assert extra >= d_direct["state.delta_direct"], (d_direct, d_ab)
+    assert d_ab["state.compress"] - d_direct["state.compress"] == extra, (
+        d_direct,
+        d_ab,
+    )
+    assert p_direct == p_ab
+    assert e_direct == e_ab
+    assert u_direct == u_ab

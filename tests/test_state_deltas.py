@@ -326,3 +326,254 @@ class TestInterleavedApplyUndo:
         keep[b_idx] = False
         want = self._rebuilt(placed, keep)
         self._assert_states_equal(state, want, "re-admission interleave")
+
+
+def _pack(placed, entries, sign):
+    tensors = placed.tensors
+    ext = tensors.ext
+    return pack_delta_entries(
+        entries,
+        tensors.alloc.shape[1],
+        ext.vg_cap.shape[1],
+        ext.sdev_cap.shape[1],
+        ext.gpu_dev_total.shape[1],
+        sign,
+    )
+
+
+def _rebuilt_from_log(placed, keep_mask):
+    import numpy as np
+
+    from simtpu.engine.state import build_state
+
+    eng = placed.engine
+    tensors = placed.tensors
+    keep = np.flatnonzero(keep_mask)
+    r = tensors.alloc.shape[1]
+    req = eng.log_req_matrix(r)[keep]
+    ext = {k: [eng.ext_log[k][int(i)] for i in keep] for k in eng.ext_log}
+    return build_state(
+        tensors,
+        np.asarray(eng.placed_group, np.int32)[keep],
+        np.asarray(eng.placed_node, np.int32)[keep],
+        req,
+        ext,
+    )
+
+
+@pytest.fixture(scope="module")
+def placed_wide():
+    """> DOM_SMALL (64) nodes with hostname-keyed anti-affinity terms: the
+    hostname topology key has one value PER NODE, so its rows compress as
+    kind-2 DENSE rows — the fixture that exercises compact_delta_step's
+    dense-row branch (the 9-node fixture above is all-tabular)."""
+    cluster = synth_cluster(
+        80, seed=61, zones=4, taint_frac=0.0, gpu_frac=0.2, storage_frac=0.3
+    )
+    apps = synth_apps(
+        40,
+        seed=62,
+        zones=4,
+        pods_per_deployment=6,
+        selector_frac=0.1,
+        anti_affinity_frac=0.6,
+        anti_affinity_hard_frac=0.4,
+        spread_frac=0.4,
+        spread_hard_frac=0.5,
+        gpu_frac=0.1,
+        storage_frac=0.2,
+    )
+    return place_cluster(cluster, apps)
+
+
+class TestDirectCompactDelta:
+    """ISSUE 16 tentpole: packed placement deltas applied DIRECTLY to the
+    compact carry (per-domain scatter into the [Rt, D] tabular histograms,
+    plain row updates for the dense rows) must be bit-identical to the
+    expand -> apply_placement_deltas -> recompress round trip AND to a
+    from-scratch build_state rebuild.  Preemption evictions/restores,
+    timeline departures and fault drains all replay this arithmetic."""
+
+    def _assert_equal(self, got, want, label):
+        for name in want._fields:
+            g = np.asarray(getattr(got, name))
+            w = np.asarray(getattr(want, name))
+            assert g.dtype == w.dtype, (label, name)
+            assert np.array_equal(g, w), (
+                f"{label}: compact plane {name} not bit-identical "
+                f"(max delta "
+                f"{np.max(np.abs(g.astype(np.float64) - w.astype(np.float64)))})"
+            )
+
+    def _run_interleave(self, placed, expect_dense):
+        """-A, -B, +A out of stack order, then +B, -B re-admission churn:
+        direct compact apply vs the dense round-trip oracle at every step."""
+        import jax
+        import jax.numpy as jnp
+
+        from simtpu.engine.state import (
+            apply_placement_deltas_compact,
+            compact_delta_spec,
+            compact_spec,
+            compress_state,
+            expand_state,
+            node_dom_for,
+            node_dom_small_for,
+        )
+
+        eng = placed.engine
+        tensors = placed.tensors
+        statics = statics_from(tensors, eng.sched_config)
+        spec = compact_spec(tensors)
+        assert spec.enabled, "fixture must be compact-eligible"
+        n = tensors.alloc.shape[0]
+        ndom = node_dom_for(tensors, n)
+        nds = node_dom_small_for(tensors, n)
+        dspec = compact_delta_spec(tensors)
+        base = eng.carried_state()
+        direct = compress_state(spec.dev, base)
+        if expect_dense:
+            assert int(direct.cm_dense.shape[0]) > 0, (
+                "wide fixture grew no dense compact rows — the dense "
+                "branch of compact_delta_step is not exercised"
+            )
+        else:
+            assert int(direct.cm_dense.shape[0]) == 0
+        dense = jax.tree_util.tree_map(jnp.copy, base)
+        m = len(eng.placed_node)
+        a_idx = list(range(0, m, 4))
+        b_idx = list(range(1, m, 4))
+        assert len(a_idx) >= 4 and len(b_idx) >= 4
+        seq = (
+            (a_idx, -1.0),
+            (b_idx, -1.0),
+            (a_idx, +1.0),  # out-of-stack-order undo
+            (b_idx, +1.0),  # re-admission on identical nodes
+            (b_idx, -1.0),
+        )
+        for step, (idx, sign) in enumerate(seq):
+            packed = _pack(placed, _entries_of(eng, idx), sign)
+            direct = apply_placement_deltas_compact(
+                statics, dspec, ndom, nds, direct, packed
+            )
+            dense = apply_placement_deltas(statics, dense, packed)
+            self._assert_equal(
+                direct, compress_state(spec.dev, dense), f"step {step}"
+            )
+        keep = np.ones(m, bool)
+        keep[b_idx] = False
+        want = _rebuilt_from_log(placed, keep)
+        self._assert_equal(
+            direct, compress_state(spec.dev, want), "vs build_state rebuild"
+        )
+        # the direct-advanced compact state expands to the exact dense
+        # rebuild: no information was lost to the scatter shortcut
+        back = expand_state(spec.dev, direct, nds)
+        self._assert_equal(back, want, "expansion of direct carry")
+
+    def test_direct_interleave_tabular(self, placed):
+        """9-node fixture: every compact row is tabular ([Rt, D] scatter)."""
+        self._run_interleave(placed, expect_dense=False)
+
+    def test_direct_interleave_dense_rows(self, placed_wide):
+        """80-node fixture: hostname-keyed terms ride the dense-row branch."""
+        self._run_interleave(placed_wide, expect_dense=True)
+
+    def test_direct_is_non_donating(self, placed):
+        """plan/incremental.py shares one compact snapshot across probes:
+        the direct apply must NOT donate/overwrite its input buffers."""
+        import jax
+        import jax.numpy as jnp
+
+        from simtpu.engine.state import (
+            apply_placement_deltas_compact,
+            compact_delta_spec,
+            compact_spec,
+            compress_state,
+            node_dom_for,
+            node_dom_small_for,
+        )
+
+        eng = placed.engine
+        tensors = placed.tensors
+        statics = statics_from(tensors, eng.sched_config)
+        spec = compact_spec(tensors)
+        n = tensors.alloc.shape[0]
+        cstate = compress_state(spec.dev, eng.carried_state())
+        before = jax.tree_util.tree_map(
+            lambda a: np.asarray(a).copy(), cstate
+        )
+        packed = _pack(
+            placed, _entries_of(eng, range(0, len(eng.placed_node), 3)), -1.0
+        )
+        out = apply_placement_deltas_compact(
+            statics,
+            compact_delta_spec(tensors),
+            node_dom_for(tensors, n),
+            node_dom_small_for(tensors, n),
+            cstate,
+            packed,
+        )
+        assert not np.array_equal(np.asarray(out.free), before.free)
+        self._assert_equal(cstate, before, "input snapshot after apply")
+
+    def test_engine_preemption_path_skips_expand_recompress(
+        self, placed, monkeypatch
+    ):
+        """Engine.remove_placements/restore_placements on a compact carry:
+        the direct path fires (state.delta_direct +2), expand/recompress
+        stay untouched, and the compact carry returns bit-identically —
+        then the SIMTPU_DELTA_DIRECT=0 round trip reproduces the same
+        carry, pinning the A/B bit-identity at the engine level."""
+        import jax
+
+        from simtpu.engine.state import CompactState
+        from simtpu.obs.metrics import REGISTRY
+
+        eng = placed.engine
+        base = eng.last_state
+        if not isinstance(base, CompactState):
+            pytest.skip("engine carry not compact under this config")
+        base_np = jax.tree_util.tree_map(lambda a: np.asarray(a).copy(), base)
+        idx = list(range(0, len(eng.placed_node), 3))
+
+        def churn():
+            saved = eng.remove_placements(idx)
+            mid = jax.tree_util.tree_map(
+                lambda a: np.asarray(a).copy(), eng.last_state
+            )
+            eng.restore_placements(saved)
+            return mid
+
+        monkeypatch.setenv("SIMTPU_DELTA_DIRECT", "1")
+        snap0 = REGISTRY.snapshot()
+        mid_direct = churn()
+        snap1 = REGISTRY.snapshot()
+        assert isinstance(eng.last_state, CompactState)
+        assert snap1.get("state.delta_direct", 0) - snap0.get(
+            "state.delta_direct", 0
+        ) == 2
+        for name in ("state.expand", "state.compress"):
+            assert snap1.get(name, 0) == snap0.get(name, 0), (
+                f"{name} bumped on the direct preemption hot path"
+            )
+        self._assert_equal(eng.last_state, base, "direct carry round trip")
+
+        monkeypatch.setenv("SIMTPU_DELTA_DIRECT", "0")
+        snap2 = REGISTRY.snapshot()
+        mid_ab = churn()
+        snap3 = REGISTRY.snapshot()
+        assert snap3.get("state.delta_direct", 0) == snap2.get(
+            "state.delta_direct", 0
+        )
+        assert snap3.get("state.compress", 0) - snap2.get(
+            "state.compress", 0
+        ) == 2
+        self._assert_equal(eng.last_state, base, "round-trip carry")
+        for name in base._fields:
+            assert np.array_equal(
+                getattr(mid_direct, name), getattr(mid_ab, name)
+            ), f"mid-eviction carry differs between paths: {name}"
+        # the log and carry are back to the fixture's original state for
+        # the tests that share this module-scoped fixture
+        self._assert_equal(eng.last_state, base_np, "fixture restored")

@@ -407,3 +407,86 @@ class TestFullScaleSpotCheck:
         ok = nodes_exact >= 0
         np.add.at(used, nodes_exact[ok], req[ok, :r])
         assert (used <= tensors.alloc * (1 + 1e-5) + 1e-6).all()
+
+
+class TestHeavyDrafting:
+    """ISSUE 16 tentpole: storage/GPU/ports/volume pods — excluded from
+    drafting entirely before SIMTPU_WAVE_HEAVY — now ride the HARD
+    verifier with the extra resource stages (ports conflicts, LVM/device
+    allocation, GPU-share fitting) recomputed inside the verify scan.
+    Placements stay bit-identical to the serial scan, the accept rate on
+    those previously-skipped pods is > 0, and the result audits clean."""
+
+    def _all_heavy_problem(self):
+        """Every pod carries a heavy feature (LVM, exclusive device, GPU
+        share, or hostPort): with SIMTPU_WAVE_HEAVY=0 the wavefront drafts
+        NOTHING here, so any accept under heavy=1 is attributable to the
+        new path."""
+        from simtpu.core.objects import AppResource, ResourceTypes
+
+        cluster = synth_cluster(
+            24, seed=17, zones=3, taint_frac=0.0,
+            gpu_frac=0.6, storage_frac=0.6,
+        )
+        res = ResourceTypes()
+        res.deployments = [
+            make_deployment("lvmy", 24, 500, 256, lvm_gib=5),
+            make_deployment("gpuey", 24, 500, 256, gpu_mem_mib=1024),
+            make_deployment("devy", 12, 300, 256, device_gib=10),
+            make_deployment("porty", 16, 100, 128, host_port=8080),
+        ]
+        return cluster, [AppResource(name="heavy", resource=res)]
+
+    def test_all_heavy_mix_accepts_where_legacy_skips(self, monkeypatch):
+        cluster, apps = self._all_heavy_problem()
+        serial = _place(cluster, apps, speculate=False)
+
+        monkeypatch.setenv("SIMTPU_WAVE_HEAVY", "0")
+        before = wave_counts()
+        legacy = _place(cluster, apps, speculate=True)
+        mid = wave_counts()
+        _assert_identical(serial, legacy)
+        assert mid["pods"] == before["pods"], (
+            "legacy mask drafted a heavy pod — the A/B control is broken"
+        )
+
+        monkeypatch.setenv("SIMTPU_WAVE_HEAVY", "1")
+        wave = _place(cluster, apps, speculate=True)
+        after = wave_counts()
+        _assert_identical(serial, wave)
+        drafted = after["pods"] - mid["pods"]
+        accepted = after["accepted"] - mid["accepted"]
+        hard = after["draft_hard"] - mid["draft_hard"]
+        assert drafted > 0, "no heavy pod was drafted"
+        assert hard > 0, "heavy pods must ride the hard verifier"
+        assert accepted > 0, (
+            f"wavefront_accept_rate is 0 on the all-heavy mix "
+            f"({drafted} drafted)"
+        )
+        rate = accepted / drafted
+        assert 0 < rate <= 1
+
+    @pytest.mark.parametrize("seed", [5, 7, 12])
+    def test_fuzz_gnarly_mixes_identical_and_audit_clean(self, seed):
+        """Seeded gnarly storage/GPU/ports mixes (audit/fuzz.gen_case —
+        seed 7 draws all three): wavefront == serial bit-identically, the
+        hard-drafting path engages, and the placement audits clean."""
+        from simtpu.audit.checker import audit_placement, extras_from_log
+        from simtpu.audit.fuzz import gen_case
+        from simtpu.faults import place_cluster
+
+        cluster, apps, mix = gen_case(seed, n_nodes=16, n_pods=96)
+        assert mix["gpu_frac"] or mix["storage_frac"] or mix["ports"]
+        serial = place_cluster(cluster, apps, bulk=False, speculate=False)
+        before = wave_counts()
+        wave = place_cluster(cluster, apps, bulk=False, speculate=True)
+        after = wave_counts()
+        assert np.array_equal(serial.nodes, wave.nodes)
+        assert after["pods"] > before["pods"], "no wavefront engaged"
+        assert after["draft_hard"] > before["draft_hard"], (
+            "gnarly mix never engaged the hard verifier"
+        )
+        rep = audit_placement(
+            wave.tensors, wave.batch, wave.nodes, extras_from_log(wave)
+        )
+        assert rep.ok, rep
