@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all install lint test test-all test-perf bench bench-cold bench-faults bench-layout bench-durable bench-audit bench-obs bench-explain bench-multihost bench-serve bench-timeline bench-scan fuzz-smoke clean
+.PHONY: all install lint test test-all test-perf bench bench-cold bench-faults bench-layout bench-durable bench-audit bench-solve bench-obs bench-explain bench-multihost bench-serve bench-timeline bench-scan fuzz-smoke clean
 
 all: test
 
@@ -102,6 +102,24 @@ bench-audit:
 	SIMTPU_BENCH_SMALL=0 SIMTPU_BENCH_HARD=0 SIMTPU_BENCH_MATRIX=0 \
 	SIMTPU_BENCH_PLAN=0 SIMTPU_BENCH_BIG=0 SIMTPU_BENCH_FAULTS=0 \
 	SIMTPU_BENCH_LAYOUT=0 SIMTPU_BENCH_DURABLE=0 $(PY) bench.py
+
+# global-solver backend smoke (mirrors bench-audit): one solver consult
+# vs the exact doubling+bisection on a solver-eligible aligned mix,
+# ASSERTING bit-identical certified node counts, clean audits on both
+# answers, and accept rate > 0 — solve_speedup / solve_accept_rate /
+# solve_status land in the JSON line (CI runs this alongside the fast
+# tier; the >= 2x speedup claim is measured at the 2k-node default
+# shape, recorded not asserted at this CI smoke shape)
+bench-solve:
+	SIMTPU_BENCH_SOLVE=1 SIMTPU_BENCH_SOLVE_ASSERT=1 \
+	SIMTPU_BENCH_SOLVE_NODES=100 SIMTPU_BENCH_SOLVE_PODS=6000 \
+	SIMTPU_BENCH_SOLVE_MAX_NEW=256 \
+	SIMTPU_BENCH_NODES=500 SIMTPU_BENCH_PODS=2000 \
+	SIMTPU_BENCH_SCAN_PODS=200 SIMTPU_BENCH_BASELINE_PODS=50 \
+	SIMTPU_BENCH_SMALL=0 SIMTPU_BENCH_HARD=0 SIMTPU_BENCH_MATRIX=0 \
+	SIMTPU_BENCH_PLAN=0 SIMTPU_BENCH_BIG=0 SIMTPU_BENCH_FAULTS=0 \
+	SIMTPU_BENCH_LAYOUT=0 SIMTPU_BENCH_DURABLE=0 SIMTPU_BENCH_AUDIT=0 \
+	$(PY) bench.py
 
 # observability overhead gate (mirrors bench-audit): the same warm bulk
 # placement with the span tracer off vs on, ASSERTING < 3% tracing-on

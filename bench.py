@@ -887,6 +887,110 @@ def audit_point() -> dict:
     return out
 
 
+def solve_point() -> dict:
+    """Global-solver backend smoke (ISSUE 19, docs/solver.md): time the
+    exact doubling+bisection capacity search against one solver consult
+    (`plan_capacity(..., solver=True)`) on a solver-eligible mix —
+    uniform pod shapes whose request vectors divide every node capacity,
+    ordered big-first so the heuristic scheduler packs optimally and the
+    certified LP minimum EQUALS the exact search's answer (on
+    ratio-mismatched mixes the solver legitimately beats the heuristic;
+    docs/solver.md's when-it-loses table owns that story).  `make
+    bench-solve` runs this alone with SIMTPU_BENCH_SOLVE_ASSERT=1, which
+    fails the run unless both backends agree, both audits are clean, and
+    the solver's answer was accepted (accept rate > 0)."""
+    from simtpu import AppResource, ResourceTypes
+    from simtpu.obs.metrics import REGISTRY
+    from simtpu.plan.capacity import plan_capacity
+    from simtpu.synth import make_deployment, make_node, synth_cluster
+
+    n_nodes = int(os.environ.get("SIMTPU_BENCH_SOLVE_NODES", 2000))
+    n_pods = int(os.environ.get("SIMTPU_BENCH_SOLVE_PODS", n_nodes * 60))
+    max_new = int(
+        os.environ.get("SIMTPU_BENCH_SOLVE_MAX_NEW", max(2 * n_nodes, 64))
+    )
+
+    def mk_cluster():
+        return synth_cluster(n_nodes, seed=7, zones=4, taint_frac=0.0)
+
+    def mk_apps():
+        # solver-eligible by construction: no storage/GPU demand, no
+        # anti-affinity/spread; two nested pod shapes (2:1) that divide
+        # every synth node capacity, largest first (first-fit-decreasing)
+        res = ResourceTypes()
+        per = max(n_pods // 40, 1)
+        d = 0
+        for cpu, mem in ((2000, 8192), (1000, 4096)):
+            for _ in range(20):
+                res.deployments.append(
+                    make_deployment(f"solve-dep-{d}", per, cpu, mem)
+                )
+                d += 1
+        return [AppResource(name="solve-bench", resource=res)]
+
+    template = make_node("solve-template", 32000, 128)
+    note(
+        f"solve point: {n_nodes} nodes / ~{n_pods} pods, "
+        f"max_new={max_new}"
+    )
+    t0 = time.perf_counter()
+    solved = plan_capacity(
+        mk_cluster(), mk_apps(), template, max_new, solver=True
+    )
+    solve_s = time.perf_counter() - t0
+    note(
+        f"solve point: solver {'ACCEPTED' if solved.solve.get('status') == 'accepted' else solved.solve.get('status')} "
+        f"{solved.nodes_added} node(s) in {solve_s:.2f}s "
+        f"(relax+round+audit {solved.solve.get('wall_s', 0.0)}s)"
+    )
+    t0 = time.perf_counter()
+    exact = plan_capacity(
+        mk_cluster(), mk_apps(), template, max_new, solver=False
+    )
+    exact_s = time.perf_counter() - t0
+    note(
+        f"solve point: exact search {exact.nodes_added} node(s) in "
+        f"{exact_s:.2f}s over {len(exact.probes)} probes"
+    )
+
+    attempts = REGISTRY.counter("solve.attempts").value
+    accepted = REGISTRY.counter("solve.accepted").value
+    out = {
+        "solve_nodes_added": int(solved.nodes_added),
+        "solve_exact_nodes_added": int(exact.nodes_added),
+        "solve_status": solved.solve.get("status"),
+        "solve_s": round(solve_s, 3),
+        "solve_exact_s": round(exact_s, 3),
+        "solve_speedup": round(exact_s / max(solve_s, 1e-9), 2),
+        "solve_accept_rate": round(accepted / attempts, 4) if attempts else 0.0,
+        "solve_consult_s": round(float(solved.solve.get("wall_s", 0.0)), 3),
+    }
+    note(
+        f"solve point: speedup {out['solve_speedup']}x, "
+        f"accept rate {out['solve_accept_rate']:.0%}"
+    )
+    if os.environ.get("SIMTPU_BENCH_SOLVE_ASSERT", "0") == "1":
+        assert solved.success and exact.success, (
+            f"both backends must succeed: solver={solved.message!r} "
+            f"exact={exact.message!r}"
+        )
+        assert out["solve_accept_rate"] > 0, (
+            f"the solver must ACCEPT on the feasible bench mix: "
+            f"{solved.solve}"
+        )
+        assert solved.nodes_added == exact.nodes_added, (
+            f"certified answers must agree on the aligned mix: "
+            f"solver={solved.nodes_added} exact={exact.nodes_added}"
+        )
+        assert solved.audit and solved.audit.get("ok"), (
+            f"the shipped solver answer must audit clean: {solved.audit}"
+        )
+        assert exact.audit and exact.audit.get("ok"), (
+            f"the exact answer must audit clean: {exact.audit}"
+        )
+    return out
+
+
 def durable_point() -> dict:
     """Durable-execution smoke (ISSUE 6, docs/robustness.md): (1) a small
     incremental plan checkpointed, killed mid-search, and resumed — the
@@ -2145,6 +2249,16 @@ def main() -> int:
         except Exception as exc:  # noqa: BLE001 - report, keep the line
             note(f"audit point failed: {type(exc).__name__}: {exc}")
             record["audit_error"] = f"{type(exc).__name__}: {exc}"
+    # global-solver backend smoke (ISSUE 19): on by default at north-star
+    # runs, SIMTPU_BENCH_SOLVE=1 forces it at any configuration (`make
+    # bench-solve` = the small-shape asserting smoke), =0 skips
+    solve_env = os.environ.get("SIMTPU_BENCH_SOLVE", "")
+    if solve_env != "0" and (north_star or solve_env == "1"):
+        try:
+            record.update(solve_point())
+        except Exception as exc:  # noqa: BLE001 - report, keep the line
+            note(f"solve point failed: {type(exc).__name__}: {exc}")
+            record["solve_error"] = f"{type(exc).__name__}: {exc}"
     # observability overhead gate (ISSUE 8): on by default at north-star
     # runs, SIMTPU_BENCH_OBS=1 forces it at any configuration (`make
     # bench-obs` = the small-shape asserting smoke), =0 skips

@@ -255,6 +255,7 @@ def _cmd_apply(args: argparse.Namespace) -> int:
         # ^C = the default KeyboardInterrupt (durable/deadline.py)
         install_sigint=True,
         audit=args.audit,
+        solver=args.solver,
         explain=args.explain,
     )
     def fail_early(exc: Exception) -> int:
@@ -369,6 +370,17 @@ def _cmd_apply(args: argparse.Namespace) -> int:
 
             color = C.COLOR_RED if _audit_failed(plan.audit) else C.COLOR_GREEN
             print(f"{color}{audit_report(plan.audit)}{C.COLOR_RESET}")
+        if plan.solve:
+            from .report import solve_report
+
+            print(solve_report(plan.solve))
+        if getattr(plan, "preemption_ignored", False):
+            print(
+                f"{C.COLOR_YELLOW}warning: specs carry pod priorities, but "
+                "the incremental planner never runs preemption — "
+                "priority/eviction semantics were IGNORED (use --search "
+                f"binary/linear for the preemption path){C.COLOR_RESET}"
+            )
         if _audit_failed(fault_audit):
             from .report import audit_report
 
@@ -391,7 +403,13 @@ def _cmd_apply(args: argparse.Namespace) -> int:
             phases = "  ".join(f"{k}={v:.2f}s" for k, v in plan.timings.items())
             print(f"phase timings: {phases}")
         if plan.engine:
-            eng = " ".join(f"{k}={v}" for k, v in plan.engine.items())
+            # dict-valued entries (the solve record) have their own
+            # report section — the one-liner keeps the scalar knobs only
+            eng = " ".join(
+                f"{k}={v}"
+                for k, v in plan.engine.items()
+                if not isinstance(v, dict)
+            )
             print(f"engine selection: {eng}")
         if _audit_failed(plan.audit) or _audit_failed(fault_audit):
             return _flight_exit(
@@ -521,6 +539,7 @@ def _cmd_resilience(args: argparse.Namespace) -> int:
                     checkpoint=checkpoint,
                     control=control,
                     audit=args.audit,
+                    solver=args.solver,
                     explain=args.explain,
                 )
             if args.json:
@@ -548,6 +567,10 @@ def _cmd_resilience(args: argparse.Namespace) -> int:
                         C.COLOR_RED if _audit_failed(plan.audit) else C.COLOR_GREEN
                     )
                     print(f"{a_color}{audit_report(plan.audit)}{C.COLOR_RESET}")
+                if plan.solve:
+                    from .report import solve_report
+
+                    print(solve_report(plan.solve))
                 if plan.explain:
                     from .report import explain_report
 
@@ -1146,6 +1169,34 @@ def _add_audit_flags(p: argparse.ArgumentParser) -> None:
     )
 
 
+def _add_solver_flags(p: argparse.ArgumentParser) -> None:
+    """Global-solver backend opt-in shared by the planning commands
+    (docs/solver.md, simtpu/solve).  Advisory mode: the solver PROPOSES
+    a placement at a certified-minimal node count, the independent
+    auditor DISPOSES — any rejected or uncertified answer falls back to
+    the exact search with at most a warm-start lower bound."""
+    p.add_argument(
+        "--solver",
+        dest="solver",
+        action="store_true",
+        default=None,
+        help="consult the global-solver planning backend first: one "
+        "vmapped convex relaxation over ALL candidate node counts "
+        "replaces the doubling+bisection capacity search; the rounded "
+        "placement ships only when the independent auditor certifies it "
+        "AND minimality is proven by an infeasibility certificate at the "
+        "count below (default: off, SIMTPU_SOLVER=1 enables globally; "
+        "the '--json' engine block records which backend answered)",
+    )
+    p.add_argument(
+        "--no-solver",
+        dest="solver",
+        action="store_false",
+        help="never consult the global-solver backend (exact search "
+        "only, even when SIMTPU_SOLVER=1)",
+    )
+
+
 def _add_explain_flag(p: argparse.ArgumentParser) -> None:
     """Decision-observability opt-in shared by the planning commands
     (simtpu/explain, docs/observability.md)."""
@@ -1349,6 +1400,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="deterministic seed for sampled fault scenarios (default 0)",
     )
     _add_audit_flags(apply_p)
+    _add_solver_flags(apply_p)
     _add_durable_flags(apply_p)
     _add_obs_flags(apply_p)
     _add_explain_flag(apply_p)
@@ -1432,6 +1484,7 @@ def build_parser() -> argparse.ArgumentParser:
         "instead of the report tables",
     )
     _add_audit_flags(res_p)
+    _add_solver_flags(res_p)
     _add_durable_flags(res_p)
     _add_obs_flags(res_p)
     _add_explain_flag(res_p)

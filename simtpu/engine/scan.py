@@ -101,7 +101,7 @@ FAIL_VOLUME_BIND = 11  # PVC missing / not bindable / PV zone mismatch
 # increments.)  The backing store is the obs metrics registry under
 # `compile.<kind>` (read via `obs.metrics.family("compile",
 # COMPILE_COUNT_KINDS)` — the ISSUE-8 alias views are gone).
-COMPILE_COUNT_KINDS = ("scan", "rounds", "wave", "explain")
+COMPILE_COUNT_KINDS = ("scan", "rounds", "wave", "explain", "solve")
 
 
 def count_trace(kind: str) -> None:

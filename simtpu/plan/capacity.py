@@ -113,6 +113,19 @@ class PlanResult:
     # "version" stamp (explain.EXPLAIN_VERSION); rides --json as
     # "explain" and the flight recorder's exit-3/4 bundles
     explain: Dict[str, object] = field(default_factory=dict)
+    # the global-solver backend's record (simtpu/solve, docs/solver.md):
+    # status (accepted / accepted_fallback / rejected / infeasible /
+    # ineligible), the certified lower bound it handed the exact search,
+    # and the audit/fallback trail when its answer shipped.  {} = solver
+    # not consulted (--no-solver / SIMTPU_SOLVER unset); rides --json
+    # under engine.solve
+    solve: Dict[str, object] = field(default_factory=dict)
+    # True when the incremental planner received priority/preemption-
+    # bearing specs: probes never run preemption (capacity planning asks
+    # whether everything fits), so priority semantics were IGNORED — the
+    # loud runtime counterpart of the docs/status.md note; rides --json
+    # under engine.preemption_ignored
+    preemption_ignored: bool = False
 
 
 def new_fake_nodes(template: dict, count: int) -> List[dict]:
@@ -254,8 +267,19 @@ def plan_capacity(
     control=None,
     audit: Optional[bool] = None,
     explain: bool = False,
+    solver: Optional[bool] = None,
 ) -> PlanResult:
     """Find the minimum clone count of `new_node` that deploys everything.
+
+    `solver` (None = the SIMTPU_SOLVER default, off) consults the global
+    solve backend (simtpu/solve, docs/solver.md) FIRST: one vmapped
+    convex relaxation over every candidate count replaces the whole
+    doubling+bisection when its rounded answer is audit-certified at a
+    count whose predecessor carries an infeasibility proof.  Advisory
+    mode throughout — a rejected/uncertified solve falls through to the
+    exact search below, warm-started with the solver's certified lower
+    bound when one exists; the answer is then bit-identical to the
+    solver-off run.
 
     `explain` (off by default — the off path adds zero device
     dispatches) attaches the decision-observability block
@@ -289,9 +313,34 @@ def plan_capacity(
     runs before each candidate; an interrupt yields a partial PlanResult
     (`partial=True`) instead of a traceback."""
     from ..audit.checker import audit_enabled, inject_divergence_enabled
+    from ..solve import solver_enabled
 
     say = progress or (lambda s: None)
     probes: Dict[int, int] = {}
+    # -- global-solver consult (simtpu/solve): solver proposes, auditor
+    # disposes.  An accepted attempt IS the plan (no simulate() at all);
+    # anything else warm-starts the exact search below.  Checkpointed
+    # runs skip the solver — its answers are not candidate records.
+    solve_doc: Dict[str, object] = {}
+    lb_hint = 0
+    solver_on = solver_enabled() if solver is None else bool(solver)
+    if solver_on and checkpoint is None:
+        from ..solve import solve_capacity_plan
+
+        with span("solve"):
+            plan_s, att = solve_capacity_plan(
+                cluster, apps, new_node, max_new_nodes,
+                extended_resources, progress=say, sched_config=sched_config,
+            )
+        if plan_s is not None:
+            return plan_s
+        solve_doc = att.doc
+        if att.certified and att.lower_bound > 0:
+            lb_hint = min(att.lower_bound, max_new_nodes - 1)
+            say(
+                f"solver: certified lower bound {att.lower_bound} — "
+                "warm-starting the exact search"
+            )
     all_daemon_sets = list(cluster.daemon_sets)
     for app in apps:
         all_daemon_sets += app.resource.daemon_sets
@@ -528,18 +577,21 @@ def plan_capacity(
 
     def search_candidates() -> PlanResult:
         nonlocal cap_rejected
-        ok, unsched, msg, result = evaluate(0)
-        if ok:
-            return final_success(0, result)
-        if unsched and msg:
-            res = result or last_result[0]
-            return with_explain(PlanResult(False, 0, res, msg, probes), res)
+        if lb_hint < 1:
+            ok, unsched, msg, result = evaluate(0)
+            if ok:
+                return final_success(0, result)
+            if unsched and msg:
+                res = result or last_result[0]
+                return with_explain(PlanResult(False, 0, res, msg, probes), res)
+        # else: the solver PROVED candidate 0 (and everything below
+        # lb_hint) infeasible — skip straight to the bound
 
         # the reference's loop is `for i := 0; i < MaxNumNewNode; i++`
         # (apply.go:183) — the largest candidate ever tried is
         # max_new_nodes-1
         if search == "linear":
-            return linear_from(1)
+            return linear_from(max(1, lb_hint))
 
         def cap_fallback() -> PlanResult:
             """A cap rejection makes feasibility potentially non-monotone —
@@ -559,9 +611,10 @@ def plan_capacity(
             return linear_from(1)
 
         # doubling probe then binary search (feasibility monotone in
-        # clone count)
+        # clone count); a certified solver lower bound starts the
+        # doubling at the bound instead of 1
         hi, hi_result = None, None
-        probe = 1
+        probe = max(1, lb_hint)
         while probe < max_new_nodes:
             ok, unsched, msg, result = evaluate(probe)
             if cap_rejected:
@@ -594,7 +647,9 @@ def plan_capacity(
                     res,
                 )
             hi, hi_result = probe, result
-        lo = hi // 2  # lowest infeasible known is hi//2 (or 0)
+        # lowest infeasible known is hi//2 (probed by the doubling, or 0)
+        # — unless the solver certified everything below lb_hint
+        lo = max(hi // 2, lb_hint - 1)
         while hi - lo > 1:
             mid = (lo + hi) // 2
             ok, _, _, result = evaluate(mid)
@@ -606,21 +661,30 @@ def plan_capacity(
                 lo = mid
         return final_success(hi, hi_result)
 
+    def _with_solve(out: PlanResult) -> PlanResult:
+        # a rejected/uncertified solver consult still rides the result —
+        # --json consumers see WHY the exact search answered
+        if solve_doc and not out.solve:
+            out.solve = dict(solve_doc)
+        return out
+
     try:
-        return search_candidates()
+        return _with_solve(search_candidates())
     except PlanInterrupted as exc:
         # deadline / SIGINT between candidates: the structured partial
         # result — every completed candidate is already checkpointed
         from ..durable.deadline import partial_message
 
         best = best_candidate[0]
-        return PlanResult(
-            False,
-            -1 if best is None else best,
-            None,
-            partial_message(exc.reason, best, checkpoint),
-            probes,
-            partial=True,
+        return _with_solve(
+            PlanResult(
+                False,
+                -1 if best is None else best,
+                None,
+                partial_message(exc.reason, best, checkpoint),
+                probes,
+                partial=True,
+            )
         )
 
 
@@ -675,6 +739,11 @@ class ApplierOptions:
     # placement auditor over the accepted candidate and fall back to the
     # serial exact engines on failure; False = --no-audit
     audit: Optional[bool] = None
+    # None = the SIMTPU_SOLVER default (off): consult the global solve
+    # backend (simtpu/solve) before the exact search — advisory mode,
+    # the auditor gates everything it proposes; --solver forces it on,
+    # --no-solver off (docs/solver.md)
+    solver: Optional[bool] = None
     # decision observability (simtpu/explain, --explain): attach failure
     # breakdowns + the bottleneck analysis to the plan.  Off = zero cost
     # (no explain import, no extra device dispatch)
@@ -971,6 +1040,7 @@ class Applier:
                     control=control,
                     audit=self.opts.audit,
                     explain=self.opts.explain,
+                    solver=self.opts.solver,
                 )
             else:
                 plan = plan_capacity(
@@ -988,6 +1058,7 @@ class Applier:
                     control=control,
                     audit=self.opts.audit,
                     explain=self.opts.explain,
+                    solver=self.opts.solver,
                 )
         timings["plan"] = _time.perf_counter() - t0
         plan.timings = timings
@@ -1079,6 +1150,19 @@ class Applier:
             # when the primary engine's answer failed certification.
             # {"enabled": False} = --no-audit / SIMTPU_AUDIT=0
             "audit": plan.audit if plan.audit else {"enabled": False},
+            # the global-solver backend's record (simtpu/solve): which
+            # engine ANSWERED — an accepted status means the vmapped
+            # relaxation produced the shipped plan; rejected/ineligible
+            # means the exact search did (with the solver's certified
+            # lower bound when one existed).  {"enabled": False} =
+            # solver not consulted (--no-solver / SIMTPU_SOLVER unset)
+            "solve": plan.solve if plan.solve else {"enabled": False},
+            # loud runtime flag (docs/status.md): the incremental
+            # planner's probes never run preemption, and this plan's
+            # specs carried pod priorities — they were ignored
+            "preemption_ignored": bool(
+                getattr(plan, "preemption_ignored", False)
+            ),
         }
         if self.opts.trace:
             from ..obs.trace import export_trace

@@ -200,9 +200,18 @@ def plan_capacity_incremental(
     control=None,
     audit: Optional[bool] = None,
     explain: bool = False,
+    solver: Optional[bool] = None,
 ) -> PlanResult:
     """Minimum clone count of `new_node` deploying everything, via the
     incremental probe strategy described in the module docstring.
+
+    `solver` (None = the SIMTPU_SOLVER default, off) consults the global
+    solve backend (simtpu/solve, docs/solver.md) right after the shared
+    tensorization: one vmapped convex relaxation over every candidate
+    count.  An audit-certified solver answer ships directly (no base
+    placement, no probes); a rejected one floors the resource lower
+    bound with the solver's certified LP bound and the probe search runs
+    as usual — always advisory, the auditor disposes.
 
     `explain` (off by default; the off path adds zero device dispatches)
     attaches the decision-observability block (simtpu/explain) to
@@ -260,7 +269,7 @@ def plan_capacity_incremental(
             cluster, apps, new_node, max_new_nodes, extended_resources,
             progress, sched_config, corrected_ds_overhead, verify,
             materialize, mesh, pipeline, speculate, checkpoint, control,
-            audit, explain,
+            audit, explain, solver,
         )
     except PlanInterrupted as exc:
         # deadline / SIGINT between candidates (docs/robustness.md): the
@@ -303,11 +312,13 @@ def _plan_capacity_incremental(
     control,
     audit=None,
     explain=False,
+    solver=None,
 ) -> PlanResult:
     from ..audit.checker import audit_enabled
     from ..engine.scan import COMPILE_COUNT_KINDS, statics_from
     from ..obs.metrics import family as metrics_family
     from ..parallel.sweep import assemble_planning_problem
+    from ..solve import solver_enabled
 
     def trace_counts() -> Dict[str, int]:
         # per-kind jit-trace counters off the obs registry (the ISSUE-8
@@ -323,6 +334,10 @@ def _plan_capacity_incremental(
     timings: Dict[str, float] = {}
     compiles: Dict[str, Dict[str, int]] = {}
     probes: Dict[int, int] = {}
+    # the global-solver consult's record + the priority-ignored flag,
+    # attached to EVERY result this plan returns (finalize)
+    solve_doc: Dict[str, object] = {}
+    preempt_flag = [False]
     fail_msg = f"we have added {max_new_nodes} nodes but it still failed!!"
     # the best candidate any probe/verify found feasible so far — what an
     # interrupted plan reports as its partial answer
@@ -358,6 +373,9 @@ def _plan_capacity_incremental(
             timings["compile_serial"] = s["compile_serial_s"]
         out.timings = timings
         out.compiles = compiles
+        if solve_doc and not out.solve:
+            out.solve = dict(solve_doc)
+        out.preemption_ignored = preempt_flag[0]
         return out
 
     t0 = time.perf_counter()
@@ -383,6 +401,69 @@ def _plan_capacity_incremental(
         pin = np.asarray(batch.pin)
         clone_of = pin - n_base  # >= 0 for clone-pinned (DaemonSet) pods
     timings["tensorize"] = time.perf_counter() - t0
+
+    # -- loud no-preemption notice (docs/status.md): probes never evict.
+    # Capacity planning asks whether everything FITS — priority-bearing
+    # specs plan fine, but their eviction semantics are ignored, and
+    # that must be visible at runtime, not only in the docs.
+    from ..core.objects import pod_priority
+
+    if any(pod_priority(p) != 0 for p in ordered):
+        import sys
+
+        preempt_flag[0] = True
+        notice = (
+            "simtpu: specs carry pod priorities, but the incremental "
+            "planner never runs preemption — priority/eviction semantics "
+            "are IGNORED (use --search binary/linear for simulate()'s "
+            "preemption path)"
+        )
+        print(notice, file=sys.stderr)
+        say(notice)
+
+    # -- global-solver consult (simtpu/solve, docs/solver.md): one
+    # vmapped relaxation over every candidate count, on the SAME
+    # tensorization the probes would use.  Accepted => the plan ships
+    # here (no base placement, no probes); rejected => its certified LP
+    # bound floors the resource lower bound below.  Checkpointed runs
+    # skip it — solver answers are not candidate records.
+    lb_solve = 0
+    solver_on = solver_enabled() if solver is None else bool(solver)
+    if solver_on and checkpoint is None:
+        from ..solve import attempt_solve
+
+        check()
+        c0 = trace_counts()
+        t_s = time.perf_counter()
+        with span("solve"):
+            att = attempt_solve(
+                tz, tensors, batch, all_nodes, n_base, max_new,
+                sched_config, say,
+            )
+        timings["solve"] = time.perf_counter() - t_s
+        mark_compiles("solve", c0)
+        solve_doc.update(att.doc)
+        if att.accepted:
+            probes[att.k] = 0
+            best_candidate[0] = att.k
+            result = None
+            if materialize:
+                t1 = time.perf_counter()
+                result = _materialize(
+                    tz, all_nodes, n_base + att.k, batch, att.nodes_arr,
+                    att.reasons, clone_of, att.k, att.ext_log, att.gpu_arr,
+                )
+                timings["materialize"] = time.perf_counter() - t1
+            out = PlanResult(True, att.k, result, "Success!", probes)
+            out.audit = att.audit_doc
+            return finalize(out)
+        if att.certified:
+            lb_solve = att.lower_bound
+            if lb_solve > 0:
+                say(
+                    f"solver: certified lower bound {lb_solve} — flooring "
+                    "the probe search"
+                )
 
     # one shape-bucket registry for every engine of this plan: probes snap
     # their bulk chunks into buckets the base run (or an earlier probe)
@@ -792,6 +873,10 @@ def _plan_capacity_incremental(
             lb = max_new
         else:
             lb = max(1, int(math.ceil(need_max - 1e-9)))
+    # the solver's certified LP bound floors the resource bound — LP
+    # feasibility is necessary for ANY placement, so probes below it are
+    # wasted dispatches (simtpu/solve, docs/solver.md)
+    lb = max(lb, lb_solve)
     # doubling from the bound, then bisection on the open interval; when the
     # very first probe (the resource lower bound) is feasible, try bound-1
     # next — the bound is usually tight, making the whole search 2 probes

@@ -82,6 +82,10 @@ class ResiliencePlan:
     #: placement strands) or the worst scenario's binding-constraint
     #: bottleneck — *what to buy*, not just *how many*.  {} = not requested
     explain: Dict[str, object] = field(default_factory=dict)
+    #: the global-solver backend's relax-only lower-bound record
+    #: (simtpu/solve `solve_lower_bound`): the no-failure LP bound the
+    #: doubling started from.  {} = solver not consulted
+    solve: Dict[str, object] = field(default_factory=dict)
 
     def counters(self) -> Dict[str, object]:
         """Machine-readable summary (CLI --json, bench)."""
@@ -99,6 +103,8 @@ class ResiliencePlan:
             out["audit"] = dict(self.audit)
         if self.explain:
             out["explain"] = dict(self.explain)
+        if self.solve:
+            out["solve"] = dict(self.solve)
         if self.sweep is not None:
             out.update(self.sweep.counters())
         return out
@@ -169,6 +175,7 @@ def plan_resilience(
     control=None,
     audit: Optional[bool] = None,
     explain: bool = False,
+    solver: Optional[bool] = None,
 ) -> ResiliencePlan:
     """Minimum clone count of `new_node` whose cluster still fully places
     every workload under the failure model.
@@ -194,9 +201,17 @@ def plan_resilience(
     (simtpu/audit).  An audit-dirty winner is never shipped: the base
     placement re-runs through the serial exact scan, re-audits, and the
     sweep re-runs over the certified placement, with the divergence
-    diagnostic under `ResiliencePlan.audit` (docs/robustness.md)."""
+    diagnostic under `ResiliencePlan.audit` (docs/robustness.md).
+
+    `solver` (None = the SIMTPU_SOLVER default, off) consults the solve
+    backend's relax-only lower bound (simtpu/solve `solve_lower_bound`):
+    the no-failure fit is necessary for survivability — failures only
+    remove capacity — so a certified LP infeasibility proof at count j
+    rules out every candidate <= j, and the doubling starts at the
+    bound instead of 1 (docs/solver.md)."""
     from ..engine.scan import statics_from
     from ..parallel.sweep import assemble_planning_problem
+    from ..solve import solve_lower_bound, solver_enabled
 
     say = progress or (lambda s: None)
     t_start = time.perf_counter()
@@ -230,6 +245,24 @@ def plan_resilience(
     pin = np.asarray(batch.pin)
     clone_of = pin - n_base  # >= 0 for clone-pinned (DaemonSet) pods
     timings["tensorize"] = time.perf_counter() - t0
+
+    # relax-only solver consult: a certified no-failure LP bound floors
+    # the candidate search (survivability requires the base fit)
+    solve_doc: Dict[str, object] = {}
+    lb_solve = 0
+    solver_on = solver_enabled() if solver is None else bool(solver)
+    if solver_on and new_node is not None and max_new > 0:
+        t_s = time.perf_counter()
+        lb_solve, solve_doc = solve_lower_bound(
+            tensors, batch, n_base, len(all_nodes), max_new
+        )
+        solve_doc["wall_s"] = round(time.perf_counter() - t_s, 4)
+        lb_solve = min(lb_solve, max_new)
+        if lb_solve > 0:
+            say(
+                f"solver: certified no-failure lower bound {lb_solve} — "
+                "starting the candidate search there"
+            )
 
     # one bulk-shape registry across every candidate's engine, the
     # incremental planner's warm-executable lever
@@ -469,6 +502,7 @@ def plan_resilience(
                     probes=probes, sweep=sweeps.get(i), timings=timings,
                 )
                 out.audit = audit_doc
+                out.solve = solve_doc
                 return out
         timings["total_s"] = time.perf_counter() - t_start
         out = ResiliencePlan(
@@ -476,6 +510,7 @@ def plan_resilience(
             probes=probes, sweep=sweeps.get(i), timings=timings,
         )
         out.audit = audit_doc
+        out.solve = solve_doc
         return out
 
     def interrupted(exc: PlanInterrupted) -> ResiliencePlan:
@@ -489,10 +524,12 @@ def plan_resilience(
             none_note="no surviving candidate found yet",
         )
         timings["total_s"] = time.perf_counter() - t_start
-        return ResiliencePlan(
+        out = ResiliencePlan(
             False, -1 if best is None else best, k, quantile, msg,
             probes=probes, sweep=None, timings=timings, partial=True,
         )
+        out.solve = solve_doc
+        return out
 
     def mk_explain() -> Dict[str, object]:
         """The failed search's decision-observability block
@@ -559,6 +596,7 @@ def plan_resilience(
             sweep=None, timings=timings,
         )
         out.explain = mk_explain()
+        out.solve = solve_doc
         return out
 
     fail_msg = (
@@ -567,7 +605,9 @@ def plan_resilience(
     )
     t0 = time.perf_counter()
     try:
-        if probe(0):
+        # a certified solver bound >= 1 proves candidate 0's base fit
+        # impossible — its probe is a wasted placement
+        if lb_solve < 1 and probe(0):
             timings["search"] = time.perf_counter() - t0
             return finish(0)
         if new_node is None:
@@ -580,7 +620,7 @@ def plan_resilience(
             )
 
         if search == "linear":
-            for i in range(1, max_new + 1):
+            for i in range(max(1, lb_solve), max_new + 1):
                 if probe(i):
                     timings["search"] = time.perf_counter() - t0
                     return finish(i)
@@ -591,7 +631,7 @@ def plan_resilience(
         # the plan_capacity scaffolding; see the module docstring's
         # sampling caveat)
         hi = None
-        cand = 1
+        cand = max(1, lb_solve)
         while cand <= max_new:
             if probe(cand):
                 hi = cand
@@ -605,7 +645,7 @@ def plan_resilience(
                 return fail(fail_msg)
         lo = max(
             [i for i in probes if i < hi and not _passed(probes[i], quantile)],
-            default=0,
+            default=max(0, lb_solve - 1),  # certified infeasible below
         )
         while hi - lo > 1:
             mid = (lo + hi) // 2

@@ -177,6 +177,47 @@ def audit_report(doc: dict) -> str:
     return "\n".join(out)
 
 
+def solve_report(doc: dict) -> str:
+    """Render one global-solver consult record (`PlanResult.solve` /
+    `ResiliencePlan.solve`, docs/solver.md) as a one-to-two line section
+    under the placement report.
+
+    Advisory mode means every status is a legitimate outcome: accepted
+    answers name the certified count, everything else names why the
+    exact search took over (and what warm start, if any, it inherited)."""
+    if not doc or not doc.get("enabled"):
+        return "Solver: not consulted (--no-solver / SIMTPU_SOLVER unset)"
+    status = doc.get("status", "?")
+    wall = doc.get("wall_s", 0.0)
+    if status == "accepted":
+        return (
+            f"Solver: accepted — {doc.get('k', '?')} node(s), minimality "
+            f"certified at k-1, residual {doc.get('residual', 0.0):.2e}, "
+            f"{wall:.3f}s"
+        )
+    if status == "accepted_fallback":
+        return (
+            f"Solver: audit rejected the rounded placement — serial exact "
+            f"engine re-placed at the certified count "
+            f"{doc.get('k', '?')} ({wall:.3f}s)"
+        )
+    if status == "certified":  # lower-bound-only mode (resilience)
+        return (
+            f"Solver: certified lower bound {doc.get('lower_bound', 0)} "
+            f"(warm-started the survivability search, {wall:.3f}s)"
+        )
+    out = [
+        f"Solver: {status} — exact search answered "
+        f"({doc.get('reason', 'no reason recorded')}, {wall:.3f}s)"
+    ]
+    if doc.get("certified_lb") and doc.get("lower_bound"):
+        out.append(
+            f"  certified lower bound {doc['lower_bound']} warm-started "
+            "the exact search"
+        )
+    return "\n".join(out)
+
+
 def _fmt_res(name: str, val: float) -> str:
     if name == "cpu":
         return format_quantity(val, "cpu")

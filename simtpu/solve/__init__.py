@@ -1,0 +1,20 @@
+"""Global-solver planning backend (ISSUE 19, docs/solver.md).
+
+A second planning engine: pod x node placement lowered to a batched
+assignment relaxation in pure JAX, vmapped over candidate node counts so
+the entire capacity search collapses into ONE solve instead of
+doubling+bisection over full placements.  Always advisory: the solver
+proposes a candidate placement, the PR-7 auditor (simtpu/audit) disposes
+— audit-dirty answers fall back to the serial exact engine exactly like
+wavefront rollback, and nothing uncertified ever ships.
+"""
+
+from .planner import (  # noqa: F401
+    SolveAttempt,
+    attempt_solve,
+    solve_capacity_plan,
+    solve_lower_bound,
+    solver_enabled,
+)
+from .relax import build_relax_problem, relax_candidates  # noqa: F401
+from .rounding import nodes_from_counts, round_candidate  # noqa: F401

@@ -554,6 +554,7 @@ def test_scan_vs_bulk_equivalence(seed):
     _assert_no_overcommit(bulk)
 
 
+@pytest.mark.slow
 def test_scan_vs_bulk_hard_mix_agreement():
     """Mid-scale pin of the bench's HARD mix (VERDICT r3 task 6): under the
     exact hard-point constraint fractions (DoNotSchedule spread + required
@@ -587,6 +588,7 @@ def test_scan_vs_bulk_hard_mix_agreement():
     _assert_anti_satisfied(bulk)
 
 
+@pytest.mark.slow
 def test_scan_vs_bulk_matrix_mix_agreement():
     """Mid-scale pin of the bench's MATRIX mix (round-4): the multi-GPU /
     multi-claim-LVM / self-affinity fractions the matrix-point times, at

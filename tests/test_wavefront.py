@@ -124,6 +124,7 @@ class TestWavefrontBitIdentity:
         wave = _place(cluster, apps, speculate=True)
         _assert_identical(base, wave)
 
+    @pytest.mark.slow
     def test_identical_under_sliced_chunk_contexts(self):
         """Forced tiny chunk/row budgets exercise the group- and term-row-
         sliced statics contexts the wavefront dispatch composes with."""
