@@ -529,6 +529,11 @@ def serve_point() -> dict:
     ]
     if assert_on:
         args.append("--smoke")
+    # sustained open-loop fit-query arrival sweep (warm-engine serving):
+    # p50/p99 under a fixed arrival rate + the zero-retensorize assertion
+    arrival = os.environ.get("SIMTPU_BENCH_SERVE_ARRIVAL", "4,12")
+    if arrival:
+        args += ["--arrival-sweep", arrival]
     burst = os.environ.get("SIMTPU_BENCH_SERVE_BURST", "")
     if burst:
         args += ["--burst", burst]
@@ -550,6 +555,8 @@ def serve_point() -> dict:
             "serve_qps", "serve_p50_s", "serve_p99_s",
             "serve_coalesce_ratio", "serve_requests", "serve_coalesced",
             "serve_sweeps", "serve_shed", "serve_timeouts",
+            "serve_fit_p50_s", "serve_fit_p99_s",
+            "serve_warm_fits", "serve_warm_fallbacks",
         )
         if k in doc
     }
@@ -560,6 +567,10 @@ def serve_point() -> dict:
         )
         assert rec["serve_coalesce_ratio"] > 0, rec
         assert rec["serve_sweeps"] < rec["serve_requests"], rec
+        if rec.get("serve_warm_fits", 0) > 0:
+            # warm-engine acceptance: a repeating fit mix must never
+            # fall back to a re-tensorize
+            assert rec.get("serve_warm_fallbacks", 0) == 0, rec
     return rec
 
 
@@ -1600,6 +1611,241 @@ def scan_smoke_point() -> dict:
     return out
 
 
+def grow_point() -> dict:
+    """Warm-engine serving point (`make bench-grow` = the asserting
+    smoke, SIMTPU_BENCH_GROW_ASSERT=1).  Two measurements:
+
+    (a) append-only vocabulary growth at the engine level: a warm grow
+        engine absorbs successive query waves (each interning new
+        interpod terms) through `extend_state`, against the
+        re-tensorize-from-scratch + `build_state` cost the pre-round-20
+        serve path paid per query.  Asserts placements bit-identical,
+        recompiles bounded by the pow2 buckets touched (trace-once-per-
+        bucket), and the append path faster than the rebuild.
+    (b) warm serve fit QPS before/after: the SAME alternating fit-query
+        mix through an in-process SessionStore/Batcher with
+        SIMTPU_SERVE_WARM on vs off.  Asserts >= 10x warm throughput and
+        ZERO retensorize fallbacks on the warm mix.
+    """
+    from simtpu import constants as C
+    from simtpu.core.objects import AppResource, ResourceTypes, set_label
+    from simtpu.core.tensorize import Tensorizer
+    from simtpu.engine.rounds import RoundsEngine
+    from simtpu.obs.metrics import REGISTRY
+    from simtpu.synth import make_deployment, synth_cluster
+    from simtpu.workloads.expand import (
+        get_valid_pods_exclude_daemonset,
+        seed_name_hashes,
+    )
+
+    do_assert = os.environ.get("SIMTPU_BENCH_GROW_ASSERT", "") == "1"
+    n_nodes = int(os.environ.get("SIMTPU_BENCH_GROW_NODES", 64))
+    n_waves = int(os.environ.get("SIMTPU_BENCH_GROW_WAVES", 6))
+    out = {}
+
+    # ---- (a) extend_state vs re-tensorize+build_state ------------------
+    note("grow point: append-only growth vs re-tensorize rebuild")
+    cluster = synth_cluster(n_nodes, seed=11, zones=2)
+
+    def expand(name, deployments, seed):
+        res = ResourceTypes()
+        res.deployments = deployments
+        app = AppResource(name=name, resource=res)
+        seed_name_hashes(seed)
+        pods = []
+        for pod in get_valid_pods_exclude_daemonset(app.resource):
+            set_label(pod, C.LABEL_APP_NAME, app.name)
+            pods.append(pod)
+        return pods
+
+    # wave 0 is the session base; waves 1.. are the serving mix — two
+    # query SHAPES that each intern their vocabulary once (an extend,
+    # traced once per bucket) and then repeat with fresh pod names, the
+    # zero-retensorize common path every later wave rides
+    def query_wave(i):
+        shape = i % 2
+        return expand(f"shape-{shape}", [
+            make_deployment(
+                f"shape-{shape}", 24, 200, 128,
+                anti_affinity_topo="kubernetes.io/hostname",
+            )
+        ], 2000 + i)
+
+    waves = [expand("base", [
+        make_deployment(
+            f"svc-{j}", 12, 200, 128,
+            anti_affinity_topo="kubernetes.io/hostname",
+        )
+        for j in range(6)
+    ], 1000)]
+    waves += [query_wave(i) for i in range(1, n_waves)]
+    steady_from = 3  # both query shapes interned by wave 2
+    assert n_waves > steady_from + 1, "need steady-state waves to time"
+    tz = Tensorizer(cluster.nodes)
+    eng = RoundsEngine(tz)
+    eng.enable_grow()
+    batch0 = tz.add_pods(waves[0])
+    eng.place(batch0)  # compile + warm (first bucket traces here)
+    s0 = REGISTRY.snapshot()
+    warm_nodes, warm_s, steady = [], 0.0, {}
+    for i, pods in enumerate(waves[1:], 1):
+        if i == steady_from:
+            steady = REGISTRY.snapshot()
+        batch = tz.add_pods(pods)
+        t0 = time.perf_counter()
+        nodes, _r, _e = eng.place(batch)
+        if i >= steady_from:
+            warm_s += time.perf_counter() - t0
+        warm_nodes.append(np.asarray(nodes))
+    end = REGISTRY.snapshot()
+    d = {
+        k: end.get(k, 0) - s0.get(k, 0)
+        for k in ("grow.extends", "grow.bucket_promotions", "grow.rebuilds",
+                  "compile.grow")
+    }
+    # the trace-once-per-bucket contract, asserted where it bites: once
+    # the mix's shapes are interned, MORE waves compile NOTHING
+    steady_traces = sum(
+        end.get(k, 0) - steady.get(k, 0)
+        for k in ("compile.grow", "compile.rounds", "compile.scan",
+                  "compile.wave")
+    )
+
+    # the rebuild leg: per steady-state wave, a from-scratch tensorizer
+    # + a replay of the whole placement history before the query wave
+    # lands — what the pre-round-20 serve path paid per fit query.  The
+    # shape progression matches the warm leg's, so the jit cache is
+    # already warm and the clock measures the re-tensorize + replay work
+    # itself.
+    def cold_wave(i):
+        t0 = time.perf_counter()
+        tz2 = Tensorizer(cluster.nodes)
+        eng2 = RoundsEngine(tz2)
+        eng2.compact = False  # match the grow layout's dense carry
+        last = None
+        for pods in waves[: i + 1]:
+            batch2 = tz2.add_pods(pods)
+            last, _r2, _e2 = eng2.place(batch2)
+        return np.asarray(last), time.perf_counter() - t0
+
+    rebuild_s = 0.0
+    cold_wave(1)  # compile the cold leg's own dense-path kernels
+    cold_nodes = []
+    for i in range(1, n_waves):
+        last, dt = cold_wave(i)
+        cold_nodes.append(last)
+        if i >= steady_from:
+            rebuild_s += dt
+    identical = all(
+        np.array_equal(a, b) for a, b in zip(warm_nodes, cold_nodes)
+    )
+    n_steady = n_waves - steady_from
+    warm_ms = 1000 * warm_s / n_steady
+    rebuild_ms = 1000 * rebuild_s / n_steady
+    note(
+        f"grow point: steady warm wave {warm_ms:.1f}ms vs rebuild "
+        f"{rebuild_ms:.1f}ms ({rebuild_ms / max(warm_ms, 1e-9):.1f}x), "
+        f"extends={d['grow.extends']} "
+        f"promotions={d['grow.bucket_promotions']} "
+        f"rebuilds={d['grow.rebuilds']} traces={d['compile.grow']} "
+        f"steady_traces={steady_traces} identical={identical}"
+    )
+    out["grow_warm_wave_ms"] = round(warm_ms, 2)
+    out["grow_rebuild_wave_ms"] = round(rebuild_ms, 2)
+    out["grow_speedup"] = round(rebuild_ms / max(warm_ms, 1e-9), 2)
+    out["grow_identical"] = identical
+    out["grow_steady_traces"] = int(steady_traces)
+    out.update({
+        f"grow_{k.split('.', 1)[-1]}": int(v) for k, v in d.items()
+    })
+    if do_assert:
+        assert identical, "grow placements diverged from the rebuild leg"
+        assert d["grow.rebuilds"] == 0, d
+        assert d["grow.extends"] >= 1, d
+        assert steady_traces == 0, (
+            f"steady-state waves recompiled {steady_traces}x"
+        )
+        assert out["grow_speedup"] > 1.0, out
+
+    # ---- (b) warm serve fit QPS before/after ---------------------------
+    note("grow point: warm vs cold serve fit QPS")
+    from simtpu.durable.deadline import RunControl
+    from simtpu.serve.batching import Batcher, Query
+    from simtpu.serve.session import SessionStore
+
+    def fit_payload(i):
+        shape = i % 2
+        name = f"bench-fit-{shape}"
+        return {"workloads": [{
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {
+                "replicas": 1 + shape,
+                "template": {
+                    "metadata": {"labels": {"app": name}},
+                    "spec": {"containers": [{
+                        "name": "c", "image": "app",
+                        "resources": {"requests": {
+                            "cpu": "250m" if shape else "100m",
+                            "memory": "128Mi",
+                        }},
+                    }]},
+                },
+            },
+        }]}
+
+    def fit_qps(warm_on, n_queries):
+        prev = os.environ.get("SIMTPU_SERVE_WARM")
+        os.environ["SIMTPU_SERVE_WARM"] = "1" if warm_on else "0"
+        try:
+            store = SessionStore(state_dir="", audit=False)
+            session, _created = store.create("examples/simtpu-config.yaml")
+            batcher = Batcher(store)
+
+            def one(i):
+                q = Query(kind="fit", session=session,
+                          payload=fit_payload(i), control=RunControl())
+                with session.lock:
+                    return batcher._run_fit(q)
+
+            one(0), one(1)  # per-shape warm-up (compile off the clock)
+            t0 = time.perf_counter()
+            for i in range(n_queries):
+                doc = one(i)
+                assert doc["ok"], doc
+            wall = time.perf_counter() - t0
+            return n_queries / wall, doc
+        finally:
+            if prev is None:
+                os.environ.pop("SIMTPU_SERVE_WARM", None)
+            else:
+                os.environ["SIMTPU_SERVE_WARM"] = prev
+
+    s1 = REGISTRY.snapshot()
+    warm_qps, warm_doc = fit_qps(True, 40)
+    fallbacks = (
+        REGISTRY.snapshot().get("grow.retensorize_fallbacks", 0)
+        - s1.get("grow.retensorize_fallbacks", 0)
+    )
+    cold_qps, _cold_doc = fit_qps(False, 6)
+    note(
+        f"grow point: warm fit {warm_qps:.1f} q/s vs cold "
+        f"{cold_qps:.1f} q/s ({warm_qps / max(cold_qps, 1e-9):.1f}x), "
+        f"warm fallbacks={fallbacks}"
+    )
+    out["grow_serve_warm_qps"] = round(warm_qps, 1)
+    out["grow_serve_cold_qps"] = round(cold_qps, 1)
+    out["grow_serve_speedup"] = round(warm_qps / max(cold_qps, 1e-9), 1)
+    out["grow_serve_fallbacks"] = int(fallbacks)
+    if do_assert:
+        assert warm_doc.get("warm") is True, warm_doc
+        assert fallbacks == 0, f"warm mix re-tensorized {fallbacks}x"
+        assert out["grow_serve_speedup"] >= 10.0, out
+        note("grow point asserts passed")
+    return out
+
+
 def time_plan():
     """The min-node-add plan at north-star scale: a 100k-node cluster whose
     Open-Local capacity strands ~28k LVM pods of a 1M-pod selector-free mix,
@@ -2311,6 +2557,16 @@ def main() -> int:
         except Exception as exc:  # noqa: BLE001 - report, keep the line
             note(f"scan smoke point failed: {type(exc).__name__}: {exc}")
             record["scan_smoke_error"] = f"{type(exc).__name__}: {exc}"
+    # round-20 warm-engine serving (append-only vocabulary growth): on by
+    # default at north-star runs, SIMTPU_BENCH_GROW=1 forces it at any
+    # configuration (`make bench-grow` = the asserting smoke), =0 skips
+    grow_env = os.environ.get("SIMTPU_BENCH_GROW", "")
+    if grow_env != "0" and (north_star or grow_env == "1"):
+        try:
+            record.update(grow_point())
+        except Exception as exc:  # noqa: BLE001 - report, keep the line
+            note(f"grow point failed: {type(exc).__name__}: {exc}")
+            record["grow_error"] = f"{type(exc).__name__}: {exc}"
     # OOM-backoff telemetry (durable/backoff.py): process-lifetime
     # counters — nonzero only when a dispatch really hit
     # RESOURCE_EXHAUSTED (or the durable point injected one)
@@ -2330,6 +2586,7 @@ def main() -> int:
             "plan_error", "big_point_error", "fault_error", "layout_error",
             "durable_error", "audit_error", "obs_error", "explain_error",
             "serve_error", "timeline_error", "scan_smoke_error",
+            "grow_error",
         )
     ) else 0
 

@@ -84,6 +84,14 @@ def _plan_json(plan, resilience: dict = None) -> str:
             len(plan.result.unscheduled_pods) if plan.result is not None else None
         ),
     }
+    if isinstance(doc.get("engine"), dict):
+        # grow.* counter family (append-only vocabulary growth): zero for
+        # a one-shot apply unless the run extended a warm carry, but the
+        # block is ALWAYS present so consumers need no feature probe
+        from .engine.state import grow_counters_doc
+
+        doc["engine"] = dict(doc["engine"])
+        doc["engine"]["grow"] = grow_counters_doc()
     if plan.explain:
         # the versioned decision-observability block (simtpu/explain,
         # --explain): failure breakdowns + bottleneck analysis

@@ -574,6 +574,13 @@ def slice_batch(batch: PodBatch, idx) -> PodBatch:
     )
 
 
+class GrowRefused(RuntimeError):
+    """`Tensorizer.add_clone_nodes` cannot extend the node axis in place —
+    the extension would change something the interned vocabularies already
+    depend on (zone key, a reduction route, an ext plane width).  Raised
+    BEFORE any mutation; callers fall back to a full re-tensorize."""
+
+
 class Tensorizer:
     """Incremental tensorization: one instance per simulation.
 
@@ -1224,6 +1231,207 @@ class Tensorizer:
                 ):
                     row[t] = True
             self._smatch_done[gid] = t_n
+
+    # -- append-only node growth (warm-engine serving, ISSUE 20) -----------
+
+    def add_clone_nodes(self, new_nodes: Sequence[dict]) -> None:
+        """Append template-clone nodes to the node axis in place.
+
+        The node-side arrays are "fixed at construction" above — this is the
+        ONE sanctioned mutation, and it must leave the tensorizer
+        indistinguishable from a from-scratch `Tensorizer(all_nodes)` fed the
+        same pod sequence (modulo global domain-id numbering, which every
+        consumer treats as opaque): the serve capacity fast path and the
+        replay autoscaler grow a warm engine's node axis through it
+        (`Engine.grow_nodes`) instead of re-tensorizing the cluster.
+
+        Raises `GrowRefused` — without mutating any state — when the
+        extension would change something the interned vocabularies already
+        depend on: the SelectorSpread zone key, a topology key's same-domain
+        reduction route (`key_kind`), or an extended-storage plane width.
+        Callers fall back to a full re-tensorize; the refusal is a
+        correctness guard, never an error.
+        """
+        new_nodes = list(new_nodes)
+        if not new_nodes:
+            return
+        nodes = self.nodes + new_nodes
+        n = len(nodes)
+        li = NodeLabelIndex(nodes)
+
+        # -- pass 1: validation only (no mutation before any refusal) ------
+        if li.has_key(C.LABEL_ZONE).any():
+            zone_key = C.LABEL_ZONE
+        elif li.has_key(C.LABEL_ZONE_BETA).any():
+            zone_key = C.LABEL_ZONE_BETA
+        else:
+            zone_key = None
+        if zone_key != self.zone_key and any(
+            self._ss_host[g] or self._ss_zone[g] for g in range(len(self.groups))
+        ):
+            # a from-scratch tensorize would have interned the spread
+            # selectors' zone terms under the recomputed key
+            raise GrowRefused(
+                "SelectorSpread zone key would flip with the new nodes"
+            )
+        kinds = []
+        for key in self.topo_keys.items():
+            key = str(key)
+            vid = li._vid.get(key)
+            if vid is None:
+                kinds.append(1)
+                continue
+            vmap = li._vmap[key]
+            if len(vmap) <= DOM_SMALL:
+                kinds.append(1)
+            elif vid.max(initial=-1) >= 0 and np.all(
+                np.bincount(vid[vid >= 0]) <= 1
+            ):
+                kinds.append(2)
+            else:
+                kinds.append(0)
+        for k, kind in enumerate(kinds):
+            if kind != self._key_kinds[k]:
+                raise GrowRefused(
+                    f"topology key {self.topo_keys.items()[k]!r} would "
+                    f"change reduction route ({self._key_kinds[k]} -> {kind})"
+                )
+        from .extended import NodeStorage
+
+        for node in new_nodes:
+            s = NodeStorage.from_node(node)
+            if s:
+                if len(s.vgs) > self.ext.vg_cap.shape[1]:
+                    raise GrowRefused("new node widens the VG plane")
+                if len(s.devices) > self.ext.sdev_cap.shape[1]:
+                    raise GrowRefused("new node widens the device plane")
+            cap = ((node.get("status") or {}).get("capacity")) or {}
+            if int(parse_quantity(cap.get(C.RES_GPU_COUNT))) > (
+                self.ext.gpu_dev_total.shape[1]
+            ):
+                raise GrowRefused("new node widens the GPU device plane")
+
+        # -- pass 2: extend ------------------------------------------------
+        self.nodes = nodes
+        self.label_index = li
+        self.zone_key = zone_key
+        for i, node in enumerate(new_nodes):
+            self.node_idx[name_of(node)] = len(self.node_idx)
+            self._alloc_maps.append(node_allocatable(node))
+            for rname in self._alloc_maps[-1]:
+                self.resources.intern(rname)
+        r = len(self.resources)
+        alloc = np.zeros((n, r), np.float32)
+        alloc[: self.alloc.shape[0], : self.alloc.shape[1]] = self.alloc
+        for i in range(len(self.nodes) - len(new_nodes), n):
+            for rname, val in self._alloc_maps[i].items():
+                alloc[i, self.resources.intern(rname)] = val
+        self.alloc = alloc
+
+        # extended storage/GPU planes: re-run over all nodes (interner is
+        # idempotent, widths pinned equal by pass 1)
+        self.ext = tensorize_node_storage(self.nodes, self.vg_names)
+
+        # distinct-taint machinery: rebuild from scratch over all nodes —
+        # first-seen node order keeps the old distinct-taint prefix stable
+        for node in new_nodes:
+            taints = list(node_taints(node))
+            if node_unschedulable(node):
+                taints = taints + [_UNSCHEDULABLE_TAINT]
+            self.taints.append(taints)
+        self._hard_taints = []
+        self._pref_taints = []
+        hard_ids: Dict[str, int] = {}
+        pref_ids: Dict[str, int] = {}
+        hard_rows: List[np.ndarray] = []
+        pref_rows: List[np.ndarray] = []
+        for i, taints in enumerate(self.taints):
+            for taint in taints:
+                effect = taint.get("effect")
+                if effect in ("NoSchedule", "NoExecute"):
+                    ids, rows, bucket = hard_ids, hard_rows, self._hard_taints
+                elif effect == "PreferNoSchedule":
+                    ids, rows, bucket = pref_ids, pref_rows, self._pref_taints
+                else:
+                    continue
+                key = _canon(taint)
+                t = ids.get(key)
+                if t is None:
+                    t = ids[key] = len(bucket)
+                    bucket.append(taint)
+                    rows.append(np.zeros(n, bool))
+                rows[t][i] = True
+        self._hard_taint_incid = (
+            np.stack(hard_rows) if hard_rows else np.zeros((0, n), bool)
+        )
+        self._pref_taint_incid = (
+            np.stack(pref_rows) if pref_rows else np.zeros((0, n), bool)
+        )
+
+        self.prefer_avoid = np.array(
+            [node_prefer_avoid_pods(nd) for nd in self.nodes], bool
+        )
+        self.image_index = {}
+        for i, node in enumerate(self.nodes):
+            for img in node_images(node):
+                size = float(img.get("sizeBytes") or 0)
+                for nm in img.get("names") or []:
+                    have, _ = self.image_index.setdefault(
+                        nm, (np.zeros(n, bool), size)
+                    )
+                    have[i] = True
+
+        # topology rows: recompute over all nodes. `vmap.items()` follows
+        # first-seen node order, so old domain values re-intern to their
+        # existing ids and only genuinely new values append (the numbering
+        # still differs from from-scratch across MULTIPLE keys — by-key
+        # instead of by-pod-sequence — which is fine: domain ids are opaque
+        # scatter indices, and the grow carry is dense [T, N], never [Rt, D])
+        self._node_dom_rows = []
+        self._node_dom_small_rows = []
+        for k, key in enumerate(self.topo_keys.items()):
+            key = str(key)
+            vid = li._vid.get(key)
+            if vid is None:
+                row = np.full(n, -1, np.int32)
+                small = np.full(n, -1, np.int32)
+            else:
+                vmap = li._vmap[key]
+                dom_of = np.empty(len(vmap) + 1, np.int32)
+                dom_of[-1] = -1
+                for v, j in vmap.items():
+                    dom_of[j] = self.domains.intern((key, v))
+                row = dom_of[vid]
+                if kinds[k] == 1 and len(vmap):
+                    small = vid.astype(np.int32)
+                else:
+                    small = np.full(n, -1, np.int32)
+            self._node_dom_rows.append(row)
+            self._node_dom_small_rows.append(small)
+        self._key_kinds = kinds
+
+        # group planes: recompute every row through the stored evaluators —
+        # deterministic functions of (group, rebuilt node-side state), so the
+        # old-node prefix is unchanged and the result matches from-scratch
+        # (ImageLocality's spread fraction legitimately shifts with N for ALL
+        # nodes; statics are re-derived from the next freeze() anyway)
+        self._pv_mask_cache = {}
+        self._static_mask = _RowTable(n, bool)
+        self._vol_mask = _RowTable(n, bool, fill=True)
+        self._node_pref = _RowTable(n, np.float32)
+        self._taint_intol = _RowTable(n, np.float32)
+        self._static_score = _RowTable(n, np.float32)
+        self._avoid_pen = _RowTable(n, np.float32)
+        for g in self.groups:
+            self._static_mask.append(self._static_mask_for(g))
+            self._vol_mask.append(self._volume_mask_for(g))
+            self._node_pref.append(self._node_pref_for(g))
+            self._taint_intol.append(self._taint_intol_for(g))
+            self._static_score.append(self._static_score_for(g))
+            self._avoid_pen.append(self._avoid_penalty_for(g))
+
+        self._attach_cache = None
+        self._content_version += 1
 
     # -- batches -----------------------------------------------------------
 

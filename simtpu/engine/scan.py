@@ -64,11 +64,18 @@ from .state import (
     compress_state,
     delta_direct_enabled,
     expand_state,
+    extend_state,
+    extend_state_nodes,
+    grow_plan_nodes,
+    grow_plan_terms,
     interpod_term_index,
     node_dom_for,
     node_dom_small_for,
     pack_delta_entries,
+    snap_pow2,
     state_nbytes,
+    strip_term_padding,
+    _pad_terms_kernel,
     take_rows,
     take_rows_i32,
     update_state_gauge,
@@ -101,7 +108,7 @@ FAIL_VOLUME_BIND = 11  # PVC missing / not bindable / PV zone mismatch
 # increments.)  The backing store is the obs metrics registry under
 # `compile.<kind>` (read via `obs.metrics.family("compile",
 # COMPILE_COUNT_KINDS)` — the ISSUE-8 alias views are gone).
-COMPILE_COUNT_KINDS = ("scan", "rounds", "wave", "explain", "solve")
+COMPILE_COUNT_KINDS = ("scan", "rounds", "wave", "explain", "solve", "grow")
 
 
 def count_trace(kind: str) -> None:
@@ -2874,6 +2881,14 @@ class Engine:
         self.last_state: SchedState = None
         self._last_vocab = None  # vocabulary sizes behind last_state
         self._state_dirty = False  # log surgery (preemption) invalidates reuse
+        #: append-only vocabulary growth (warm-engine serving): when set,
+        #: the carried state lives DENSE with its term axes pre-padded to
+        #: pow2 shape buckets, and a vocabulary growth extends it in place
+        #: (engine/state.py extend_state) instead of rebuilding from the
+        #: log.  Placements are bit-identical on or off; enable via
+        #: enable_grow() (serve sessions, the replay runtime).
+        self.grow = False
+        self._grow_ref = None  # carried layout: t/ti/ip_terms/caps/n
 
     def log_req_matrix(self, r: int) -> np.ndarray:
         """The placement log's request rows padded to the r-column resource
@@ -2899,7 +2914,127 @@ class Engine:
             tensors.n_ports,
             tensors.n_vols,
             int((interpod_term_index(tensors) >= 0).sum()),
+            # the node axis participates since add_clone_nodes can grow it
+            # mid-simulation (append-only vocabulary growth, ISSUE 20)
+            tensors.alloc.shape[0],
         )
+
+    # -- append-only vocabulary growth (warm-engine serving) -------------
+
+    def enable_grow(self) -> None:
+        """Switch this engine to grow mode: the carried state stays DENSE
+        with its term axes pre-padded to pow2 shape buckets, and a
+        vocabulary growth extends it in place (one `extend_state` call)
+        instead of forcing the O(P·T) from-log rebuild.  Compression is
+        disabled — the compact plan is keyed to the exact term partition
+        and would re-trace per vocabulary size, defeating the
+        trace-once-per-bucket contract.  Placements are bit-identical
+        either way (tests/test_grow.py)."""
+        self.grow = True
+        self.compact = False
+
+    def _grow_layout(self, tensors) -> dict:
+        """The bucket layout of a grow-mode carry built over `tensors`."""
+        ip_terms = np.flatnonzero(interpod_term_index(tensors) >= 0)
+        t, ti = tensors.n_terms, len(ip_terms)
+        return {
+            "t": t,
+            "ti": ti,
+            "ip_terms": ip_terms,
+            "t_cap": snap_pow2(t) if t else 0,
+            "ti_cap": snap_pow2(ti) if ti else 0,
+            "n": tensors.alloc.shape[0],
+        }
+
+    def _enter_grow_buckets(self, tensors, dense_state):
+        """Pad a freshly built exact-shape state into its term buckets and
+        record the carried layout."""
+        ref = self._grow_layout(tensors)
+        state = _pad_terms_kernel(ref["t_cap"], ref["ti_cap"], dense_state)
+        self._grow_ref = ref
+        return state
+
+    def _try_extend_carry(self, tensors, vocab):
+        """Extend the carried (bucket-padded) state to a grown vocabulary;
+        None when the change is not an in-place append (resource/port/
+        volume axes or the node axis moved — rare, rebuild instead)."""
+        old = self._last_vocab
+        ref = self._grow_ref
+        if old is None or ref is None:
+            return None
+        r1, t1, p1, w1, _ti1, n1 = vocab
+        r0, _t0, p0, w0, _ti0, n0 = old
+        if (r1, p1, w1, n1) != (r0, p0, w0, n0) or n1 != ref["n"]:
+            return None
+        if t1 < ref["t"]:
+            return None
+        plan = grow_plan_terms(
+            tensors,
+            ref["t"],
+            ref["ip_terms"],
+            np.asarray(self.placed_group, np.int32),
+            np.asarray(self.placed_node, np.int32),
+        )
+        promoted = (
+            plan["t_cap"] != ref["t_cap"] or plan["ti_cap"] != ref["ti_cap"]
+        )
+        # the extension donates the carry: mark dirty across the call so a
+        # failure never leaves a dead buffer looking reusable
+        self._state_dirty = True
+        state = extend_state(self.last_state, plan)
+        self._state_dirty = False
+        self._grow_ref = {
+            "t": plan["t"],
+            "ti": plan["ti"],
+            "ip_terms": plan["ip_terms"],
+            "t_cap": plan["t_cap"],
+            "ti_cap": plan["ti_cap"],
+            "n": n1,
+        }
+        REGISTRY.counter("grow.extends").inc()
+        if promoted:
+            REGISTRY.counter("grow.bucket_promotions").inc()
+        return state
+
+    def grow_nodes(self) -> bool:
+        """Extend the carried state to the tensorizer's grown node axis
+        (after `Tensorizer.add_clone_nodes`) — counts of pods already
+        placed in a domain a clone joins appear on the clone's columns.
+        Returns False (carry invalidated, next place() rebuilds from the
+        log) when no extendable grow-mode carry exists."""
+        tensors = self.tensorizer.freeze()
+        vocab = self.state_vocab(tensors)
+        if self._last_vocab == vocab and not self._state_dirty:
+            return True  # node axis did not actually move
+        ref = self._grow_ref
+        if (
+            not self.grow
+            or ref is None
+            or self.last_state is None
+            or self._state_dirty
+            or isinstance(self.last_state, CompactState)
+            or self._last_vocab is None
+            # only the node axis may have moved
+            or self._last_vocab[:5] != vocab[:5]
+            or tensors.alloc.shape[0] < ref["n"]
+        ):
+            self._last_vocab = None
+            return False
+        plan = grow_plan_nodes(
+            tensors,
+            ref["n"],
+            np.asarray(self.placed_group, np.int32),
+            np.asarray(self.placed_node, np.int32),
+            ref["t_cap"],
+            ref["ti_cap"],
+        )
+        self._state_dirty = True
+        self.last_state = extend_state_nodes(self.last_state, plan, tensors)
+        self._state_dirty = False
+        self._grow_ref = dict(ref, n=plan["n"])
+        self._last_vocab = vocab
+        REGISTRY.counter("grow.node_extends").inc()
+        return True
 
     def _aot_scan(self, flags: StepFlags):
         """(pipeline key name, jit callable, static argument tail) for the
@@ -3017,6 +3152,12 @@ class Engine:
             )
         if isinstance(state, CompactState):
             state = self._expand_carry(tensors, state)
+        if self.grow and self._grow_ref is not None:
+            # grow-mode carries are bucket-padded; consumers get the
+            # exact-shape view
+            state = strip_term_padding(
+                state, self._grow_ref["t"], self._grow_ref["ti"]
+            )
         return state
 
     def _scan_call(self, statics, state, seg, flags):
@@ -3094,13 +3235,28 @@ class Engine:
                 # dispatch below leaves it intact for the log fallback
                 state = self._expand_carry(tensors, state)
         else:
-            state = build_state(
-                tensors,
-                np.asarray(self.placed_group, np.int32),
-                np.asarray(self.placed_node, np.int32),
-                self.log_req_matrix(r),
-                self.ext_log,
-            )
+            state = None
+            if (
+                self.grow
+                and self.last_state is not None
+                and not self._state_dirty
+                and not isinstance(self.last_state, CompactState)
+            ):
+                # append-only vocabulary growth: extend the carried planes
+                # in place instead of rebuilding from the log
+                state = self._try_extend_carry(tensors, vocab)
+            if state is None:
+                if self.grow and self._grow_ref is not None:
+                    REGISTRY.counter("grow.rebuilds").inc()
+                state = build_state(
+                    tensors,
+                    np.asarray(self.placed_group, np.int32),
+                    np.asarray(self.placed_node, np.int32),
+                    self.log_req_matrix(r),
+                    self.ext_log,
+                )
+                if self.grow:
+                    state = self._enter_grow_buckets(tensors, state)
         statics = statics_from(tensors, self.sched_config)
         if self.node_valid is not None:
             # fault/what-if masking: dead rows no pod can select — the same

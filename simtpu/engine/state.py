@@ -442,6 +442,399 @@ def pack_delta_entries(entries, n_resources: int, vg_w: int, sd_w: int, gd_w: in
     return (g_a, n_a, w_a, req_a, vg_a, sd_a, gp_a)
 
 
+# -- append-only vocabulary growth (warm-engine serving) ---------------------
+#
+# Between place() calls the pod/term vocabulary only ever APPENDS (Interners
+# never reassign ids), so a carried state can follow a grown vocabulary with
+# a device-side extension instead of the O(P·T) host rebuild build_state
+# performs: new term rows are computed host-side from the SAME group-level
+# aggregation build_state uses (bit-identity by shared math — counts are
+# integer-valued f32, and per-row contributions accumulate over the sparse
+# (group, term) pairs in the same ascending-group order), the compacted
+# interpod planes are re-laid-out by one gather (an old term newly marked
+# interpod-used INSERTS a row mid-plane; its values are zero — only groups
+# interned after the mark own it, and they have no placements yet), and
+# everything else passes through.
+#
+# To bound recompiles, a grow-mode engine carries its term axes PRE-PADDED
+# to pow2 shape buckets: cnt_match/cnt_total live at [T_cap, N]/[T_cap] and
+# the own planes at [Ti_cap, N] with zero rows above the live watermark.
+# Every consumer addresses term rows by id (< T), so padding rows are never
+# read or written — dispatch executables, the delta apply/undo path and the
+# chunked scan all key on the BUCKET shape and stay warm while the
+# vocabulary grows within it.  Growth events trace `_extend_terms_kernel`
+# once per (old bucket, new bucket, appended-row bucket) signature — the
+# `compile.grow` trace-once-per-bucket contract (tests/test_grow.py).
+# Grow-mode carries stay dense (compression re-derives its plan from the
+# tensors' exact term partition and would re-trace per vocabulary size).
+
+
+def snap_pow2(x: int, floor: int = 1) -> int:
+    """Next power of two ≥ x (at least `floor`) — the shape-bucket snap for
+    grow-mode carried planes and appended-row batches."""
+    return max(floor, 1 << max(int(x) - 1, 0).bit_length())
+
+
+#: counter names surfaced in the `engine.grow` response/CLI block — the
+#: registry family tests/test_grow.py and `make bench-grow` pin.  Lives
+#: here (not in simtpu.serve) so `apply --json` can report it without
+#: importing the daemon (the off-path zero-cost pin, tests/test_serve.py).
+GROW_COUNTERS = (
+    "grow.extends",
+    "grow.bucket_promotions",
+    "grow.node_extends",
+    "grow.rebuilds",
+    "grow.retensorize_fallbacks",
+    "compile.grow",
+)
+
+
+def grow_counters_doc() -> dict:
+    """The append-only-growth counter block (process registry — monotone
+    across queries, like the `compile.*` family), `grow.` prefix
+    stripped.  serve/session.py's `grow_doc` layers the per-session
+    warm/bucket fields on top."""
+    from ..obs.metrics import REGISTRY
+
+    snap = REGISTRY.snapshot()
+    return {
+        name.split("grow.", 1)[-1] if name.startswith("grow.") else name:
+            int(snap.get(name, 0))
+        for name in GROW_COUNTERS
+    }
+
+
+def _count_grow_trace() -> None:
+    """Python-side trace counter: executes once per (re)trace of a growth
+    kernel, never at run time — the `compile.grow` registry family
+    (engine/scan.py COMPILE_COUNT_KINDS)."""
+    from ..obs.metrics import REGISTRY
+
+    REGISTRY.counter("compile.grow").inc()
+
+
+@partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2,))
+def _pad_terms_kernel(t_cap: int, ti_cap: int, state: SchedState) -> SchedState:
+    """Copy a freshly built exact-shape state into its pow2 term buckets
+    (zero rows above the live watermark) — the grow-mode entry copy, once
+    per (exact shape, bucket) pair."""
+    _count_grow_trace()
+
+    def pad_rows(plane, cap):
+        if plane.shape[0] == cap:
+            return plane
+        return (
+            jnp.zeros((cap,) + plane.shape[1:], plane.dtype)
+            .at[: plane.shape[0]]
+            .set(plane)
+        )
+
+    return state._replace(
+        cnt_match=pad_rows(state.cnt_match, t_cap),
+        cnt_total=pad_rows(state.cnt_total, t_cap),
+        cnt_own_anti=pad_rows(state.cnt_own_anti, ti_cap),
+        cnt_own_aff=pad_rows(state.cnt_own_aff, ti_cap),
+        w_own_aff_pref=pad_rows(state.w_own_aff_pref, ti_cap),
+        w_own_anti_pref=pad_rows(state.w_own_anti_pref, ti_cap),
+    )
+
+
+@partial(jax.jit, static_argnums=(5, 6), donate_argnums=(0,))
+def _extend_terms_kernel(
+    state: SchedState, new_ids, new_rows, new_tot, own_perm,
+    t_cap: int, ti_cap: int,
+) -> SchedState:
+    """Device-side term-axis extension: promote the count planes into the
+    target buckets, scatter the host-computed appended term rows (padded
+    ids are -1 → masked to zero adds), and re-gather the own planes
+    through the new interpod layout (`own_perm[j]` = old row feeding new
+    row j, -1 = fresh zero row)."""
+    _count_grow_trace()
+    cm, ct = state.cnt_match, state.cnt_total
+    if cm.shape[0] != t_cap:
+        cm = jnp.zeros((t_cap, cm.shape[1]), cm.dtype).at[: cm.shape[0]].set(cm)
+        ct = jnp.zeros((t_cap,), ct.dtype).at[: ct.shape[0]].set(ct)
+    if new_ids.shape[0]:
+        safe = jnp.clip(new_ids, 0)
+        live = new_ids >= 0
+        cm = cm.at[safe].add(jnp.where(live[:, None], new_rows, 0.0))
+        ct = ct.at[safe].add(jnp.where(live, new_tot, 0.0))
+
+    def permute(plane):
+        if not ti_cap:
+            return plane
+        if not plane.shape[0]:
+            return jnp.zeros((ti_cap, state.cnt_match.shape[1]), plane.dtype)
+        return jnp.where(
+            (own_perm >= 0)[:, None],
+            plane[jnp.clip(own_perm, 0)],
+            jnp.zeros((), plane.dtype),
+        )
+
+    return state._replace(
+        cnt_match=cm,
+        cnt_total=ct,
+        cnt_own_anti=permute(state.cnt_own_anti),
+        cnt_own_aff=permute(state.cnt_own_aff),
+        w_own_aff_pref=permute(state.w_own_aff_pref),
+        w_own_anti_pref=permute(state.w_own_anti_pref),
+    )
+
+
+@partial(jax.jit, static_argnums=(7, 8), donate_argnums=(0,))
+def _extend_nodes_kernel(
+    state: SchedState, free_rows, cnt_cols, own_cols,
+    vg_rows, sdev_rows, gpu_rows, n_ports: int, n_vols: int,
+) -> SchedState:
+    """Device-side node-axis extension: append the new nodes' free/storage
+    rows and the host-computed count-plane columns (pods already placed in
+    a domain the new node joins are visible from it immediately)."""
+    _count_grow_trace()
+    a = free_rows.shape[0]
+
+    def cat_cols(plane, cols):
+        return jnp.concatenate([plane, cols.astype(plane.dtype)], axis=1)
+
+    return state._replace(
+        free=jnp.concatenate([state.free, free_rows]),
+        cnt_match=cat_cols(state.cnt_match, cnt_cols),
+        cnt_own_anti=cat_cols(state.cnt_own_anti, own_cols[0]),
+        cnt_own_aff=cat_cols(state.cnt_own_aff, own_cols[1]),
+        w_own_aff_pref=cat_cols(state.w_own_aff_pref, own_cols[2]),
+        w_own_anti_pref=cat_cols(state.w_own_anti_pref, own_cols[3]),
+        vg_free=jnp.concatenate([state.vg_free, vg_rows]),
+        sdev_free=jnp.concatenate([state.sdev_free, sdev_rows]),
+        gpu_free=jnp.concatenate([state.gpu_free, gpu_rows]),
+        ports_used=jnp.concatenate(
+            [state.ports_used, jnp.zeros((a, n_ports), state.ports_used.dtype)]
+        ),
+        vols_any=jnp.concatenate(
+            [state.vols_any, jnp.zeros((a, n_vols), state.vols_any.dtype)]
+        ),
+        vols_rw=jnp.concatenate(
+            [state.vols_rw, jnp.zeros((a, n_vols), state.vols_rw.dtype)]
+        ),
+    )
+
+
+def _grow_aggregates(tensors, placed_group, placed_node, keys):
+    """The per-key [D, G] domain aggregates build_state derives its count
+    rows from, restricted to the topology keys a growth event touches.
+    One [P]-length bincount over the log plus a per-key row scatter —
+    O(P) instead of build_state's O(P·T)."""
+    n = tensors.alloc.shape[0]
+    g_n = len(tensors.groups)
+    d = tensors.n_domains
+    key_valid = tensors.node_dom >= 0
+    flat = placed_group.astype(np.int64) * n + placed_node
+    cnt_gn = (
+        np.bincount(flat, minlength=g_n * n).reshape(g_n, n).astype(np.float32)
+    )
+    cnt_dg, safe_k = {}, {}
+    for k in keys:
+        safe_k[k] = np.where(key_valid[k], tensors.node_dom[k], 0)
+        src = np.where(key_valid[k][None, :], cnt_gn, 0.0).T.copy()
+        buf = np.zeros((d, g_n), np.float32)
+        _add_at_rows(buf, safe_k[k], src)
+        cnt_dg[k] = buf
+    return cnt_dg, safe_k, key_valid
+
+
+def _term_rows_subset(tensors, placed_group, placed_node, term_ids):
+    """Count rows + cluster totals for a SUBSET of terms, by the same
+    aggregation build_state runs for all terms (integer-valued counts and
+    identical ascending-group accumulation keep the rows bit-identical to
+    a from-scratch rebuild's)."""
+    n = tensors.alloc.shape[0]
+    rows = np.zeros((len(term_ids), n), np.float32)
+    tot = np.zeros(len(term_ids), np.float32)
+    if not len(placed_group) or not len(term_ids):
+        return rows, tot
+    term_topo = tensors.term_topo_key
+    keys = {int(term_topo[tid]) for tid in term_ids}
+    cnt_dg, safe_k, key_valid = _grow_aggregates(
+        tensors, placed_group, placed_node, keys
+    )
+    tot_kg = {k: buf.sum(axis=0) for k, buf in cnt_dg.items()}
+    row_cache = {}
+
+    def group_row(k, g_i):
+        got = row_cache.get((k, g_i))
+        if got is None:
+            got = np.where(key_valid[k], cnt_dg[k][safe_k[k], g_i], 0.0)
+            row_cache[(k, g_i)] = got
+        return got
+
+    sub = tensors.s_match[:, term_ids]
+    for g_i, t_i in zip(*np.nonzero(sub)):
+        tid = int(term_ids[t_i])
+        k = int(term_topo[tid])
+        w = float(sub[g_i, t_i])
+        rows[t_i] += w * group_row(k, g_i)
+        tot[t_i] += w * tot_kg[k][g_i]
+    return rows, tot
+
+
+def _node_cols_subset(tensors, placed_group, placed_node, node_ids):
+    """Count-plane COLUMNS for appended nodes: cnt_match [T, a] plus the
+    four own planes [Ti, a] evaluated at the new nodes' domains — a pod
+    already placed in a zone a clone joins is counted on the clone."""
+    t = tensors.n_terms
+    ip_of = interpod_term_index(tensors)
+    ip_terms = np.flatnonzero(ip_of >= 0)
+    a = len(node_ids)
+    cnt_cols = np.zeros((t, a), np.float32)
+    own_cols = np.zeros((4, len(ip_terms), a), np.float32)
+    if not len(placed_group) or not t:
+        return cnt_cols, own_cols
+    term_topo = tensors.term_topo_key
+    keys = {int(x) for x in term_topo[:t]}
+    cnt_dg, safe_k, key_valid = _grow_aggregates(
+        tensors, placed_group, placed_node, keys
+    )
+    row_cache = {}
+
+    def group_cols(k, g_i):
+        got = row_cache.get((k, g_i))
+        if got is None:
+            got = np.where(
+                key_valid[k][node_ids],
+                cnt_dg[k][safe_k[k][node_ids], g_i],
+                0.0,
+            )
+            row_cache[(k, g_i)] = got
+        return got
+
+    def fill(dst, term_ids, incid):
+        sub = incid if term_ids is None else incid[:, term_ids]
+        for g_i, t_i in zip(*np.nonzero(sub)):
+            tid = t_i if term_ids is None else term_ids[t_i]
+            k = int(term_topo[tid])
+            dst[t_i] += float(sub[g_i, t_i]) * group_cols(k, g_i)
+
+    fill(cnt_cols, None, tensors.s_match)
+    for s_i, mat in enumerate(
+        (
+            tensors.a_anti_req,
+            tensors.a_aff_req,
+            tensors.w_aff_pref,
+            tensors.w_anti_pref,
+        )
+    ):
+        fill(own_cols[s_i], ip_terms, mat)
+    return cnt_cols, own_cols
+
+
+def grow_plan_terms(tensors, t_old: int, ip_terms_old, placed_group, placed_node):
+    """Host-side plan for a term-axis growth event: appended term rows and
+    totals (bucket-padded, ids -1 above the live count), the own-plane
+    re-layout gather, and the target buckets.  `ip_terms_old` is the
+    ascending term-id layout the carried own planes were built under."""
+    t_new = tensors.n_terms
+    ip_of = interpod_term_index(tensors)
+    ip_terms_new = np.flatnonzero(ip_of >= 0)
+    ti_new = len(ip_terms_new)
+    m = t_new - t_old
+    m_cap = snap_pow2(m) if m else 0
+    ids = np.full(m_cap, -1, np.int32)
+    rows = np.zeros((m_cap, tensors.alloc.shape[0]), np.float32)
+    tot = np.zeros(m_cap, np.float32)
+    if m:
+        new_ids = np.arange(t_old, t_new, dtype=np.int32)
+        ids[:m] = new_ids
+        rows[:m], tot[:m] = _term_rows_subset(
+            tensors, placed_group, placed_node, new_ids
+        )
+    ti_cap = snap_pow2(ti_new) if ti_new else 0
+    perm = np.full(max(ti_cap, 1), -1, np.int32)[:ti_cap]
+    pos_old = {int(tid): i for i, tid in enumerate(np.asarray(ip_terms_old))}
+    for j, tid in enumerate(ip_terms_new):
+        perm[j] = pos_old.get(int(tid), -1)
+    return {
+        "ids": ids,
+        "rows": rows,
+        "tot": tot,
+        "perm": perm,
+        "t": t_new,
+        "ti": ti_new,
+        "t_cap": snap_pow2(t_new) if t_new else 0,
+        "ti_cap": ti_cap,
+        "ip_terms": ip_terms_new,
+    }
+
+
+def extend_state(state: SchedState, plan: dict) -> SchedState:
+    """Apply a `grow_plan_terms` plan to a grow-mode carried state — the
+    jitted append-only alternative to build_state after a vocabulary
+    growth (bit-identity pinned by tests/test_grow.py)."""
+    return _extend_terms_kernel(
+        state,
+        jnp.asarray(plan["ids"]),
+        jnp.asarray(plan["rows"]),
+        jnp.asarray(plan["tot"]),
+        jnp.asarray(plan["perm"]),
+        plan["t_cap"],
+        plan["ti_cap"],
+    )
+
+
+def grow_plan_nodes(tensors, n_old: int, placed_group, placed_node,
+                    t_cap: int, ti_cap: int):
+    """Host-side plan for a node-axis growth event (Tensorizer.add_clone_nodes
+    appended rows [n_old:]): the new nodes' free/storage rows and the
+    count-plane columns at the carried bucket heights."""
+    n_new = tensors.alloc.shape[0]
+    node_ids = np.arange(n_old, n_new)
+    ext = tensors.ext
+    cnt_cols, own_cols = _node_cols_subset(
+        tensors, placed_group, placed_node, node_ids
+    )
+    t, ti = cnt_cols.shape[0], own_cols.shape[1]
+    cnt_p = np.zeros((t_cap, len(node_ids)), np.float32)
+    cnt_p[:t] = cnt_cols
+    own_p = np.zeros((4, ti_cap, len(node_ids)), np.float32)
+    own_p[:, :ti] = own_cols
+    return {
+        "free": tensors.alloc[n_old:].astype(np.float32),
+        "cnt_cols": cnt_p,
+        "own_cols": own_p,
+        "vg": (ext.vg_cap[n_old:] - ext.vg_req0[n_old:]).astype(np.float32),
+        "sdev": (ext.sdev_cap[n_old:] > 0) & ~ext.sdev_alloc0[n_old:],
+        "gpu": ext.gpu_dev_total[n_old:].astype(np.float32),
+        "n": n_new,
+    }
+
+
+def extend_state_nodes(state: SchedState, plan: dict, tensors) -> SchedState:
+    """Apply a `grow_plan_nodes` plan: one jitted concatenate per plane."""
+    return _extend_nodes_kernel(
+        state,
+        jnp.asarray(plan["free"]),
+        jnp.asarray(plan["cnt_cols"]),
+        jnp.asarray(plan["own_cols"]),
+        jnp.asarray(plan["vg"]),
+        jnp.asarray(plan["sdev"]),
+        jnp.asarray(plan["gpu"]),
+        tensors.n_ports,
+        tensors.n_vols,
+    )
+
+
+def strip_term_padding(state: SchedState, t: int, ti: int) -> SchedState:
+    """Exact-shape dense view of a grow-mode (bucket-padded) carry — what
+    carried_state() hands to consumers expecting [T, N]/[Ti, N] planes."""
+    if state.cnt_match.shape[0] == t and state.cnt_own_anti.shape[0] == ti:
+        return state
+    return state._replace(
+        cnt_match=state.cnt_match[:t],
+        cnt_total=state.cnt_total[:t],
+        cnt_own_anti=state.cnt_own_anti[:ti],
+        cnt_own_aff=state.cnt_own_aff[:ti],
+        w_own_aff_pref=state.w_own_aff_pref[:ti],
+        w_own_anti_pref=state.w_own_anti_pref[:ti],
+    )
+
+
 # -- compact carried state ---------------------------------------------------
 #
 # The carried count planes are [T, N] / [Ti, N] dense float32, but for every

@@ -62,7 +62,12 @@ from .errors import (
     Overloaded,
     ServeError,
 )
-from .session import EXPAND_LOCK as _EXPAND_LOCK, Session, SessionStore
+from .session import (
+    EXPAND_LOCK as _EXPAND_LOCK,
+    Session,
+    SessionStore,
+    grow_doc,
+)
 
 log = logging.getLogger("simtpu.serve")
 
@@ -98,6 +103,13 @@ _OOM_DEGRADED = REGISTRY.counter("serve.oom_degraded")
 _SWEEPS = REGISTRY.counter("serve.sweeps")
 _QUEUE_DEPTH = REGISTRY.gauge("serve.queue_depth")
 _REQUEST_S = REGISTRY.histogram("serve.request_s")
+#: warm-engine serving (ISSUE 20): queries answered by APPENDING into the
+#: session's grow-mode engine (zero re-tensorization), and the genuine
+#: vocabulary-class misses that fell back to the full legacy path — the
+#: acceptance pin is retensorize_fallbacks == 0 on the loadgen mix
+_WARM_FITS = REGISTRY.counter("serve.warm_fits")
+_WARM_CAPACITY = REGISTRY.counter("serve.warm_capacity")
+_RETENSORIZE = REGISTRY.counter("grow.retensorize_fallbacks")
 
 # pod-name-stream serialization lives in session.EXPAND_LOCK (imported
 # above as _EXPAND_LOCK): session creation/rehydration and the
@@ -579,6 +591,13 @@ class Batcher:
         want_audit = (
             audit_enabled() if self.store.audit is None else self.store.audit
         )
+        if session.warm:
+            doc = self._run_fit_warm(q, app, want_audit)
+            if doc is not None:
+                return doc
+            # a genuine vocabulary-class miss (preemption semantics the
+            # warm base cannot honor) — pay the legacy full simulate
+            _RETENSORIZE.inc()
         with span("serve.fit", sid=session.sid):
             with _EXPAND_LOCK:
                 seed_name_hashes(name_seed(q.fingerprint))
@@ -627,9 +646,201 @@ class Batcher:
             "placements": placements,
             "fingerprint": q.fingerprint,
         }
+        doc["engine"] = {"grow": grow_doc(session)}
         if result.audit is not None:
             doc["audit"] = result.audit.counters()
         return doc
+
+    def _expand_query_app(self, session: Session, app) -> list:
+        """The query app's pods, expanded EXACTLY as `simulate()` would
+        (workload expansion + DaemonSet rows + the app-name label +
+        deterministic sort) — the caller owns the name-stream seed."""
+        from .. import constants as C
+        from ..api import _sort_app_pods
+        from ..core.objects import set_label
+        from ..workloads.expand import (
+            get_valid_pods_exclude_daemonset,
+            make_valid_pods_by_daemonset,
+        )
+        from ..workloads.validate import SpecError
+
+        try:
+            pods = get_valid_pods_exclude_daemonset(app.resource)
+            for ds in app.resource.daemon_sets:
+                pods.extend(
+                    make_valid_pods_by_daemonset(ds, session.cluster.nodes)
+                )
+        except SpecError as exc:
+            raise BadRequest(f"fit query rejected: {exc}") from exc
+        for pod in pods:
+            set_label(pod, C.LABEL_APP_NAME, app.name)
+        return _sort_app_pods(pods)
+
+    def _base_name_draws(self, session: Session) -> tuple:
+        """The name-suffix draws `simulate()` consumes expanding the
+        cluster workloads and session apps BEFORE it reaches the query
+        app, recorded once per session (the structure is deterministic).
+        The warm fit path fast-forwards the freshly seeded stream past
+        them so its query pods carry the exact names the legacy one-shot
+        path would have generated (the bit-identity pin).  Caller holds
+        the expand lock."""
+        from ..workloads.expand import (
+            get_valid_pods_exclude_daemonset,
+            make_valid_pods_by_daemonset,
+            record_name_draws,
+        )
+
+        if session.name_draws is None:
+            cluster = session.cluster
+
+            def burn():
+                get_valid_pods_exclude_daemonset(cluster)
+                for ds in cluster.daemon_sets:
+                    make_valid_pods_by_daemonset(ds, cluster.nodes)
+                for sapp in session.apps:
+                    get_valid_pods_exclude_daemonset(sapp.resource)
+                    for ds in sapp.resource.daemon_sets:
+                        make_valid_pods_by_daemonset(ds, cluster.nodes)
+
+            session.name_draws = record_name_draws(burn)
+        return session.name_draws
+
+    def _run_fit_warm(self, q: Query, app, want_audit: bool) -> Optional[dict]:
+        """Zero-retensorize fit query: append the query app's pods into
+        the session's warm grow-mode engine (`Tensorizer.add_pods` +
+        `Engine.place`, whose carry EXTENDS in place on vocabulary
+        growth), read the verdict, then undo the appended placements
+        (`remove_placements` — one signed log delta) so the session base
+        is untouched for the next query.  Returns None on a genuine
+        vocabulary-class miss — query pods carrying priorities need the
+        legacy `simulate()` path's DefaultPreemption semantics, which a
+        frozen warm base cannot honor (docs/serving.md)."""
+        from ..audit.checker import extras_from_log
+        from ..engine.scan import REASON_TEXT
+        from ..workloads.expand import advance_name_stream, seed_name_hashes
+
+        session = q.session
+        pc = session.pc
+        eng, tz = pc.engine, pc.tz
+        if not getattr(eng, "grow", False):
+            return None
+        with span("serve.fit_warm", sid=session.sid):
+            with _EXPAND_LOCK:
+                draws = self._base_name_draws(session)
+                seed_name_hashes(name_seed(q.fingerprint))
+                advance_name_stream(draws)
+                pods = self._expand_query_app(session, app)
+            if any((p.get("spec") or {}).get("priority") for p in pods):
+                return None
+            _WARM_FITS.inc()
+            # base extras snapshot BEFORE the query rows join the log —
+            # extras_from_log sizes itself to the base placement
+            base_ext = extras_from_log(pc) if want_audit else None
+            batch = tz.add_pods(pods)
+            log_start = len(eng.placed_group)
+            try:
+                nodes, reasons, extras = eng.place(batch)
+            except BaseException:
+                # strip partially appended entries; the engine's dirty-
+                # state guard already forces the next place() to rebuild
+                # from the (restored) log
+                del eng.placed_group[log_start:]
+                del eng.placed_node[log_start:]
+                del eng.placed_req[log_start:]
+                for key in (
+                    "node", "vg_alloc", "sdev_take", "gpu_shares", "gpu_mem",
+                ):
+                    del eng.ext_log[key][log_start:]
+                raise
+            try:
+                tensors = tz.freeze()
+                failed = np.flatnonzero(nodes < 0)
+                unscheduled = [
+                    {
+                        "pod": (batch.pods[int(i)].get("metadata") or {}).get(
+                            "name", f"pod[{i}]"
+                        ),
+                        "reason": REASON_TEXT.get(
+                            int(reasons[int(i)]), str(int(reasons[int(i)]))
+                        ),
+                    }
+                    for i in failed[:50]
+                ]
+                placements: Dict[str, list] = {}
+                for i in np.flatnonzero(nodes >= 0):
+                    name = (batch.pods[int(i)].get("metadata") or {}).get(
+                        "name", f"pod[{i}]"
+                    )
+                    placements.setdefault(
+                        tensors.node_names[int(nodes[int(i)])], []
+                    ).append(name)
+                for names in placements.values():
+                    names.sort()
+                doc = {
+                    "ok": True,
+                    "kind": "fit",
+                    "app": app.name,
+                    "fits": not len(failed),
+                    "unscheduled": int(len(failed)),
+                    "session_unscheduled": int((pc.nodes < 0).sum()),
+                    "preempted": 0,
+                    "unscheduled_pods": unscheduled,
+                    "placements": placements,
+                    "fingerprint": q.fingerprint,
+                    "warm": True,
+                }
+                if want_audit:
+                    report = self._audit_overlay(
+                        tensors,
+                        [(pc.batch, pc.nodes, base_ext),
+                         (batch, nodes, extras)],
+                    )
+                    doc["audit"] = report.counters()
+                doc["engine"] = {"grow": grow_doc(session)}
+                return doc
+            finally:
+                # undo the query rows — the delta path restores the carry
+                # bit-identically (tests/test_grow.py) — and refresh the
+                # PlacedCluster's frozen view so the NEXT sweep/fit reads
+                # the carry against the grown vocabulary instead of
+                # rebuilding from the log
+                eng.remove_placements(
+                    list(range(log_start, len(eng.placed_group)))
+                )
+                pc.tensors = tz.freeze()
+
+    def _audit_overlay(self, tensors, layers, node_valid=None):
+        """One audit pass over stacked placements: each layer is a
+        (batch, nodes, extras) triple; entries concatenate in placement
+        order (base first), so prefix-replay checks see base occupancy
+        under the query rows exactly as one combined placement would."""
+        from ..audit.checker import (
+            _entries_from_batch,
+            _Entries,
+            audit_placement,
+        )
+
+        parts = [
+            _entries_from_batch(tensors, b, n, e) for b, n, e in layers
+        ]
+        offsets = np.cumsum([0] + [len(b.pods) for b, _n, _e in layers[:-1]])
+        merged = _Entries(
+            g=np.concatenate([p.g for p in parts]),
+            n=np.concatenate([p.n for p in parts]),
+            req=np.concatenate([p.req for p in parts]),
+            forced=np.concatenate([p.forced for p in parts]),
+            pin=np.concatenate([p.pin for p in parts]),
+            lvm=np.concatenate([p.lvm for p in parts]),
+            sdev=np.concatenate([p.sdev for p in parts]),
+            gpu=np.concatenate([p.gpu for p in parts]),
+            rows=np.concatenate(
+                [p.rows + off for p, off in zip(parts, offsets)]
+            ),
+            names=sum((p.names or [] for p in parts), []),
+        )
+        return audit_placement(
+            tensors, None, None, node_valid=node_valid, entries=merged
+        )
 
     def _run_capacity(self, q: Query) -> dict:
         """Minimum newNode clones for the given workloads — the planner's
@@ -662,6 +873,15 @@ class Batcher:
                 f"max_new_nodes must be in [1, {C.MAX_NUM_NEW_NODE}], "
                 f"got {max_new}"
             )
+        if session.warm and apps is session.apps:
+            # session-apps payload: the base placement already covers
+            # every pod, so capacity reduces to completing the STRANDED
+            # rows on extend_state-grown template clones — no Applier,
+            # no re-tensorize, no base re-place
+            doc = self._run_capacity_warm(q, max_new)
+            if doc is not None:
+                return doc
+            _RETENSORIZE.inc()
         with span("serve.capacity", sid=session.sid):
             with _EXPAND_LOCK:
                 seed_name_hashes(name_seed(q.fingerprint))
@@ -688,6 +908,7 @@ class Batcher:
             "probes": {str(k): v for k, v in sorted(plan.probes.items())},
             "fingerprint": q.fingerprint,
         }
+        doc["engine"] = {"grow": grow_doc(session)}
         if plan.audit:
             doc["audit"] = plan.audit
         if plan.partial:
@@ -695,4 +916,262 @@ class Batcher:
                 plan.message or "capacity search interrupted by deadline",
                 extra={"partial": doc},
             )
+        return doc
+
+    def _capacity_overlay(self, session: Session, m: int) -> dict:
+        """Build (once per clone-count bucket, cached on the session) the
+        warm capacity overlay: a deep copy of the session tensorizer with
+        `m` template clones appended via `Tensorizer.add_clone_nodes`,
+        the clone DaemonSet rows, and the session's carried state
+        extended onto the grown node axis (`extend_state_nodes`) — the
+        pristine snapshot every probe injects a copy of.  The session's
+        own tensorizer/engine are NEVER touched: later fit/drain queries
+        must not see (or land on) hypothetical nodes.  Raises
+        `GrowRefused` (caller falls back to the legacy full search) when
+        the template would change a vocabulary class the append contract
+        cannot absorb."""
+        import copy
+
+        from ..engine.rounds import RoundsEngine
+        from ..engine.scan import Engine
+        from ..engine.state import build_state
+        from ..plan.capacity import new_fake_nodes
+        from ..plan.incremental import _copy_state
+        from ..workloads.expand import (
+            make_valid_pods_by_daemonset,
+            seed_name_hashes,
+        )
+
+        ov = session.cap_overlay.get(m)
+        if ov is not None:
+            return ov
+        pc = session.pc
+        eng = pc.engine
+        n_base = pc.tz.freeze().alloc.shape[0]
+        clones = new_fake_nodes(session.new_node, m)
+        tz2 = copy.deepcopy(pc.tz)
+        tz2.add_clone_nodes(clones)
+        with _EXPAND_LOCK:
+            # clone DS pod names draw from the session+bucket seed, so the
+            # cached overlay is deterministic across daemon incarnations
+            seed_name_hashes(
+                name_seed(f"{session.fingerprint}/capacity/{m}")
+            )
+            all_ds = list(session.cluster.daemon_sets)
+            for a in session.apps:
+                all_ds += a.resource.daemon_sets
+            ds_pods = []
+            for ds in all_ds:
+                ds_pods.extend(make_valid_pods_by_daemonset(ds, clones))
+        batch_ds = tz2.add_pods(ds_pods)
+        # DS pods are clone-pinned (matchFields hostname), the incremental
+        # planner's own mapping (plan/incremental.py)
+        clone_of = np.asarray(batch_ds.pin, np.int64) - n_base
+        ov_eng = RoundsEngine(tz2)
+        ov_eng.enable_grow()
+        ov_eng.sched_config = session.sched_config
+        ov_eng.placed_group = list(eng.placed_group)
+        ov_eng.placed_node = list(eng.placed_node)
+        ov_eng.placed_req = list(eng.placed_req)
+        ov_eng.ext_log = {k: list(v) for k, v in eng.ext_log.items()}
+        ov_eng.last_state = _copy_state(eng.last_state)
+        ov_eng._grow_ref = dict(eng._grow_ref)
+        ov_eng._last_vocab = eng._last_vocab
+        ov_eng._state_dirty = False
+        tensors2 = tz2.freeze()
+        if not ov_eng.grow_nodes():
+            # the clone DaemonSets interned new vocabulary beyond the node
+            # axis — rebuild the overlay carry once from the copied log
+            dense = build_state(
+                tensors2,
+                np.asarray(ov_eng.placed_group, np.int32),
+                np.asarray(ov_eng.placed_node, np.int32),
+                ov_eng.log_req_matrix(tensors2.alloc.shape[1]),
+                ov_eng.ext_log,
+            )
+            ov_eng.last_state = ov_eng._enter_grow_buckets(tensors2, dense)
+        ov = {
+            "tz2": tz2,
+            "tensors2": tensors2,
+            "vocab2": Engine.state_vocab(tensors2),
+            "snapshot": ov_eng.last_state,
+            "batch_ds": batch_ds,
+            "clone_of": clone_of,
+            "n_base": n_base,
+            # chunk-shape registry shared across probes: every probe pads
+            # its bulk segments to the same pow2 buckets, so the first
+            # probe's executables serve the rest (plan/incremental idiom)
+            "shapes": {},
+        }
+        session.cap_overlay[m] = ov
+        return ov
+
+    def _run_capacity_warm(self, q: Query, max_new: int) -> Optional[dict]:
+        """Session-reusing capacity fast path: the base placement is
+        FROZEN (it is the session's own, already audited), template
+        clones join via append-only node growth, and probes k = 1..max
+        complete only the stranded rows over an injected copy of the
+        node-extended carry — the incremental planner's probe semantics
+        (plan/incremental.py) served warm.  Returns None to fall back to
+        the legacy full `plan_capacity` search (counted as a
+        retensorize fallback)."""
+        from ..core.tensorize import GrowRefused, slice_batch
+        from ..engine.rounds import RoundsEngine
+        from ..engine.state import snap_pow2
+        from ..plan.incremental import _copy_state
+
+        from .. import constants as C
+
+        session = q.session
+        pc = session.pc
+        eng = pc.engine
+        if (
+            not getattr(eng, "grow", False)
+            or eng._grow_ref is None
+            or eng.last_state is None
+            or eng._state_dirty
+        ):
+            return None
+        from ..audit.checker import audit_enabled
+
+        want_audit = (
+            audit_enabled() if self.store.audit is None else self.store.audit
+        )
+        strands = np.flatnonzero(np.asarray(pc.nodes) < 0)
+        base_doc = {
+            "kind": "capacity",
+            "fingerprint": q.fingerprint,
+            "warm": True,
+        }
+        try:
+            # same cooperative contract as the legacy search: an
+            # already-expired deadline answers the structured 504 before
+            # any probe (or the zero-strand short-circuit) runs
+            q.control.check()
+        except PlanInterrupted as exc:
+            doc = dict(
+                base_doc, ok=False, success=False, nodes_added=0,
+                message=f"warm capacity search interrupted ({exc.reason})",
+                partial=True, probes={},
+            )
+            raise DeadlineExceeded(
+                doc["message"], extra={"partial": doc}
+            ) from exc
+        if not len(strands):
+            _WARM_CAPACITY.inc()
+            doc = dict(
+                base_doc, ok=True, success=True, nodes_added=0,
+                message="all pods already placed in the session base",
+                partial=False, probes={},
+            )
+            if want_audit:
+                from ..audit.checker import extras_from_log
+
+                report = self._audit_overlay(
+                    pc.tz.freeze(),
+                    [(pc.batch, pc.nodes, extras_from_log(pc))],
+                )
+                doc["audit"] = report.counters()
+            doc["engine"] = {"grow": grow_doc(session)}
+            return doc
+        m = min(snap_pow2(max_new), C.MAX_NUM_NEW_NODE)
+        try:
+            ov = self._capacity_overlay(session, m)
+        except GrowRefused as exc:
+            log.info(
+                "serve: warm capacity refused for session %s (%s); "
+                "falling back to the full search", session.sid, exc,
+            )
+            return None
+        _WARM_CAPACITY.inc()
+        tensors2 = ov["tensors2"]
+        n_base, clone_of = ov["n_base"], ov["clone_of"]
+        n2 = tensors2.alloc.shape[0]
+        strand_batch = slice_batch(pc.batch, strands)
+        # resource lower bound: the strands must at least FIT the added
+        # template capacity — probes below it cannot succeed
+        demand = np.asarray(pc.batch.req, np.float64)[strands].sum(axis=0)
+        cap = np.asarray(tensors2.alloc[n_base], np.float64)[: demand.shape[0]]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            need = np.where(demand > 0, demand / np.maximum(cap, 1e-30), 0.0)
+        need_max = float(need.max()) if need.size else 0.0
+        lb = 1
+        if np.isfinite(need_max) and need_max > 1:
+            lb = min(int(np.ceil(need_max - 1e-9)), max_new)
+        probes: Dict[int, int] = {}
+        best = None
+        with span(
+            "serve.capacity_warm", sid=session.sid, strands=int(len(strands)),
+        ):
+            for k in range(lb, max_new + 1):
+                try:
+                    q.control.check()
+                except PlanInterrupted as exc:
+                    doc = dict(
+                        base_doc, ok=False, success=False, nodes_added=0,
+                        message=f"warm capacity search interrupted "
+                        f"({exc.reason})", partial=True,
+                        probes={str(i): v for i, v in sorted(probes.items())},
+                    )
+                    raise DeadlineExceeded(
+                        doc["message"], extra={"partial": doc}
+                    ) from exc
+                mask = np.zeros(n2, bool)
+                mask[: n_base + k] = True
+                pe = RoundsEngine(ov["tz2"])
+                pe.enable_grow()
+                pe.sched_config = session.sched_config
+                pe.node_valid = mask
+                pe.bulk_shapes = ov["shapes"]
+                pe.snap_shapes = True
+                pe.last_state = _copy_state(ov["snapshot"])
+                pe._last_vocab = ov["vocab2"]
+                pe._state_dirty = False
+                failed = 0
+                ds_idx = np.flatnonzero((clone_of >= 0) & (clone_of < k))
+                ds_run = None
+                if len(ds_idx):
+                    # clone DS overhead lands first — the infra rows a
+                    # real scale-up pays before workload pods arrive
+                    bds = slice_batch(ov["batch_ds"], ds_idx)
+                    nds, _rds, eds = pe.place(bds)
+                    ds_run = (bds, np.asarray(nds), eds)
+                    failed += int((np.asarray(nds) < 0).sum())
+                ns, _rs, es = pe.place(strand_batch)
+                ns = np.asarray(ns)
+                failed += int((ns < 0).sum())
+                probes[k] = failed
+                if failed == 0:
+                    best = (k, ns, es, ds_run, mask)
+                    break
+        if best is None:
+            doc = dict(
+                base_doc, ok=False, success=False, nodes_added=0,
+                message=f"cannot complete {len(strands)} stranded pod(s) "
+                f"within {max_new} template node(s)",
+                partial=False,
+                probes={str(i): v for i, v in sorted(probes.items())},
+            )
+            doc["engine"] = {"grow": grow_doc(session)}
+            return doc
+        k, ns, es, ds_run, mask = best
+        doc = dict(
+            base_doc, ok=True, success=True, nodes_added=int(k),
+            message=f"completed {len(strands)} stranded pod(s) on {k} "
+            "cloned node(s) over the warm session base",
+            partial=False,
+            probes={str(i): v for i, v in sorted(probes.items())},
+        )
+        if want_audit:
+            from ..audit.checker import extras_from_log
+
+            layers = [(pc.batch, pc.nodes, extras_from_log(pc))]
+            if ds_run is not None:
+                layers.append(ds_run)
+            layers.append((strand_batch, ns, es))
+            report = self._audit_overlay(
+                tensors2, layers, node_valid=mask
+            )
+            doc["audit"] = report.counters()
+        doc["engine"] = {"grow": grow_doc(session)}
         return doc

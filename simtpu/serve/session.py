@@ -71,6 +71,55 @@ _EVICTIONS = REGISTRY.counter("serve.evictions")
 #: batcher's fit/capacity queries (batching.py) all take it.
 EXPAND_LOCK = threading.Lock()
 
+#: counter names surfaced in the per-query `engine.grow` block — defined
+#: beside the growth kernels so the CLI can report them without
+#: importing the daemon (the off-path zero-cost pin)
+from ..engine.state import GROW_COUNTERS  # noqa: E402  (re-export)
+
+
+def warm_serve_enabled() -> bool:
+    """SIMTPU_SERVE_WARM gate (default ON): serve sessions keep ONE warm
+    grow-mode engine and APPEND query pods into its vocabulary
+    (`Tensorizer.add_pods` + `Engine._try_extend_carry`) instead of
+    re-running the Applier + a from-scratch tensorize per request — the
+    append-only vocabulary growth fast path (ISSUE 20).  Off = the
+    pre-warm behavior: every fit query pays a full `simulate()`."""
+    return os.environ.get("SIMTPU_SERVE_WARM", "1").strip().lower() not in (
+        "0", "false", "off", "no",
+    )
+
+
+def _grow_engine(tz):
+    """Engine factory for warm sessions: the bulk rounds engine in grow
+    mode (dense carry, term axes pre-padded to pow2 buckets), so a query
+    that grows the vocabulary extends the carried state in place."""
+    from ..engine.rounds import RoundsEngine
+
+    eng = RoundsEngine(tz)
+    eng.enable_grow()
+    return eng
+
+
+def grow_doc(session: Optional["Session"] = None) -> Dict[str, object]:
+    """The `engine.grow` response block: warm-path counters
+    (`engine.state.grow_counters_doc`) plus the serving session's live
+    bucket layout."""
+    from ..engine.state import grow_counters_doc
+
+    doc: Dict[str, object] = grow_counters_doc()
+    if session is not None:
+        doc["warm"] = bool(session.warm)
+        ref = getattr(session.pc.engine, "_grow_ref", None)
+        if ref:
+            doc["buckets"] = {
+                "terms": int(ref["t"]),
+                "t_cap": int(ref["t_cap"]),
+                "interpod": int(ref["ti"]),
+                "ti_cap": int(ref["ti_cap"]),
+                "nodes": int(ref["n"]),
+            }
+    return doc
+
 
 class Session:
     """One warm snapshot: ingested objects + placed base + per-session
@@ -89,6 +138,7 @@ class Session:
         pc,
         audit: Optional[dict] = None,
         recovered: bool = False,
+        warm: bool = False,
     ):
         self.sid = sid
         self.fingerprint = fingerprint
@@ -100,6 +150,17 @@ class Session:
         self.pc = pc
         self.audit = audit
         self.recovered = recovered
+        self.warm = warm
+        # capacity fast-path overlay (batching._run_capacity_warm): the
+        # cloned tensorizer + node-extended carry, cached per clone-count
+        # bucket so repeat capacity queries re-probe without re-growing
+        self.cap_overlay: Dict[int, object] = {}
+        # name-stream fast-forward (batching._run_fit_warm): the widths
+        # of every pod-name draw the one-shot path consumes expanding
+        # the cluster + session apps BEFORE the query app — recorded
+        # once, replayed per query so warm answers carry the exact pod
+        # names the legacy simulate() path would have generated
+        self.name_draws = None
         self.lock = threading.RLock()
         self.created_unix = time.time()
         self.last_used = time.monotonic()
@@ -130,6 +191,7 @@ class Session:
             "created_unix": self.created_unix,
             "audit_ok": bool(self.audit.get("ok")) if self.audit else None,
             "has_new_node": self.new_node is not None,
+            "warm": bool(self.warm),
         }
 
 
@@ -153,7 +215,7 @@ def _extras_rows(pc) -> Dict[str, np.ndarray]:
 
 
 def _replay_placed_cluster(
-    cluster, apps, rec, sched_config, extended_resources=()
+    cluster, apps, rec, sched_config, extended_resources=(), warm=False
 ):
     """A `PlacedCluster` equivalent to one that just ran the recorded
     base placement: tensorization re-runs (deterministic given the
@@ -180,7 +242,7 @@ def _replay_placed_cluster(
             f"re-expanded snapshot has {len(batch.pods)}; refusing to "
             "rehydrate (the snapshot files changed since the checkpoint)"
         )
-    eng = RoundsEngine(tz)
+    eng = _grow_engine(tz) if warm else RoundsEngine(tz)
     eng.sched_config = sched_config
     r = tensors.alloc.shape[1]
     req_pad = batch.req
@@ -207,6 +269,10 @@ def _replay_placed_cluster(
         eng.log_req_matrix(r),
         eng.ext_log,
     )
+    if warm:
+        # a rehydrated warm session carries the SAME bucket-padded dense
+        # state a fresh warm placement would — queries append either way
+        dense = eng._enter_grow_buckets(tensors, dense)
     eng.last_state = eng._store_state(tensors, dense)
     eng._last_vocab = eng.state_vocab(tensors)
     eng._state_dirty = False
@@ -309,6 +375,7 @@ class SessionStore:
         from ..faults import place_cluster
         from ..workloads.expand import seed_name_hashes
 
+        warm = warm_serve_enabled()
         with EXPAND_LOCK, span(
             "serve.place_base", nodes=len(cluster.nodes)
         ):
@@ -317,6 +384,10 @@ class SessionStore:
                 cluster, apps,
                 extended_resources=self.extended_resources,
                 sched_config=sched_config,
+                # warm sessions place through ONE grow-mode engine whose
+                # carry later queries append into (bit-identical
+                # placements either way, tests/test_grow.py)
+                engine_factory=_grow_engine if warm else None,
             )
         audit_doc = None
         want_audit = audit_enabled() if self.audit is None else self.audit
@@ -415,7 +486,7 @@ class SessionStore:
         )
         session = Session(
             sid, fingerprint, config_path, cluster, apps, new_node,
-            sched_config, pc, audit=audit_doc,
+            sched_config, pc, audit=audit_doc, warm=warm_serve_enabled(),
         )
         self._checkpoint(session)
         with self._lock:
@@ -600,15 +671,16 @@ class SessionStore:
                 f"session {sid!r} cannot rehydrate: {exc} (the snapshot "
                 "files changed since the checkpoint; delete and reload)"
             ) from exc
+        warm = warm_serve_enabled()
         with EXPAND_LOCK, span("serve.rehydrate", sid=sid):
             seed_name_hashes(name_seed(fingerprint))
             pc = _replay_placed_cluster(
                 cluster, apps, rec, sched_config,
-                extended_resources=self.extended_resources,
+                extended_resources=self.extended_resources, warm=warm,
             )
         session = Session(
             sid, fingerprint, config_path, cluster, apps, new_node,
-            sched_config, pc, recovered=True,
+            sched_config, pc, recovered=True, warm=warm,
         )
         _RECOVERED.inc()
         with self._lock:

@@ -19,8 +19,16 @@ clones of `autoscale.node` at tensorize time, DISABLED via the engine's
 interval while admission demand is visibly starved (a non-empty pending
 queue), and disarms the highest empty pool node when utilization sits
 below half the HPA target — capacity-planner-shaped grow/shrink without
-ever re-tensorizing (growing the node axis would invalidate the carried
-state; docs/timeline.md states the trade).
+ever re-tensorizing.
+
+**Real node growth.**  `autoscale.grow_max` (default 0 — existing traces
+are untouched) lets the tick keep scaling PAST the pre-provisioned pool:
+once every pool node is armed and demand is still starved, one template
+clone per interval joins through `Tensorizer.add_clone_nodes` and the
+engine's append-only carry extension (`Engine.grow_nodes` /
+`extend_state_nodes`), up to `grow_max` extra nodes.  The replay engine
+runs in the grow layout for such traces; a `GrowRefused` template
+disables further growth and is counted (`pool_grow_refused`).
 """
 
 from __future__ import annotations
@@ -108,7 +116,7 @@ def autoscale_tick(rt, auto, t: float) -> bool:
                 st.status = "active"
 
     # -- template-node pool ----------------------------------------------
-    if rt.pool_rows:
+    if rt.pool_rows or rt.grow_left > 0:
         pending = sum(
             st.needs
             for st in rt.jobs
@@ -122,6 +130,11 @@ def autoscale_tick(rt, auto, t: float) -> bool:
             rt.eng.node_valid = rt.valid.copy()
             rt._bump("pool_up")
             released = True
+        elif pending > 0 and rt.grow_left > 0:
+            # the pre-provisioned pool is exhausted: grow the node axis
+            # for real (append-only clone + in-place carry extension),
+            # still one node per tick
+            released |= rt._grow_pool_node()
         elif pending == 0:
             cap = float(rt.alloc_cpu[rt.valid].sum())
             util = rt.used_cpu / cap if cap > 0 else 0.0

@@ -114,6 +114,8 @@ class AutoscaleSpec:
     target_util: float = 0.6  # HPA target utilization of requests
     pool: int = 0  # pre-provisioned template nodes the pool scaler arms
     node: Optional[dict] = None  # pool node template (required when pool>0)
+    grow_max: int = 0  # extra clones grown PAST the pool (append-only
+    #                    node-axis growth; 0 keeps the fixed-axis behavior)
 
 
 @dataclass
@@ -221,10 +223,14 @@ def trace_from_doc(doc: dict, source: str = "<in-memory>") -> Trace:
                 ),
                 pool=int(_number(ad, "pool", "autoscale", 0, minimum=0)),
                 node=_want(ad, "node", (dict,), "autoscale", None),
+                grow_max=int(
+                    _number(ad, "grow_max", "autoscale", 0, minimum=0)
+                ),
             )
-            if autoscale.pool and autoscale.node is None:
+            if (autoscale.pool or autoscale.grow_max) \
+                    and autoscale.node is None:
                 raise SpecError(
-                    "autoscale.pool > 0 requires autoscale.node "
+                    "autoscale.pool/grow_max > 0 requires autoscale.node "
                     "(the template the pool nodes clone)",
                     field="autoscale.pool",
                 )

@@ -77,7 +77,7 @@ TIMELINE_KEYS = (
     "gang_rollbacks", "retries", "preemptions", "preempted_pods",
     "node_down", "node_up", "cron_fires", "dropped_pods",
     "autoscale_checks", "scale_up_pods", "scale_down_pods",
-    "pool_up", "pool_down",
+    "pool_up", "pool_down", "pool_grow", "pool_grow_refused",
 )
 
 
@@ -280,6 +280,8 @@ class _Replay:
                     source=trace.source,
                     field=f"node_events@{ev.t_s:g}s",
                 )
+        self.grow_left = int(auto.grow_max) if auto is not None else 0
+        self._grown = 0
         eng = Engine(self.tz)
         eng.sched_config = self.opts.sched_config
         if self.serial:
@@ -290,6 +292,11 @@ class _Replay:
                 eng.speculate = bool(self.opts.speculate)
             if self.opts.compact is not None:
                 eng.compact = bool(self.opts.compact)
+        if self.grow_left > 0:
+            # real node-axis growth is enabled for this trace: the carry
+            # must live in the grow layout (dense, pow2-bucketed axes) so
+            # scale-ups extend it in place instead of invalidating it
+            eng.enable_grow()
         n = self.tensors.alloc.shape[0]
         self.valid = np.ones(n, bool)
         if self.pool_rows:
@@ -661,6 +668,45 @@ class _Replay:
                 st.arrive_t = t
                 self._push(t, EVT_RETRY, int(jid))
         return False  # capacity shrank; the retries ride their own events
+
+    def _grow_pool_node(self) -> bool:
+        """Grow the node axis for REAL — one template clone joins past the
+        pre-provisioned pool via `Tensorizer.add_clone_nodes` and the
+        engine's `grow_nodes` carry extension (no re-tensorize, no log
+        rebuild).  Returns True when capacity was released; a `GrowRefused`
+        template (vocabulary-class change) permanently disables further
+        growth for this replay and is counted."""
+        from ..core.tensorize import GrowRefused
+
+        auto = self.trace.autoscale
+        idx = self.tensors.alloc.shape[0]
+        name = f"timeline-grow-{self._grown:04d}"
+        node = make_valid_node_by_node(auto.node, name)
+        try:
+            self.tz.add_clone_nodes([node])
+        except GrowRefused:
+            self.grow_left = 0
+            self._bump("pool_grow_refused")
+            return False
+        self._grown += 1
+        self.grow_left -= 1
+        # False means the term vocabulary moved under us (cannot happen
+        # between ticks — no pods were added) — the next place() rebuilds
+        # once from the log and the replay stays correct regardless
+        self.eng.grow_nodes()
+        self.tensors = self.tz.freeze()
+        self.res.tensors = self.tensors
+        self.valid = np.append(self.valid, True)
+        self.eng.node_valid = self.valid.copy()
+        self.alloc_cpu = np.append(
+            self.alloc_cpu, float(self.tensors.alloc[idx, self.cpu_idx])
+        )
+        self.node_idx[name] = idx
+        # grown nodes join the pool bookkeeping so the scale-down arm can
+        # disarm them again once they sit empty
+        self.pool_rows.append(idx)
+        self._bump("pool_grow")
+        return True
 
     def _sample(self, t: float) -> None:
         cap = float(self.alloc_cpu[self.valid].sum())

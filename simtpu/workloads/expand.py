@@ -78,6 +78,46 @@ def seed_name_hashes(seed: Optional[int]) -> None:
     _rng = random.Random(seed)
 
 
+class _RecordingRandom(random.Random):
+    """RNG stand-in that notes the bit width of every draw (the widths
+    vary: workload-level suffixes are longer than pod-level ones)."""
+
+    def __init__(self):
+        super().__init__(0)
+        self.widths = []
+
+    def getrandbits(self, k: int) -> int:
+        self.widths.append(k)
+        return super().getrandbits(k)
+
+
+def record_name_draws(fn) -> tuple:
+    """Run `fn` (an expansion) against a throwaway recording RNG and
+    return the bit widths of every name-suffix draw it made.  The draw
+    STRUCTURE is deterministic — it depends on the workload tree, never
+    on the drawn values — so the recording replays exactly via
+    `advance_name_stream` under any seed.  The caller's stream is
+    untouched (restored on exit)."""
+    global _rng
+    prev = _rng
+    rec = _RecordingRandom()
+    _rng = rec
+    try:
+        fn()
+    finally:
+        _rng = prev
+    return tuple(rec.widths)
+
+
+def advance_name_stream(widths) -> None:
+    """Fast-forward the current name stream past `widths` (a
+    `record_name_draws` recording) without expanding anything — the
+    warm serve path's replacement for re-expanding the session base
+    before each query app (serve/batching.py)."""
+    for k in widths:
+        _rng.getrandbits(k)
+
+
 def _hash_suffix(digits: int) -> str:
     """Random hex suffix, shaped like the reference's sha256-of-random-token
     prefix (`utils.GetSHA256HashCode`, utils.go:531-536). Drawn directly from

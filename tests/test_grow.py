@@ -1,0 +1,701 @@
+"""Append-only vocabulary growth — `extend_state` (ISSUE 20).
+
+The load-bearing pins:
+
+- BIT-IDENTITY MATRIX: a grow-mode engine (dense carry, term axes
+  pre-padded to pow2 buckets, carry EXTENDED in place as the vocabulary
+  grows — including across a bucket-boundary promotion) places every
+  wave bit-identically to tensorize-from-scratch engines in both the
+  compact and dense carry layouts, and the final carried planes match.
+- NODE GROWTH: `Tensorizer.add_clone_nodes` + `Engine.grow_nodes`
+  extends the node axis mid-run bit-identically to a rebuild, and the
+  incrementally grown tensorizer is indistinguishable from a
+  from-scratch `Tensorizer` over the full node list.
+- AUTOSCALE GROWTH: `autoscale.grow_max` lets a replay scale PAST the
+  pre-provisioned pool — grown nodes admit a gang the fixed axis
+  strands, batched stays pinned to the serial oracle, auditor-clean.
+- WARM SERVING: a session's fit queries append into ONE warm engine and
+  answer bit-identically to the legacy full-`simulate()` path (pod
+  names included — the name-stream fast-forward), with ZERO retensorize
+  fallbacks on the common path; the warm capacity fast path completes
+  strands on grown template clones and matches the legacy planner.
+- COMPILE BUDGET: growth kernels trace once per bucket signature —
+  a second same-bucket append adds ZERO `compile.grow`
+  (the TestSolveCompileBudget contract, extended to the grow kind).
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+
+import numpy as np
+import pytest
+
+from simtpu import constants as C
+from simtpu.api import _sort_app_pods
+from simtpu.core.objects import AppResource, ResourceTypes, set_label
+from simtpu.core.tensorize import Tensorizer
+from simtpu.durable.deadline import RunControl
+from simtpu.engine.rounds import RoundsEngine
+from simtpu.engine.state import ensure_dense
+from simtpu.obs.metrics import REGISTRY
+from simtpu.parallel.sweep import assemble_planning_problem
+from simtpu.synth import make_deployment, make_node, synth_cluster
+from simtpu.workloads.expand import (
+    get_valid_pods_exclude_daemonset,
+    make_valid_pods_by_daemonset,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CONFIG = str(REPO / "examples" / "simtpu-config.yaml")
+
+
+def _app(name, deps):
+    res = ResourceTypes()
+    res.deployments.extend(deps)
+    return AppResource(name=name, resource=res)
+
+
+def make_problem():
+    """A small cluster plus four placement waves: a term-rich base, an
+    in-bucket vocabulary extension, a pure carry-reuse wave, and a
+    many-term wave that promotes the pow2 bucket."""
+    cluster = synth_cluster(
+        8, seed=11, zones=3, taint_frac=0.1, gpu_frac=0.2, storage_frac=0.3
+    )
+    waves = [
+        _app("w0", [
+            make_deployment("a0", 4, 250, 256),
+            make_deployment(
+                "a1", 4, 250, 256,
+                anti_affinity_topo="kubernetes.io/hostname",
+                anti_affinity_required=True,
+            ),
+            make_deployment(
+                "a2", 4, 250, 256,
+                spread_topo="topology.kubernetes.io/zone", spread_hard=True,
+            ),
+            make_deployment(
+                "a3", 3, 250, 256,
+                anti_affinity_topo="kubernetes.io/hostname",
+            ),
+        ]),
+        _app("w1", [
+            make_deployment(
+                "b0", 3, 250, 256,
+                anti_affinity_topo="kubernetes.io/hostname",
+                anti_affinity_required=True,
+            ),
+            make_deployment(
+                "b1", 3, 250, 256,
+                affinity_topo="topology.kubernetes.io/zone",
+            ),
+        ]),
+        _app("w2", [make_deployment("c0", 4, 250, 256)]),
+        _app("w3", [
+            make_deployment(
+                f"d{i}", 2, 125, 128,
+                anti_affinity_topo="kubernetes.io/hostname",
+                anti_affinity_required=(i % 2 == 0),
+                spread_topo="topology.kubernetes.io/zone",
+            )
+            for i in range(10)
+        ]),
+    ]
+    return cluster, waves
+
+
+def expand_app(app, all_nodes):
+    pods = get_valid_pods_exclude_daemonset(app.resource)
+    for ds in app.resource.daemon_sets:
+        pods.extend(make_valid_pods_by_daemonset(ds, all_nodes))
+    for pod in pods:
+        set_label(pod, C.LABEL_APP_NAME, app.name)
+    return _sort_app_pods(pods)
+
+
+def run_waves(grow: bool, compact=None):
+    """Place the four waves incrementally; returns (placements list,
+    final dense carried state, engine, tensorizer)."""
+    cluster, waves = make_problem()
+    tz, all_nodes, _n_base, ordered = assemble_planning_problem(
+        cluster, [waves[0]], cluster.nodes[0], 0
+    )
+    eng = RoundsEngine(tz)
+    if grow:
+        eng.enable_grow()
+    elif compact is not None:
+        eng.compact = compact
+    placements = []
+    batch = tz.add_pods(ordered)
+    placements.append(np.asarray(eng.place(batch)[0]))
+    for app in waves[1:]:
+        batch = tz.add_pods(expand_app(app, all_nodes))
+        placements.append(np.asarray(eng.place(batch)[0]))
+    state = ensure_dense(eng.carried_state(), tz.freeze())
+    return placements, state, eng, tz
+
+
+def _assert_same_run(a, b):
+    pl_a, st_a = a[0], a[1]
+    pl_b, st_b = b[0], b[1]
+    for i, (x, y) in enumerate(zip(pl_a, pl_b)):
+        assert x.shape == y.shape, (i, x.shape, y.shape)
+        assert np.array_equal(x, y), (i, np.flatnonzero(x != y))
+    for key in type(st_a)._fields:
+        x = np.asarray(getattr(st_a, key))
+        y = np.asarray(getattr(st_b, key))
+        assert x.shape == y.shape, (key, x.shape, y.shape)
+        assert np.array_equal(x, y), key
+
+
+@pytest.fixture(scope="module")
+def grow_legs():
+    """The grow run (with its counter delta) plus compact and dense
+    from-scratch baselines over the same waves."""
+    compact_leg = run_waves(False)
+    dense_leg = run_waves(False, compact=False)
+    before = REGISTRY.snapshot()
+    grow_leg = run_waves(True)
+    delta = REGISTRY.delta_since(before)
+    return {
+        "compact": compact_leg, "dense": dense_leg,
+        "grow": grow_leg, "delta": delta,
+    }
+
+
+class TestExtendStateBitIdentity:
+    @pytest.mark.slow
+    def test_matches_compact_from_scratch(self, grow_legs):
+        _assert_same_run(grow_legs["grow"], grow_legs["compact"])
+
+    @pytest.mark.slow
+    def test_matches_dense_from_scratch(self, grow_legs):
+        _assert_same_run(grow_legs["grow"], grow_legs["dense"])
+
+    @pytest.mark.slow
+    def test_layout_baselines_agree(self, grow_legs):
+        # the matrix closes: compact and dense baselines also agree, so
+        # all three layouts answer identically
+        _assert_same_run(grow_legs["compact"], grow_legs["dense"])
+
+    @pytest.mark.slow
+    def test_extends_fired_not_rebuilds(self, grow_legs):
+        d = grow_legs["delta"]
+        assert d.get("grow.extends", 0) >= 2, d
+        assert d.get("grow.rebuilds", 0) == 0, d
+
+    @pytest.mark.slow
+    def test_bucket_promotion_crossed(self, grow_legs):
+        # wave 3's ten-deployment burst must actually cross a pow2
+        # boundary, or the promotion path went untested
+        assert grow_legs["delta"].get("grow.bucket_promotions", 0) >= 1
+
+    def test_grow_rides_compile_count_kinds(self):
+        from simtpu.engine.scan import COMPILE_COUNT_KINDS
+
+        assert "grow" in COMPILE_COUNT_KINDS
+
+
+@pytest.mark.slow
+class TestNodeGrowth:
+    @pytest.fixture(scope="class")
+    def node_legs(self):
+        from simtpu.plan.capacity import new_fake_nodes
+
+        def run(grow: bool):
+            cluster, waves = make_problem()
+            tz, all_nodes, _nb, ordered = assemble_planning_problem(
+                cluster, [waves[0]], cluster.nodes[0], 0
+            )
+            eng = RoundsEngine(tz)
+            if grow:
+                eng.enable_grow()
+            placements = []
+            batch = tz.add_pods(ordered)
+            placements.append(np.asarray(eng.place(batch)[0]))
+            for app in waves[1:3]:
+                batch = tz.add_pods(expand_app(app, all_nodes))
+                placements.append(np.asarray(eng.place(batch)[0]))
+            clones = new_fake_nodes(cluster.nodes[0], 2)
+            tz.add_clone_nodes(clones)
+            if grow:
+                assert eng.grow_nodes(), "grow_nodes should extend the carry"
+            batch = tz.add_pods(expand_app(waves[3], all_nodes + clones))
+            placements.append(np.asarray(eng.place(batch)[0]))
+            state = ensure_dense(eng.carried_state(), tz.freeze())
+            return placements, state, tz, all_nodes + clones
+
+        base = run(False)
+        before = REGISTRY.snapshot()
+        grown = run(True)
+        delta = REGISTRY.delta_since(before)
+        return base, grown, delta
+
+    def test_mid_run_node_growth_bit_identical(self, node_legs):
+        base, grown, delta = node_legs
+        _assert_same_run(base, grown)
+        assert delta.get("grow.node_extends", 0) == 1, delta
+        assert delta.get("grow.rebuilds", 0) == 0, delta
+
+    def test_grown_tensorizer_matches_from_scratch(self, node_legs):
+        """The grown tensorizer's frozen planes equal a from-scratch
+        Tensorizer over the full node list (domain ids canonicalized —
+        interning order may differ, the partition may not)."""
+        _base, grown, _delta = node_legs
+        _pl, _st, tz, nodes = grown
+        cluster, waves = make_problem()
+        _tz, _nodes, _nb, ordered = assemble_planning_problem(
+            cluster, [waves[0]], cluster.nodes[0], 0
+        )
+        tz2 = Tensorizer(nodes)
+        tz2.add_pods(ordered)
+        for w in waves[1:]:
+            tz2.add_pods(expand_app(w, nodes))
+        a, b = tz.freeze(), tz2.freeze()
+        for f in (
+            "alloc", "key_kind", "node_dom_small", "term_topo_key",
+            "static_mask", "node_pref_score", "taint_intolerable",
+            "static_score", "avoid_pen", "s_match", "a_aff_req",
+            "a_anti_req", "w_aff_pref", "w_anti_pref", "spread_hard",
+            "spread_soft", "ss_host", "ss_zone", "ports", "vol_mask",
+            "vol_rw", "vol_ro", "vol_att", "vol_class_mask",
+            "attach_limits",
+        ):
+            x, y = getattr(a, f), getattr(b, f)
+            assert x.shape == y.shape, (f, x.shape, y.shape)
+            assert np.array_equal(x, y), f
+        assert a.node_names == b.node_names
+        assert a.resource_names == b.resource_names
+        assert a.topo_keys == b.topo_keys
+
+        def canon_dom(node_dom):
+            out = np.full_like(node_dom, -1)
+            for k in range(node_dom.shape[0]):
+                seen = {}
+                for i, d in enumerate(node_dom[k]):
+                    if d >= 0:
+                        out[k, i] = seen.setdefault(int(d), len(seen))
+            return out
+
+        assert np.array_equal(canon_dom(a.node_dom), canon_dom(b.node_dom))
+        for e in (
+            "vg_cap", "vg_req0", "vg_name_id", "has_storage", "sdev_cap",
+            "sdev_media", "sdev_alloc0", "gpu_dev_total", "gpu_total",
+        ):
+            assert np.array_equal(getattr(a.ext, e), getattr(b.ext, e)), e
+
+
+class TestAutoscaleGrowMax:
+    def _doc(self, grow_max: int):
+        nodes = [make_node(f"n-{i}", 4000, 16) for i in range(2)]
+        return {
+            "version": 1, "seed": 3, "horizon_s": 6000.0,
+            "cluster": {"nodes": nodes},
+            "jobs": [
+                # a 12-pod gang the 2-node base can never hold: only
+                # grown capacity admits it (immortal — a departure would
+                # reset its landing vector and blind the assertion)
+                {"name": "surge", "t_s": 10.0,
+                 "workload": make_deployment("surge", 12, 1500, 1024)},
+            ],
+            "autoscale": {
+                "interval_s": 120.0, "target_util": 0.6, "pool": 1,
+                "node": make_node("tmpl", 4000, 16), "grow_max": grow_max,
+            },
+        }
+
+    @pytest.mark.slow
+    def test_grow_admits_the_stranded_gang_pinned(self):
+        from simtpu.timeline import ReplayOptions, replay_trace, trace_from_doc
+
+        doc = self._doc(grow_max=4)
+        batched = replay_trace(trace_from_doc(doc), ReplayOptions())
+        serial = replay_trace(trace_from_doc(doc), ReplayOptions(serial=True))
+        from tests.test_timeline import _assert_pinned
+
+        _assert_pinned(batched, serial)
+        assert batched.counts["pool_grow"] >= 1
+        assert batched.counts["pool_grow_refused"] == 0
+        assert int((batched.nodes >= 0).sum()) == 12, batched.counts
+        assert batched.audit["ok"]
+
+    def test_without_grow_max_the_gang_strands(self):
+        from simtpu.timeline import ReplayOptions, replay_trace, trace_from_doc
+
+        res = replay_trace(trace_from_doc(self._doc(grow_max=0)),
+                           ReplayOptions())
+        assert res.counts["pool_grow"] == 0
+        assert int((res.nodes >= 0).sum()) < 12
+
+
+# ---------------------------------------------------------------------------
+# warm serving
+
+
+FIT_PLAIN = {
+    "workloads": [{
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "probe", "namespace": "default"},
+        "spec": {
+            "replicas": 3,
+            "template": {
+                "metadata": {"labels": {"app": "probe"}},
+                "spec": {"containers": [{
+                    "name": "c", "image": "nginx",
+                    "resources": {"requests": {
+                        "cpu": "1", "memory": "1Gi",
+                    }},
+                }]},
+            },
+        },
+    }],
+}
+# a vocabulary-growing shape: anti-affinity interns new interpod terms
+FIT_ANTI = {
+    "workloads": [{
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "probe2", "namespace": "default"},
+        "spec": {
+            "replicas": 2,
+            "template": {
+                "metadata": {"labels": {"app": "probe2"}},
+                "spec": {
+                    "affinity": {"podAntiAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [{
+                            "topologyKey": "kubernetes.io/hostname",
+                            "labelSelector": {
+                                "matchLabels": {"app": "probe2"},
+                            },
+                        }],
+                    }},
+                    "containers": [{
+                        "name": "c", "image": "nginx",
+                        "resources": {"requests": {
+                            "cpu": "500m", "memory": "512Mi",
+                        }},
+                    }],
+                },
+            },
+        },
+    }],
+}
+
+
+def _store(warm: bool, config=CONFIG, audit=True):
+    from simtpu.serve.batching import Batcher
+    from simtpu.serve.session import SessionStore
+
+    prev = os.environ.get("SIMTPU_SERVE_WARM")
+    os.environ["SIMTPU_SERVE_WARM"] = "1" if warm else "0"
+    try:
+        store = SessionStore(state_dir="", audit=audit)
+        session, created = store.create(config)
+    finally:
+        if prev is None:
+            os.environ.pop("SIMTPU_SERVE_WARM", None)
+        else:
+            os.environ["SIMTPU_SERVE_WARM"] = prev
+    assert created and session.warm is warm
+    return Batcher(store), session
+
+
+def _fit(batcher, session, payload):
+    from simtpu.serve.batching import Query
+
+    q = Query(kind="fit", session=session, payload=payload,
+              control=RunControl())
+    with session.lock:
+        return batcher._run_fit(q)
+
+
+def _capacity(batcher, session, payload):
+    from simtpu.serve.batching import Query
+
+    q = Query(kind="capacity", session=session, payload=payload,
+              control=RunControl())
+    with session.lock:
+        return batcher._run_capacity(q)
+
+
+def _drain(batcher, session):
+    from simtpu.serve.batching import Query
+
+    q = Query(kind="drain", session=session,
+              payload={"nodes": [list(session.node_index)[1]]},
+              control=RunControl())
+    with session.lock:
+        batcher._run_sweep_batch(session, [q])
+    assert q.error is None, q.error
+    return {k: v for k, v in q.result.items()
+            if k not in ("batched_queries", "batch_scenarios")}
+
+
+@pytest.fixture(scope="module")
+def warm_session():
+    return _store(warm=True)
+
+
+class TestWarmServe:
+    FIT_KEYS = ("fits", "unscheduled", "session_unscheduled", "placements",
+                "app", "preempted")
+
+    @pytest.mark.slow
+    def test_warm_fit_bit_identical_to_legacy(self, warm_session):
+        """The acceptance pin: the warm append answer equals the legacy
+        full-simulate() answer — placements to the POD NAME (the
+        name-stream fast-forward covers the session base's draws)."""
+        batcher, session = warm_session
+        doc_w = _fit(batcher, session, FIT_PLAIN)
+        assert doc_w["warm"] is True, doc_w
+        assert doc_w["audit"]["ok"] is True
+        b2, s2 = _store(warm=False)
+        doc_c = _fit(b2, s2, FIT_PLAIN)
+        assert "warm" not in doc_c
+        assert s2.fingerprint == session.fingerprint
+        for k in self.FIT_KEYS:
+            assert doc_w[k] == doc_c[k], (k, doc_w[k], doc_c[k])
+
+    def test_repeat_query_stays_on_the_carry(self, warm_session):
+        batcher, session = warm_session
+        doc1 = _fit(batcher, session, FIT_PLAIN)
+        before = REGISTRY.snapshot()
+        doc2 = _fit(batcher, session, FIT_PLAIN)
+        delta = REGISTRY.delta_since(before)
+        assert doc2["placements"] == doc1["placements"]
+        assert delta.get("grow.retensorize_fallbacks", 0) == 0, delta
+        assert delta.get("grow.rebuilds", 0) == 0, delta
+
+    @pytest.mark.slow
+    def test_vocab_growing_query_extends_in_place(self, warm_session):
+        batcher, session = warm_session
+        before = REGISTRY.snapshot()
+        doc = _fit(batcher, session, FIT_ANTI)
+        delta = REGISTRY.delta_since(before)
+        assert doc["fits"], doc
+        assert delta.get("grow.extends", 0) >= 1, delta
+        assert delta.get("grow.rebuilds", 0) == 0, delta
+        assert delta.get("grow.retensorize_fallbacks", 0) == 0, delta
+
+    @pytest.mark.slow
+    def test_drain_stable_across_fit_queries(self, warm_session):
+        batcher, session = warm_session
+        d0 = _drain(batcher, session)
+        _fit(batcher, session, FIT_PLAIN)
+        assert _drain(batcher, session) == d0
+
+    def test_priority_payload_takes_the_counted_fallback(self, warm_session):
+        """A genuine vocabulary-class miss: query pods carrying
+        priorities need the legacy path's preemption semantics."""
+        batcher, session = warm_session
+        payload = {"workloads": [dict(FIT_PLAIN["workloads"][0])]}
+        payload["workloads"][0] = {
+            **payload["workloads"][0],
+            "spec": {
+                **payload["workloads"][0]["spec"],
+                "template": {
+                    "metadata": {"labels": {"app": "probe"}},
+                    "spec": {
+                        "priority": 100,
+                        "containers": [{
+                            "name": "c", "image": "nginx",
+                            "resources": {"requests": {
+                                "cpu": "1", "memory": "1Gi",
+                            }},
+                        }],
+                    },
+                },
+            },
+        }
+        before = REGISTRY.snapshot()
+        doc = _fit(batcher, session, payload)
+        delta = REGISTRY.delta_since(before)
+        assert doc["fits"] is not None
+        assert delta.get("grow.retensorize_fallbacks", 0) == 1, delta
+
+    def test_grow_block_in_every_response(self, warm_session):
+        batcher, session = warm_session
+        doc = _fit(batcher, session, FIT_PLAIN)
+        g = doc["engine"]["grow"]
+        for k in ("extends", "bucket_promotions", "node_extends",
+                  "rebuilds", "retensorize_fallbacks", "compile.grow"):
+            assert isinstance(g[k], int), (k, g)
+        assert g["warm"] is True
+        assert g["buckets"]["t_cap"] >= g["buckets"]["terms"]
+
+    def test_warm_capacity_fully_placed_session(self, warm_session):
+        batcher, session = warm_session
+        doc = _capacity(batcher, session, {})
+        assert doc["warm"] is True, doc
+        assert doc["success"] and doc["nodes_added"] == 0, doc
+        assert doc["audit"]["ok"] is True
+
+
+NODE_TMPL = """\
+apiVersion: v1
+kind: Node
+metadata:
+  name: worker-template
+  labels:
+    kubernetes.io/hostname: worker-template
+    topology.kubernetes.io/zone: zone-a
+status:
+  allocatable:
+    cpu: "16"
+    memory: 32Gi
+    pods: "110"
+  capacity:
+    cpu: "16"
+    memory: 32Gi
+    pods: "110"
+"""
+
+
+@pytest.fixture(scope="module")
+def strands_config(tmp_path_factory):
+    """A Config CR whose base (two 4-cpu nodes + a DaemonSet) strands
+    six of the heavy app's eight 3-cpu replicas — capacity planning must
+    grow template clones."""
+    root = tmp_path_factory.mktemp("strands")
+    cl = root / "cluster"
+    ap = root / "app"
+    cl.mkdir()
+    ap.mkdir()
+    nodes = []
+    for i, zone in enumerate(("zone-a", "zone-b")):
+        nodes.append(
+            "apiVersion: v1\nkind: Node\nmetadata:\n"
+            f"  name: small-{i}\n  labels:\n"
+            f"    kubernetes.io/hostname: small-{i}\n"
+            f"    topology.kubernetes.io/zone: {zone}\n"
+            "status:\n  allocatable:\n    cpu: \"4\"\n    memory: 8Gi\n"
+            "    pods: \"110\"\n  capacity:\n    cpu: \"4\"\n"
+            "    memory: 8Gi\n    pods: \"110\"\n"
+        )
+    (cl / "nodes.yaml").write_text("---\n".join(nodes))
+    (cl / "workloads.yaml").write_text(
+        "apiVersion: apps/v1\nkind: DaemonSet\nmetadata:\n  name: agent\n"
+        "  namespace: kube-system\nspec:\n  selector:\n    matchLabels:\n"
+        "      app: agent\n  template:\n    metadata:\n      labels:\n"
+        "        app: agent\n    spec:\n      containers:\n"
+        "        - name: agent\n          image: registry.example.com/a:1\n"
+        "          resources:\n            requests:\n"
+        "              cpu: 200m\n              memory: 128Mi\n"
+    )
+    (ap / "app.yaml").write_text(
+        "apiVersion: apps/v1\nkind: Deployment\nmetadata:\n  name: heavy\n"
+        "  namespace: default\nspec:\n  replicas: 8\n  selector:\n"
+        "    matchLabels:\n      app: heavy\n  template:\n    metadata:\n"
+        "      labels:\n        app: heavy\n    spec:\n      containers:\n"
+        "        - name: c\n          image: registry.example.com/h:1\n"
+        "          resources:\n            requests:\n"
+        "              cpu: \"3\"\n              memory: 2Gi\n"
+    )
+    (root / "worker.yaml").write_text(NODE_TMPL)
+    cfg = root / "config.yaml"
+    cfg.write_text(
+        "apiVersion: simon/v1alpha1\nkind: Config\nmetadata:\n"
+        "  name: strands\nspec:\n  cluster:\n"
+        f"    customConfig: {cl}\n  appList:\n"
+        f"    - name: heavy\n      path: {ap}\n"
+        f"  newNode: {root / 'worker.yaml'}\n"
+    )
+    return str(cfg)
+
+
+class TestWarmCapacityStrands:
+    @pytest.fixture(scope="class")
+    def stranded(self, strands_config):
+        batcher, session = _store(warm=True, config=strands_config)
+        assert int(np.sum(np.asarray(session.pc.nodes) < 0)) > 0
+        return batcher, session
+
+    @pytest.mark.slow
+    def test_completes_strands_and_matches_legacy(self, stranded,
+                                                  strands_config):
+        batcher, session = stranded
+        before = REGISTRY.snapshot()
+        doc = _capacity(batcher, session, {"max_new_nodes": 8})
+        delta = REGISTRY.delta_since(before)
+        assert doc["warm"] is True, doc
+        assert doc["success"] and doc["nodes_added"] >= 1, doc
+        assert doc["audit"]["ok"] is True, doc.get("audit")
+        assert delta.get("grow.retensorize_fallbacks", 0) == 0, delta
+        b2, s2 = _store(warm=False, config=strands_config)
+        doc_c = _capacity(b2, s2, {"max_new_nodes": 8})
+        assert doc_c["success"] == doc["success"]
+        assert doc_c["nodes_added"] == doc["nodes_added"]
+
+    @pytest.mark.slow
+    def test_overlay_cached_and_session_isolated(self, stranded):
+        batcher, session = stranded
+        doc = _capacity(batcher, session, {"max_new_nodes": 8})
+        before = REGISTRY.snapshot()
+        doc2 = _capacity(batcher, session, {"max_new_nodes": 8})
+        delta = REGISTRY.delta_since(before)
+        assert doc2["nodes_added"] == doc["nodes_added"]
+        assert delta.get("grow.node_extends", 0) == 0, delta
+        # the hypothetical clones never leak into the session base
+        tiny = {"workloads": [{
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "tiny", "namespace": "default"},
+            "spec": {"replicas": 1, "template": {
+                "metadata": {"labels": {"app": "tiny"}},
+                "spec": {"containers": [{
+                    "name": "c", "image": "nginx",
+                    "resources": {"requests": {
+                        "cpu": "100m", "memory": "64Mi",
+                    }},
+                }]},
+            }},
+        }]}
+        docf = _fit(batcher, session, tiny)
+        assert docf["warm"] is True and docf["fits"], docf
+        assert set(docf["placements"]) <= set(session.node_index)
+
+
+class TestGrowCompileBudget:
+    """Growth kernels trace once per (old bucket, new bucket,
+    appended-row bucket) signature — the trace-once-per-bucket contract
+    TestSolveCompileBudget pins for the solve kind."""
+
+    def test_same_bucket_appends_trace_nothing_new(self):
+        def small(i):
+            return _app(f"q{i}", [
+                make_deployment(
+                    f"q{i}a", 3, 250, 256,
+                    anti_affinity_topo="kubernetes.io/hostname",
+                    anti_affinity_required=True,
+                ),
+                make_deployment(
+                    f"q{i}b", 3, 250, 256,
+                    affinity_topo="topology.kubernetes.io/zone",
+                ),
+            ])
+
+        cluster, waves = make_problem()
+        tz, all_nodes, _nb, ordered = assemble_planning_problem(
+            cluster, [waves[0]], cluster.nodes[0], 0
+        )
+        eng = RoundsEngine(tz)
+        eng.enable_grow()
+        eng.place(tz.add_pods(ordered))
+        # the many-term wave promotes the bucket, anchoring the term
+        # axes at the BOTTOM of a fresh pow2 cap — the appends below
+        # cannot cross a boundary and the test measures pure reuse
+        eng.place(tz.add_pods(expand_app(waves[3], all_nodes)))
+        # first small append may trace its extend signature once...
+        eng.place(tz.add_pods(expand_app(small(0), all_nodes)))
+        caps = (eng._grow_ref["t_cap"], eng._grow_ref["ti_cap"])
+        before = REGISTRY.snapshot()
+        # ...the SECOND append with the same bucket signature (same app
+        # shape, fresh names → new groups + terms inside the same pow2
+        # bucket) must trace NOTHING
+        eng.place(tz.add_pods(expand_app(small(1), all_nodes)))
+        delta = REGISTRY.delta_since(before)
+        assert (eng._grow_ref["t_cap"], eng._grow_ref["ti_cap"]) == caps
+        assert delta.get("grow.bucket_promotions", 0) == 0, delta
+        assert delta.get("compile.grow", 0) == 0, delta
+        assert delta.get("grow.rebuilds", 0) == 0, delta
+        assert delta.get("grow.extends", 0) >= 1, delta

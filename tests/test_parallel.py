@@ -107,6 +107,9 @@ class TestShardedRoundsEngine:
 
 
 class TestShardedMatrixRounds:
+    # heaviest single cell in the module; fuzz-smoke's GSPMD column
+    # re-proves the identity in CI, so it rides the slow tier
+    @pytest.mark.slow
     def test_matrix_mix_identical_under_gspmd_small(self):
         """Fast-tier sibling of the slow matrix test: the same round
         variants (multi-GPU, multi-claim LVM, preset gpu-index, required
@@ -283,6 +286,10 @@ class TestShardedIncrementalPlanner:
 
 
 class TestBatchedSweep:
+    # tier-1 keeps the host-vs-mesh sweep pin below; the vmapped-vs-
+    # serial-planner identity duplicates test_faults' serial-oracle
+    # pins and rides the slow tier
+    @pytest.mark.slow
     def test_matches_serial_planner(self, scenario):
         """The one-shot vmapped sweep must find the same minimum node count
         as the reference-shaped serial search."""

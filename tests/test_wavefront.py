@@ -102,7 +102,13 @@ MIXES = ("north", "hard", "matrix", "priority")
 
 
 class TestWavefrontBitIdentity:
-    @pytest.mark.parametrize("mix", MIXES)
+    # the matrix mix is the heavyweight cell; CI's fuzz-smoke matrix
+    # covers wavefront bit-identity on every push, so it rides the
+    # slow tier to keep tier-1 inside its wall budget
+    @pytest.mark.parametrize(
+        "mix",
+        [pytest.param(m, marks=pytest.mark.slow) if m == "matrix" else m
+         for m in MIXES])
     def test_identical_to_pod_at_a_time(self, mix):
         """The headline guarantee: wavefront placements (nodes, reasons,
         extended-resource allocations) are bit-identical to the serial
@@ -467,7 +473,13 @@ class TestHeavyDrafting:
         rate = accepted / drafted
         assert 0 < rate <= 1
 
-    @pytest.mark.parametrize("seed", [5, 7, 12])
+    # one gnarly seed stays in tier-1; the other two ride the slow tier
+    # (fuzz-smoke sweeps the full seeded corpus in CI regardless)
+    @pytest.mark.parametrize(
+        "seed",
+        [5,
+         pytest.param(7, marks=pytest.mark.slow),
+         pytest.param(12, marks=pytest.mark.slow)])
     def test_fuzz_gnarly_mixes_identical_and_audit_clean(self, seed):
         """Seeded gnarly storage/GPU/ports mixes (audit/fuzz.gen_case —
         seed 7 draws all three): wavefront == serial bit-identically, the

@@ -111,6 +111,18 @@ def serve_metrics(base) -> dict:
     }
 
 
+def grow_metrics(base) -> dict:
+    """The warm-engine counter family (grow.* + serve.warm_*): the
+    arrival sweep asserts the common path stayed append-only
+    (`grow.retensorize_fallbacks` unmoved)."""
+    _, doc, _ = request(base, "GET", "/metrics")
+    return {
+        k: v for k, v in doc["metrics"].items()
+        if k.startswith("grow.") or k.startswith("serve.warm")
+        or k == "compile.grow"
+    }
+
+
 def delta(after: dict, before: dict) -> dict:
     out = {}
     for k, v in after.items():
@@ -204,10 +216,15 @@ def overload_tail(base, sid, n_nodes, width, say):
     results = [None] * width
 
     def fire(i):
-        results[i] = request(
-            base, "POST", f"/v1/sessions/{sid}/drain",
-            {"nodes": [i % n_nodes]},
-        )
+        try:
+            results[i] = request(
+                base, "POST", f"/v1/sessions/{sid}/drain",
+                {"nodes": [i % n_nodes]},
+            )
+        except OSError as exc:
+            # a refused/reset connection under deliberate overload is a
+            # shed-shaped outcome, not a generator crash
+            results[i] = (0, {"error": str(exc)}, {})
 
     pool = [threading.Thread(target=fire, args=(i,)) for i in range(width)]
     for t in pool:
@@ -218,6 +235,103 @@ def overload_tail(base, sid, n_nodes, width, say):
     shed = [r for r in results if r[0] == 429]
     say(f"overload tail: {len(ok)} served, {len(shed)} shed (429)")
     return ok, shed
+
+
+def fit_payload(i: int) -> dict:
+    """One of two fixed fit-query shapes (alternating): a serving mix
+    repeats shapes, which is exactly what the warm engine's append-only
+    vocabulary is built for — after the first occurrence of each shape
+    the session must answer with ZERO re-tensorization."""
+    shape = i % 2
+    name = f"arrival-{shape}"
+    return {
+        "workloads": [{
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {
+                "replicas": 1 + shape,
+                "template": {
+                    "metadata": {"labels": {"app": name}},
+                    "spec": {"containers": [{
+                        "name": "c", "image": "nginx",
+                        "resources": {"requests": {
+                            "cpu": "250m" if shape else "100m",
+                            "memory": "128Mi",
+                        }},
+                    }]},
+                },
+            },
+        }],
+    }
+
+
+def arrival_sweep(base, sid, rates, duration, say):
+    """Sustained OPEN-LOOP arrival sweep: for each rate, fit queries fire
+    at fixed inter-arrival periods for `duration` seconds regardless of
+    completions (each request on its own thread — a slow server builds a
+    queue instead of slowing the generator, the way real arrival streams
+    behave).  Returns per-rate latency records.  The sweep measures WARM
+    serving: one fit per shape runs serially first so trace/compile
+    cost (paid once per session, docs/serving.md) stays out of the
+    latency quantiles."""
+    for i in range(2):
+        status, _doc, _ = request(
+            base, "POST", f"/v1/sessions/{sid}/fit", fit_payload(i)
+        )
+        if status != 200:
+            say(f"arrival warm-up query {i} answered {status}")
+    records = []
+    for rate in rates:
+        period = 1.0 / rate
+        lats, statuses = [], []
+        lock = threading.Lock()
+        threads = []
+
+        def fire(i):
+            t0 = time.perf_counter()
+            status, doc, _ = request(
+                base, "POST", f"/v1/sessions/{sid}/fit", fit_payload(i)
+            )
+            dt = time.perf_counter() - t0
+            with lock:
+                statuses.append(status)
+                if status == 200:
+                    lats.append(dt)
+
+        t_start = time.perf_counter()
+        i = 0
+        while True:
+            t_next = t_start + i * period
+            now = time.perf_counter()
+            if t_next >= t_start + duration:
+                break
+            if now < t_next:
+                time.sleep(t_next - now)
+            th = threading.Thread(target=fire, args=(i,))
+            th.start()
+            threads.append(th)
+            i += 1
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t_start
+        lats.sort()
+        rec = {
+            "rate": rate,
+            "sent": i,
+            "ok": sum(1 for s in statuses if s == 200),
+            "shed": sum(1 for s in statuses if s == 429),
+            "achieved_qps": round(len(lats) / wall, 2) if wall > 0 else 0.0,
+            "p50_s": round(quantile(lats, 0.50), 4),
+            "p99_s": round(quantile(lats, 0.99), 4),
+        }
+        records.append(rec)
+        say(
+            f"arrival {rate:g}/s: sent={rec['sent']} ok={rec['ok']} "
+            f"achieved={rec['achieved_qps']}/s p50={rec['p50_s']}s "
+            f"p99={rec['p99_s']}s"
+        )
+    return records
 
 
 def main(argv=None) -> int:
@@ -237,6 +351,15 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="assert the full robustness matrix (kill -9 "
                     "restart recovery + SIGTERM drain included)")
+    ap.add_argument("--arrival-sweep", default="",
+                    help="comma list of sustained open-loop fit-query "
+                    "arrival rates (QPS), e.g. '4,12'; asserts p50/p99 "
+                    "bounds and zero warm-path retensorize fallbacks")
+    ap.add_argument("--arrival-duration", type=float, default=3.0,
+                    help="seconds per arrival rate (default 3)")
+    ap.add_argument("--p99-max", type=float, default=5.0,
+                    help="p99 latency bound asserted at the LOWEST "
+                    "arrival rate (default 5s)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -325,6 +448,47 @@ def main(argv=None) -> int:
             f"coalesced={summary['serve_coalesced']} "
             f"sweeps={summary['serve_sweeps']} vs {sweep_requests} requests",
         )
+
+        # sustained open-loop arrival sweep (fit queries, warm path)
+        if args.arrival_sweep:
+            rates = [float(r) for r in args.arrival_sweep.split(",") if r]
+            gbefore = grow_metrics(base)
+            records = arrival_sweep(
+                base, sid, rates, args.arrival_duration, say
+            )
+            gafter = grow_metrics(base)
+            gd = delta(gafter, gbefore)
+            summary["arrival"] = records
+            summary["serve_fit_p50_s"] = records[0]["p50_s"]
+            summary["serve_fit_p99_s"] = records[0]["p99_s"]
+            summary["serve_warm_fits"] = int(gd.get("serve.warm_fits", 0))
+            summary["serve_warm_fallbacks"] = int(
+                gd.get("grow.retensorize_fallbacks", 0)
+            )
+            check(
+                "arrival_statuses",
+                all(r["ok"] + r["shed"] == r["sent"] for r in records),
+                f"non-200/429s: {records}",
+            )
+            check(
+                "arrival_low_rate_unshed",
+                records[0]["shed"] == 0 and records[0]["ok"] > 0,
+                f"sheds at the lowest rate: {records[0]}",
+            )
+            check(
+                "arrival_p99_bound",
+                records[0]["p99_s"] <= args.p99_max,
+                f"p99 {records[0]['p99_s']}s > {args.p99_max}s "
+                f"at {records[0]['rate']:g}/s",
+            )
+            if gd.get("serve.warm_fits", 0) > 0:
+                # the acceptance bar: a repeating serving mix must ride
+                # the append-only vocabulary — zero re-tensorizations
+                check(
+                    "warm_zero_fallbacks",
+                    gd.get("grow.retensorize_fallbacks", 0) == 0,
+                    f"retensorize fallbacks on the common path: {gd}",
+                )
 
         # overload tail (only meaningful against our own small queue)
         if daemon is not None:
